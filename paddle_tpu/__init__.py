@@ -1,0 +1,16 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new JAX/XLA/Pallas implementation with the capability surface of
+PaddlePaddle Fluid (reference: zlsh80826/Paddle): static-graph Program IR
+with program-level autodiff, a trace-once XLA executor, an eager (dygraph)
+engine, fleet-style distributed training on GSPMD meshes, AMP, and a 2.0
+nn/optimizer/tensor API.
+"""
+
+__version__ = "0.1.0"
+
+from . import ops
+from . import framework
+from .framework import (Program, Executor, Scope, global_scope,
+                        default_main_program, default_startup_program,
+                        program_guard, append_backward)

@@ -1,0 +1,204 @@
+"""Program-level autodiff: append_backward.
+
+Analog of python/paddle/fluid/backward.py:1215 (append_backward) and the
+C++ GradOpMaker registry. Gradients are REAL ops appended to the Program —
+not a closed-over jax.grad — so program-rewrite passes (AMP, DGC, pipeline
+split, transpilers) can see and edit backward ops, matching the reference's
+capability (SURVEY §7 step 4).
+
+Accumulation follows the reference's rename-and-sum scheme
+(backward.py _addup_repetitive_outputs_): when multiple consumers
+contribute gradients for one forward var, each grad op writes a unique
+``<var>@GRAD@RENAME@i`` and a ``sum`` op materializes ``<var>@GRAD``.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..ops import registry as _reg
+from .program import Block, Operator, Variable, grad_var_name
+
+
+def _op_def(op_type: str):
+    try:
+        return _reg.get_op_def(op_type)
+    except NotImplementedError:
+        return None
+
+
+def _forward_needs_grad(block: Block, no_grad_set: Set[str]) -> Set[str]:
+    """Forward sweep: which var names can carry gradient."""
+    needs: Set[str] = set()
+    for v in block.vars.values():
+        if v.name in no_grad_set:
+            continue
+        if v.is_parameter and v.trainable:
+            needs.add(v.name)
+        elif not v.stop_gradient and v.is_data:
+            needs.add(v.name)
+    for op in block.ops:
+        d = _op_def(op.type)
+        if d is None or d.not_differentiable:
+            continue
+        if any(n in needs for n in op.input_names()):
+            for slot, names in op.outputs.items():
+                if slot in d.nondiff_outputs:
+                    continue
+                for n in names:
+                    if n not in no_grad_set:
+                        needs.add(n)
+    return needs
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[Set[str]] = None,
+) -> List[Tuple[Variable, Variable]]:
+    result, _ = _append_backward_impl(loss, parameter_list, no_grad_set)
+    return result
+
+
+def _append_backward_impl(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    extra_vars: Sequence[str] = (),
+):
+    """Append grad ops computing d(loss)/d(param); returns [(param, grad)].
+
+    The backward ops are appended to ``loss.block`` in reverse-forward
+    order, with op attr ``op_role='backward'`` so passes (pipeline split,
+    AMP, gradient-merge) can classify them — analog of the reference's
+    OpRole attribute.
+    """
+    block = loss.block
+    program = block.program
+    no_grad_set = set(no_grad_set or ())
+    needs_grad = _forward_needs_grad(block, no_grad_set)
+    if loss.name not in needs_grad:
+        raise ValueError(
+            f"loss {loss.name!r} does not depend on any trainable parameter")
+
+    fwd_ops = list(block.ops)
+
+    # d(loss)/d(loss) = 1
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(loss_grad, shape=loss.shape, dtype=loss.dtype,
+                     stop_gradient=True)
+    block.append_op(
+        "fill_constant_like",
+        inputs={"X": [loss.name]},
+        outputs={"Out": [loss_grad]},
+        attrs={"value": 1.0, "op_role": "backward"},
+    )
+
+    # produced[v] = list of grad var names contributed so far
+    produced: Dict[str, List[str]] = defaultdict(list)
+    produced[loss.name].append(loss_grad)
+    finalized: Dict[str, str] = {}  # var -> materialized accumulated grad name
+
+    def materialize(v: str) -> Optional[str]:
+        """Return the accumulated grad name for forward var v (sum if >1)."""
+        if v in finalized:
+            return finalized[v]
+        contribs = produced.get(v)
+        if not contribs:
+            return None
+        if len(contribs) == 1:
+            finalized[v] = contribs[0]
+            return contribs[0]
+        acc = grad_var_name(v)
+        if acc in contribs:
+            acc = grad_var_name(v) + "@ACC"
+        block.create_var(acc, stop_gradient=True)
+        block.append_op("sum", inputs={"X": list(contribs)},
+                        outputs={"Out": [acc]},
+                        attrs={"op_role": "backward"})
+        finalized[v] = acc
+        return acc
+
+    for op in reversed(fwd_ops):
+        d = _op_def(op.type)
+        if d is None or d.not_differentiable:
+            continue
+        out_grad_names: Dict[str, List[Optional[str]]] = {}
+        any_grad = False
+        for slot, names in op.outputs.items():
+            gs: List[Optional[str]] = []
+            for n in names:
+                g = materialize(n)
+                gs.append(g)
+                if g is not None:
+                    any_grad = True
+            out_grad_names[slot] = gs
+        if not any_grad:
+            continue
+
+        # wanted input grads, unique name per (name, occurrence)
+        wanted: Dict[str, List[Optional[str]]] = {}
+        new_contribs: List[Tuple[str, str]] = []
+        for slot, names in op.inputs.items():
+            targets: List[Optional[str]] = []
+            for n in names:
+                if n in needs_grad and slot not in d.no_grad_slots:
+                    k = len(produced[n]) + sum(1 for v, _ in new_contribs if v == n)
+                    t = grad_var_name(n) if k == 0 else f"{grad_var_name(n)}@RENAME@{k}"
+                    targets.append(t)
+                    new_contribs.append((n, t))
+                else:
+                    targets.append(None)
+            wanted[slot] = targets
+
+        grad_op_descs = _reg.make_grad_ops(op, out_grad_names, wanted)
+        if not grad_op_descs:
+            continue
+        for (g_type, g_in, g_out, g_attrs) in grad_op_descs:
+            g_attrs = dict(g_attrs)
+            g_attrs["op_role"] = "backward"
+            block.append_op(g_type, inputs=g_in, outputs=g_out, attrs=g_attrs)
+        # register contributions actually emitted
+        emitted_targets = set()
+        for (_, _, g_out, _) in grad_op_descs:
+            for names in g_out.values():
+                emitted_targets.update(names)
+        for n, t in new_contribs:
+            if t in emitted_targets:
+                produced[n].append(t)
+                block.create_var(t, stop_gradient=True)
+
+    # materialize final grads for parameters
+    if parameter_list is not None:
+        params = [p if isinstance(p, Variable) else block.var(str(p))
+                  for p in parameter_list]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+    result: List[Tuple[Variable, Variable]] = []
+    for p in params:
+        g = materialize(p.name)
+        if g is None:
+            continue
+        gv = block.var(g)
+        gv.shape = p.shape
+        gv.dtype = p.dtype
+        result.append((p, gv))
+    # accumulated grad names for any extra requested vars (gradients() API)
+    grad_map = {v: materialize(v) for v in extra_vars}
+    program.bump_version()
+    return result, grad_map
+
+
+def gradients(targets, inputs, target_gradients=None) -> List[Optional[Variable]]:
+    """Analog of fluid.gradients: grads of targets w.r.t. arbitrary inputs."""
+    tgt = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(tgt) != 1:
+        raise NotImplementedError("gradients() currently supports one target")
+    names = [v.name if isinstance(v, Variable) else str(v) for v in ins]
+    _, grad_map = _append_backward_impl(tgt[0], parameter_list=None,
+                                        extra_vars=names)
+    block = tgt[0].block
+    return [block.vars.get(grad_map[n]) if grad_map.get(n) else None
+            for n in names]

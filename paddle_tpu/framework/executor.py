@@ -1,0 +1,213 @@
+"""Trace/compile Executor.
+
+Analog of the reference Executor (paddle/fluid/framework/executor.cc:180,
+303,428): ``exe.run(program, feed, fetch_list)`` with a persistent Scope.
+The architectural translation (SURVEY §3.1): the reference's hot loop —
+``for op in ops: op->Run(scope, place)`` with per-step InferShape — is
+replaced by tracing the whole block once into a single XLA computation,
+jit-compiled and cached by (program version, feed shapes/dtypes, fetch set).
+
+Semantics preserved:
+- persistable variables live in the Scope across runs (parameters,
+  optimizer accumulators, learning rate);
+- optimizer ops "mutate" params: functionally, every scope-resident input
+  is also returned as output and written back (XLA aliases unchanged ones,
+  donation reuses device buffers — the TPU analog of in-place update);
+- fetch of any intermediate variable = extra computation output
+  (the "fetch = extra output" rewrite from SURVEY §7);
+- randomness (init ops, dropout) is functional: a fresh PRNG key per run,
+  folded per-op — replaces the reference's global curand/std::mt19937
+  generators while keeping seed control via ``program.random_seed``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import registry as _reg
+from .program import Program, Variable, default_main_program
+from .scope import Scope, global_scope
+
+
+class _BlockRunner:
+    """Runs a block's ops against an env dict of traced values.
+
+    Shared by the top-level trace and control-flow lowerings (while/cond
+    call back into this to trace sub-blocks under lax control flow).
+    """
+
+    def __init__(self, program: Program, mesh=None, axis_env=None):
+        self.program = program
+        self.mesh = mesh
+        self.axis_env = axis_env or {}
+
+    def run_block(self, block_idx: int, env: Dict[str, Any], rng) -> Dict[str, Any]:
+        block = self.program.blocks[block_idx]
+        for i, op in enumerate(block.ops):
+            op_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            ctx = _reg.LoweringContext(
+                rng=op_rng, eager=False, mesh=self.mesh, axis_env=self.axis_env)
+            ctx.block_runner = self  # control-flow hook
+            ctx.env = env
+            ins = {}
+            for slot, names in op.inputs.items():
+                vals = []
+                for n in names:
+                    if n not in env:
+                        raise KeyError(
+                            f"op {op.type!r} input {slot}={n!r} is not defined "
+                            f"— not produced by a prior op, not fed, and not "
+                            f"in scope (analog of PADDLE_ENFORCE NotFound)")
+                    vals.append(env[n])
+                ins[slot] = vals
+            outs = _reg.execute(ctx, op.type, ins, op.attrs)
+            for slot, names in op.outputs.items():
+                vals = outs.get(slot, [])
+                for n, v in zip(names, vals):
+                    env[n] = v
+        return env
+
+
+def _collect_io(block, feed_names, scope: Scope):
+    """Static analysis of a block: which names must come from scope (state),
+    and which outputs must be written back.
+
+    Scope-resident inputs: read before first definition, not fed. Write-back
+    set: every scope-resident input (pass-through or updated) plus any
+    persistable output — so donation is always safe and the scope never
+    holds a stale buffer.
+    """
+    defined = set(feed_names)
+    state_in: List[str] = []
+    written: List[str] = []
+    for op in block.ops:
+        for names in op.inputs.values():
+            for n in names:
+                if n not in defined and n not in state_in:
+                    if scope.has_var(n):
+                        state_in.append(n)
+                        defined.add(n)
+        for names in op.outputs.values():
+            for n in names:
+                defined.add(n)
+                try:
+                    v = block.var(n)
+                    persistable = v.persistable
+                except KeyError:
+                    persistable = False
+                if (persistable or scope.has_var(n)) and n not in written:
+                    written.append(n)
+    # every state input is written back (pass-through if not updated)
+    for n in state_in:
+        if n not in written:
+            written.append(n)
+    return state_in, written
+
+
+class Executor:
+    """Analog of fluid.Executor (executor.py:915 / executor.cc:180)."""
+
+    def __init__(self, place: Any = None, donate_state: bool = False):
+        self.place = place
+        # donate_state=True reuses device buffers for scope state across
+        # runs (in-place param update on TPU — big memory win) but
+        # invalidates any caller-held references to scope arrays after a
+        # run. Off by default for safety; training loops that only access
+        # state through the scope should enable it.
+        self.donate_state = donate_state
+        self._cache: Dict[Any, Any] = {}
+        self._seed_counters: Dict[int, int] = {}
+        # OS-entropy seeded: unseeded programs vary run to run (matching
+        # the reference's unseeded generators); set program.random_seed
+        # for determinism.
+        self._nprng = np.random.RandomState()
+
+    # -- public API --------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence[Any]] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        program = program or default_main_program()
+        # CompiledProgram front door (analog of _run_parallel dispatch)
+        if hasattr(program, "_compile_for_executor"):
+            return program._compile_for_executor(self).run(
+                feed=feed, fetch_list=fetch_list, scope=scope,
+                return_numpy=return_numpy)
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+
+        feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
+        feed_sig = tuple(sorted(
+            (k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items()))
+        # The scope-names signature catches "scope populated after first
+        # run" (e.g. startup run late) — contents changing set of names
+        # forces a re-analysis. Refs to program and scope are kept in the
+        # entry so id() reuse after GC can't alias a stale entry.
+        scope_sig = hash(frozenset(scope.all_var_names()))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               id(scope), scope_sig)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(program, feed_arrays, fetch_names, scope)
+            self._cache[key] = entry
+        compiled, state_in, written, _refs = entry
+
+        state = {}
+        for n in state_in:
+            v = scope.find_var(n)
+            if v is None:
+                raise KeyError(
+                    f"variable {n!r} needed by the program is not in scope — "
+                    f"did you run the startup program?")
+            state[n] = v if isinstance(v, jax.Array) else jnp.asarray(v)
+        rng = self._next_rng(program)
+
+        fetches, new_state = compiled(state, feed_arrays, rng)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()
+
+    # -- internals ---------------------------------------------------------
+    def _next_rng(self, program: Program):
+        if program.random_seed is not None:
+            seed = int(program.random_seed)
+            # deterministic but varying per call for this program
+            ctr = self._seed_counters.get(id(program), 0) + 1
+            self._seed_counters[id(program)] = ctr
+            return jax.random.fold_in(jax.random.PRNGKey(seed), ctr)
+        return jax.random.PRNGKey(int(self._nprng.randint(0, 2**31 - 1)))
+
+    def _build(self, program: Program, feed_arrays, fetch_names, scope):
+        block = program.global_block()
+        state_in, written = _collect_io(block, feed_arrays.keys(), scope)
+        runner = _BlockRunner(program)
+
+        def step(state, feed, rng):
+            env = dict(state)
+            env.update(feed)
+            env = runner.run_block(0, env, rng)
+            missing = [n for n in fetch_names if n not in env]
+            if missing:
+                raise KeyError(f"fetch vars not produced by program: {missing}")
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env.get(n, state.get(n)) for n in written}
+            return fetches, new_state
+
+        donate = (0,) if self.donate_state else ()
+        compiled = jax.jit(step, donate_argnums=donate)
+        return compiled, state_in, written, (program, scope)

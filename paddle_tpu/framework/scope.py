@@ -1,0 +1,66 @@
+"""Scope: name -> device array store.
+
+Analog of the reference's Scope/Variable (framework/scope.h, variable.h) —
+but instead of a hierarchy of C++ Variables holding LoDTensors, a Scope here
+is a flat name->jax.Array map that persists across Executor.run calls. The
+executor reads persistable inputs from the scope, runs one traced XLA
+computation, and writes updated persistables back (functional in/out instead
+of in-place mutation — the XLA-native translation of scope mutation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def var_names(self):
+        return list(self._vars.keys())
+
+    def all_var_names(self):
+        """All names visible from this scope (own + ancestors)."""
+        names = set()
+        s: Optional[Scope] = self
+        while s is not None:
+            names.update(s._vars.keys())
+            s = s.parent
+        return names
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+    def get_numpy(self, name: str) -> np.ndarray:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not in scope")
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope

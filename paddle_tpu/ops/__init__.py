@@ -1,0 +1,12 @@
+"""Op lowering library — importing this package populates the registry."""
+
+from . import registry
+from .registry import (LoweringContext, execute, get_op_def, is_registered,
+                       register, registered_ops)
+
+from . import math_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import reduce_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,344 @@
+"""Dense math op lowerings.
+
+Analogs of reference kernels in paddle/fluid/operators/ (elementwise/,
+activation_op.*, matmul_op.*, scale_op, sum_op, cast_op, clip_op...).
+Each CUDA kernel body becomes a jnp/lax emitter that XLA fuses and tiles
+onto the MXU/VPU; gradients are vjp-derived unless noted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import LoweringContext, register
+
+
+def _bcast_y(x, y, axis: int):
+    """Paddle elementwise broadcast: align y's dims to x starting at `axis`
+    (reference operators/elementwise/elementwise_op_function.h semantics)."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    axis = int(axis)
+    pad_right = x.ndim - axis - y.ndim
+    shape = (1,) * axis + y.shape + (1,) * pad_right
+    return y.reshape(shape)
+
+
+def _ew(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _bcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+    return _lower
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod)
+_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+def _unary(name, fn, **kw):
+    @register(name, **kw)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0])]}
+    return _lower
+
+
+_unary("relu", jax.nn.relu)
+_unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("square", jnp.square)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("abs", jnp.abs)
+_unary("reciprocal", jnp.reciprocal)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("asinh", jnp.arcsinh)
+_unary("acosh", jnp.arccosh)
+_unary("atanh", jnp.arctanh)
+_unary("erf", jax.scipy.special.erf)
+_unary("floor", jnp.floor, not_differentiable=True)
+_unary("ceil", jnp.ceil, not_differentiable=True)
+_unary("round", jnp.round, not_differentiable=True)
+_unary("sign", jnp.sign, not_differentiable=True)
+_unary("logical_not", jnp.logical_not, not_differentiable=True)
+_unary("softsign", lambda x: x / (1.0 + jnp.abs(x)))
+_unary("silu", jax.nn.silu)
+
+
+@register("gelu")
+def _gelu(ctx, ins, attrs):
+    approx = bool(attrs.get("approximate", False))
+    return {"Out": [jax.nn.gelu(ins["X"][0], approximate=approx)]}
+
+
+@register("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    return {"Out": [jax.nn.leaky_relu(ins["X"][0], negative_slope=alpha)]}
+
+
+@register("elu")
+def _elu(ctx, ins, attrs):
+    return {"Out": [jax.nn.elu(ins["X"][0], alpha=attrs.get("alpha", 1.0))]}
+
+
+@register("softplus")
+def _softplus(ctx, ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    threshold = attrs.get("threshold", 20.0)
+    x = ins["X"][0]
+    out = jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+    return {"Out": [out]}
+
+
+@register("swish")
+def _swish(ctx, ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = ins["X"][0]
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(slope * ins["X"][0] + offset, 0.0, 1.0)]}
+
+
+@register("hard_swish")
+def _hard_swish(ctx, ins, attrs):
+    x = ins["X"][0]
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    return {"Out": [x * jnp.clip(x + offset, 0.0, threshold) / scale]}
+
+
+@register("hard_tanh")
+def _hard_tanh(ctx, ins, attrs):
+    t_min = attrs.get("t_min", -1.0)
+    t_max = attrs.get("t_max", 1.0)
+    return {"Out": [jnp.clip(ins["X"][0], t_min, t_max)]}
+
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+@register("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    bias_after_scale = attrs.get("bias_after_scale", True)
+    if "ScaleTensor" in ins and ins["ScaleTensor"]:
+        scale = ins["ScaleTensor"][0]
+    if bias_after_scale:
+        out = x * scale + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * scale
+    return {"Out": [out]}
+
+
+@register("clip")
+def _clip(ctx, ins, attrs):
+    lo = ins["Min"][0] if ins.get("Min") else attrs.get("min")
+    hi = ins["Max"][0] if ins.get("Max") else attrs.get("max")
+    return {"Out": [jnp.clip(ins["X"][0], lo, hi)]}
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale.astype(x.dtype)]}
+
+
+@register("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("cast", custom_grad_maker=None)
+def _cast(ctx, ins, attrs):
+    from ..framework.program import convert_dtype
+    return {"Out": [ins["X"][0].astype(convert_dtype(attrs["out_dtype"]))]}
+
+
+@register("cast_grad")
+def _cast_grad(ctx, ins, attrs):
+    from ..framework.program import convert_dtype
+    g = ins["Out@GRAD"][0]
+    if ins.get("X"):  # default grad maker forwards X; its dtype is truth
+        in_dtype = ins["X"][0].dtype
+    else:
+        in_dtype = convert_dtype(attrs.get("in_dtype", "float32"))
+    if not jnp.issubdtype(jnp.dtype(in_dtype), jnp.inexact):
+        return {"X@GRAD": [jnp.zeros(g.shape, in_dtype)]}
+    return {"X@GRAD": [g.astype(in_dtype)]}
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ty:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = x @ y
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register("matmul_v2")
+def _matmul_v2(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False) and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False) and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [x @ y]}
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    """FC matmul: flatten x to 2-D at x_num_col_dims (operators/mul_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = x2 @ y2
+    return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
+
+
+@register("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1)]}
+
+
+@register("addmm")
+def _addmm(ctx, ins, attrs):
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    alpha = attrs.get("Alpha", 1.0)
+    beta = attrs.get("Beta", 1.0)
+    return {"Out": [beta * inp + alpha * (x @ y)]}
+
+
+def _cmp(name, fn):
+    @register(name, not_differentiable=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [_fn(x, y)]}
+    return _lower
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+
+
+@register("isfinite", not_differentiable=True)
+def _isfinite(ctx, ins, attrs):
+    # reference isfinite_op reduces to a single bool
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0]))]}
+
+
+@register("isfinite_v2", not_differentiable=True)
+def _isfinite_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isfinite(ins["X"][0])]}
+
+
+@register("isnan_v2", not_differentiable=True)
+def _isnan_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isnan(ins["X"][0])]}
+
+
+@register("isinf_v2", not_differentiable=True)
+def _isinf_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isinf(ins["X"][0])]}
+
+
+@register("increment", not_differentiable=True)
+def _increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+
+
+@register("p_norm")
+def _p_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    porder = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keepdim = attrs.get("keepdim", False)
+    eps = attrs.get("epsilon", 1e-12)
+    out = jnp.power(jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis,
+                            keepdims=keepdim) + eps, 1.0 / porder)
+    return {"Out": [out]}
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(jnp.square(x)).reshape((1,))]}
+
+
+@register("maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": [jnp.maximum(ins["X"][0], ins["Y"][0])]}
+
+
+@register("minimum")
+def _minimum(ctx, ins, attrs):
+    return {"Out": [jnp.minimum(ins["X"][0], ins["Y"][0])]}

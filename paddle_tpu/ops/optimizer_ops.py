@@ -1,0 +1,184 @@
+"""Optimizer op lowerings.
+
+Analogs of paddle/fluid/operators/optimizers/ (sgd_op, momentum_op, adam_op,
+lamb_op, lars_momentum_op, adagrad_op, rmsprop_op...). Each is a pure
+update: "ParamOut" etc. rebind the persistable state vars in the traced
+env; the executor writes them back to the scope (functional in-place).
+All are not_differentiable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_ND = {"not_differentiable": True}
+
+
+@register("sgd", **_ND)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype)]}
+
+
+@register("momentum", **_ND)
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    g = g.astype(p.dtype)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register("adam", **_ND)
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = g.astype(p.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * g * g
+    b1p_out = b1p * beta1
+    b2p_out = b2p * beta2
+    lr_t = lr * jnp.sqrt(1 - b2p_out.reshape(())) / (1 - b1p_out.reshape(()))
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {"ParamOut": [p_out], "Moment1Out": [m1_out],
+            "Moment2Out": [m2_out], "Beta1PowOut": [b1p_out],
+            "Beta2PowOut": [b2p_out]}
+
+
+@register("adamw", **_ND)
+def _adamw(ctx, ins, attrs):
+    """Decoupled weight decay (2.0 paddle.optimizer.AdamW semantics)."""
+    p = ins["Param"][0]
+    coeff = attrs.get("coeff", 0.01)
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    with_decay = attrs.get("with_decay", True)
+    out = _adam(ctx, ins, attrs)
+    if with_decay:
+        out["ParamOut"][0] = out["ParamOut"][0] - lr * coeff * p
+    return out
+
+
+@register("adagrad", **_ND)
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    mom_out = mom + g * g
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register("rmsprop", **_ND)
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-10)
+    decay = attrs.get("decay", 0.9)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    g = g.astype(p.dtype)
+    ms_out = decay * ms + (1 - decay) * g * g
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_out = decay * mg + (1 - decay) * g
+        denom = jnp.sqrt(ms_out - mg_out * mg_out + eps)
+        mom_out = momentum * mom + lr * g / denom
+        return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+                "MomentOut": [mom_out], "MeanGradOut": [mg_out]}
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+            "MomentOut": [mom_out]}
+
+
+@register("lamb", **_ND)
+def _lamb(ctx, ins, attrs):
+    """reference operators/optimizers/lamb_op.cc: Adam update rescaled by
+    trust ratio ||p|| / ||update||."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    g = g.astype(p.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * g * g
+    m1_hat = m1_out / (1 - b1p.reshape(()))
+    m2_hat = m2_out / (1 - b2p.reshape(()))
+    upd = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+    ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
+    p_out = p - lr * ratio * upd
+    return {"ParamOut": [p_out], "Moment1Out": [m1_out],
+            "Moment2Out": [m2_out], "Beta1PowOut": [b1p * beta1],
+            "Beta2PowOut": [b2p * beta2]}
+
+
+@register("lars_momentum", **_ND)
+def _lars_momentum(ctx, ins, attrs):
+    """reference operators/optimizers/lars_momentum_op.cc."""
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    g = g.astype(p.dtype)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps), lr)
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register("ftrl", **_ND)
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    g = g.astype(p.dtype)
+    new_sq = sq + g * g
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    quad = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre / quad, jnp.zeros_like(p))
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register("dpsgd", **_ND)
+def _dpsgd(ctx, ins, attrs):
+    import jax
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0].reshape(()).astype(p.dtype)
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, g.dtype)
+    return {"ParamOut": [p - lr * (g * scale + noise) / batch_size]}

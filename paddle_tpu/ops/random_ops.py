@@ -1,0 +1,89 @@
+"""Random op lowerings — functional PRNG.
+
+Analogs of gaussian_random_op.cu, uniform_random_op.cu, randint_op,
+truncated_gaussian_random_op (paddle/fluid/operators/). The reference uses
+stateful curand generators; here every random op derives its stream from
+the per-run PRNG key folded per op-index (registry.LoweringContext.rng) —
+deterministic under program.random_seed, parallel-safe under pjit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.program import convert_dtype
+from .registry import register
+
+
+def _maybe_seed(ctx, attrs):
+    seed = attrs.get("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(int(seed))
+    return ctx.rng()
+
+
+@register("gaussian_random", not_differentiable=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = mean + std * jax.random.normal(_maybe_seed(ctx, attrs), shape, dtype)
+    return {"Out": [out]}
+
+
+@register("uniform_random", not_differentiable=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    out = jax.random.uniform(_maybe_seed(ctx, attrs), shape, dtype, lo, hi)
+    return {"Out": [out]}
+
+
+@register("truncated_gaussian_random", not_differentiable=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        _maybe_seed(ctx, attrs), -2.0, 2.0, shape, dtype)
+    return {"Out": [out]}
+
+
+@register("randint", not_differentiable=True)
+def _randint(ctx, ins, attrs):
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = convert_dtype(attrs.get("dtype", "int64"))
+    out = jax.random.randint(_maybe_seed(ctx, attrs), shape,
+                             attrs.get("low", 0), attrs.get("high"), dtype)
+    return {"Out": [out]}
+
+
+@register("randperm", not_differentiable=True)
+def _randperm(ctx, ins, attrs):
+    n = int(attrs["n"])
+    dtype = convert_dtype(attrs.get("dtype", "int64"))
+    out = jax.random.permutation(_maybe_seed(ctx, attrs), n).astype(dtype)
+    return {"Out": [out]}
+
+
+@register("bernoulli", not_differentiable=True)
+def _bernoulli(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jax.random.bernoulli(_maybe_seed(ctx, attrs), x).astype(x.dtype)
+    return {"Out": [out]}
+
+
+@register("multinomial", not_differentiable=True)
+def _multinomial(ctx, ins, attrs):
+    x = ins["X"][0]
+    num = attrs.get("num_samples", 1)
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    out = jax.random.categorical(_maybe_seed(ctx, attrs), logits,
+                                 shape=(num,) + x.shape[:-1], axis=-1)
+    out = jnp.moveaxis(out, 0, -1)
+    return {"Out": [out.astype(jnp.int64)]}

@@ -1,0 +1,56 @@
+"""Reduction op lowerings (reference paddle/fluid/operators/reduce_ops/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _axes(attrs, x):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    if not dim:
+        return None
+    return tuple(d % x.ndim for d in dim)
+
+
+def _reduce(name, fn, differentiable=True):
+    kw = {} if differentiable else {"not_differentiable": True}
+
+    @register(name, **kw)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        keep = attrs.get("keep_dim", False)
+        return {"Out": [_fn(x, axis=_axes(attrs, x), keepdims=keep)]}
+    return _lower
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, differentiable=False)
+_reduce("reduce_any", jnp.any, differentiable=False)
+
+
+@register("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register("logsumexp")
+def _logsumexp(ctx, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    axis = attrs.get("axis", None)
+    keepdim = attrs.get("keepdim", False)
+    if attrs.get("reduce_all", False):
+        axis = None
+    elif axis is not None:
+        axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return {"Out": [jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)]}

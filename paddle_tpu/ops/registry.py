@@ -1,0 +1,301 @@
+"""Op lowering registry — kernel dispatch, the TPU way.
+
+Analog of the reference's static op registry + kernel choice
+(paddle/fluid/framework/op_registry.h:223-298, operator.cc:944-1068). Where
+the reference maps (op_type, place, dtype, layout) -> hand-written CUDA/CPU
+kernel function, we map op_type -> a *lowering*: a pure python function that
+emits jax/XLA operations. The same lowering serves:
+
+- the static-graph executor (called with tracers during jit trace), and
+- the dygraph engine (called eagerly with concrete jax.Arrays).
+
+Gradients: the reference registers a hand-written grad kernel per op plus a
+GradOpMaker that wires grad-op descs (op_registry.h REGISTER_OPERATOR's
+GradOpDescMaker slot). Here, grad ops are first-class op types named
+``<type>_grad``. If no custom ``<type>_grad`` lowering is registered, a
+generic one is derived from the forward lowering with ``jax.vjp`` —
+recomputation is free-ish under XLA fusion and is the idiomatic TPU
+trade (FLOPs for HBM). Custom grad lowerings are registered only where
+vjp is wrong (stateful masks, e.g. dropout) or wasteful.
+
+Grad *wiring* (which grad op to emit, reading/writing which names) uses a
+default maker based on slot-name conventions, overridable per op — the
+analog of GradOpDescMaker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Lowering context
+# ---------------------------------------------------------------------------
+
+
+class LoweringContext:
+    """Per-op execution context threaded through lowerings.
+
+    Carries the PRNG key (functional randomness — the TPU-native analog of
+    the reference's per-op curand states), mesh axis info for collectives,
+    and mode flags.
+    """
+
+    def __init__(self, rng: Optional[jax.Array] = None, eager: bool = False,
+                 mesh=None, axis_env: Optional[Dict[int, str]] = None,
+                 executor=None):
+        self._rng = rng
+        self.eager = eager
+        self.mesh = mesh
+        # ring_id -> mesh axis name mapping (reference: NCCL ring ids,
+        # platform/collective_helper.h:62 -> GSPMD mesh axes).
+        self.axis_env = axis_env or {}
+        self.executor = executor
+
+    def rng(self) -> jax.Array:
+        if self._rng is None:
+            # Eager mode without an explicit key: draw from a process-global
+            # counter so results vary call to call (like the reference's
+            # global generator).
+            global _EAGER_SEED
+            _EAGER_SEED += 1
+            return jax.random.PRNGKey(_EAGER_SEED)
+        return self._rng
+
+    def axis_name(self, ring_id: int) -> Optional[str]:
+        return self.axis_env.get(int(ring_id))
+
+
+_EAGER_SEED = 0
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+# Lowering signature: (ctx, ins, attrs) -> outs
+#   ins:  {slot: [jax.Array, ...]}
+#   outs: {slot: [jax.Array, ...]}
+Lowering = Callable[[LoweringContext, Dict[str, List[Any]], Dict[str, Any]],
+                    Dict[str, List[Any]]]
+
+# Grad maker signature:
+#   maker(op_desc, out_grad_names, wanted_input_slots) -> list of
+#   (type, inputs, outputs, attrs) tuples, where op_desc is the forward
+#   framework.Operator, out_grad_names maps output slot -> list of grad var
+#   names (None where no grad flows), and wanted_input_slots maps input
+#   slot -> list of target grad names (None where grad not needed).
+GradMaker = Callable[..., List[Tuple[str, dict, dict, dict]]]
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    lowering: Lowering
+    # Input slots that never receive gradients (indices, labels, masks...).
+    no_grad_slots: Tuple[str, ...] = ()
+    # Output slots that are non-differentiable (e.g. argmax Indices).
+    nondiff_outputs: Tuple[str, ...] = ()
+    # Forward input slots the default grad op does NOT need (saves memory
+    # when a custom grad lowering only reads e.g. the mask).
+    grad_drops_inputs: Tuple[str, ...] = ()
+    # Forward *output* slots the grad op additionally needs (e.g. dropout's
+    # Mask, relu's Out for custom grads).
+    grad_needs_outputs: Tuple[str, ...] = ()
+    # True if the op has no gradient at all.
+    not_differentiable: bool = False
+    custom_grad_maker: Optional[GradMaker] = None
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register(op_type: str, **kw):
+    """Decorator: register a lowering for ``op_type``."""
+    def deco(fn: Lowering) -> Lowering:
+        if op_type in OPS:
+            raise ValueError(f"op {op_type!r} already registered")
+        OPS[op_type] = OpDef(type=op_type, lowering=fn, **kw)
+        return fn
+    return deco
+
+
+def get_op_def(op_type: str) -> OpDef:
+    d = OPS.get(op_type)
+    if d is None:
+        raise NotImplementedError(
+            f"no lowering registered for op {op_type!r} "
+            f"({len(OPS)} ops registered)")
+    return d
+
+
+def is_registered(op_type: str) -> bool:
+    return op_type in OPS
+
+
+def registered_ops() -> List[str]:
+    return sorted(OPS.keys())
+
+
+# ---------------------------------------------------------------------------
+# Execution (shared by static trace + eager dygraph)
+# ---------------------------------------------------------------------------
+
+
+def execute(ctx: LoweringContext, op_type: str, ins: Dict[str, List[Any]],
+            attrs: Dict[str, Any]) -> Dict[str, List[Any]]:
+    """Run one op's lowering; falls back to vjp-derived grad lowerings."""
+    if op_type in OPS:
+        return OPS[op_type].lowering(ctx, ins, attrs)
+    if op_type.endswith("_grad") and op_type[:-5] in OPS:
+        return _generic_grad_lowering(ctx, op_type[:-5], ins, attrs)
+    raise NotImplementedError(f"no lowering for op {op_type!r}")
+
+
+GRAD_SLOT_SUFFIX = "@GRAD"
+
+
+def _generic_grad_lowering(ctx: LoweringContext, fw_type: str,
+                           ins: Dict[str, List[Any]],
+                           attrs: Dict[str, Any]) -> Dict[str, List[Any]]:
+    """Derive <op>_grad by jax.vjp over the forward lowering.
+
+    The grad op's inputs follow the reference's slot convention: forward
+    input slots carry forward values; ``<out_slot>@GRAD`` slots carry
+    incoming cotangents. Outputs are ``<in_slot>@GRAD``.
+    """
+    fw_def = OPS[fw_type]
+    fw_ins = {s: v for s, v in ins.items() if not s.endswith(GRAD_SLOT_SUFFIX)}
+    out_grads = {s[:-len(GRAD_SLOT_SUFFIX)]: list(v) for s, v in ins.items()
+                 if s.endswith(GRAD_SLOT_SUFFIX)}
+    # Re-expand partially-present grad lists to full positional alignment
+    # (make_grad_ops records which positions were dropped).
+    for slot, mask in attrs.get("__out_grad_present__", {}).items():
+        gs = iter(out_grads.get(slot, []))
+        out_grads[slot] = [next(gs) if m else None for m in mask]
+
+    # Split differentiable vs pass-through inputs. Only inexact (float)
+    # arrays can carry cotangents.
+    diff_ins, aux_ins = {}, {}
+    for slot, vals in fw_ins.items():
+        if slot in fw_def.no_grad_slots or not all(
+                jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact) for v in vals):
+            aux_ins[slot] = vals
+        else:
+            diff_ins[slot] = vals
+
+    def fwd(d_ins):
+        all_ins = dict(aux_ins)
+        all_ins.update(d_ins)
+        return fw_def.lowering(ctx, all_ins, attrs)
+
+    primal_out, vjp_fn = jax.vjp(fwd, diff_ins)
+
+    # Build cotangent pytree matching primal_out structure; zeros where no
+    # grad flows (non-differentiable or unused outputs). Integer/bool
+    # outputs take float0 cotangents per jax's vjp contract.
+    cot = {}
+    for slot, vals in primal_out.items():
+        gs = out_grads.get(slot)
+        cot[slot] = []
+        for i, v in enumerate(vals):
+            va = jnp.asarray(v)
+            if not jnp.issubdtype(va.dtype, jnp.inexact):
+                cot[slot].append(np.zeros(va.shape, jax.dtypes.float0))
+                continue
+            g = gs[i] if gs is not None and i < len(gs) and gs[i] is not None else None
+            if g is None:
+                g = jnp.zeros_like(va)
+            else:
+                g = jnp.asarray(g, dtype=va.dtype)
+            cot[slot].append(g)
+
+    (d_grads,) = vjp_fn(cot)
+    # Filter each slot's grads down to the wanted positions so the block
+    # runner's zip(names, vals) stays aligned with the grad op's outputs.
+    wanted_masks = attrs.get("__in_grad_wanted__", {})
+    out = {}
+    for slot, vals in d_grads.items():
+        mask = wanted_masks.get(slot)
+        if mask is not None:
+            vals = [v for v, m in zip(vals, mask) if m]
+        out[f"{slot}{GRAD_SLOT_SUFFIX}"] = vals
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Default grad-op maker (analog of DefaultGradOpDescMaker)
+# ---------------------------------------------------------------------------
+
+
+def make_grad_ops(op, out_grad_names: Dict[str, List[Optional[str]]],
+                  wanted_input_grads: Dict[str, List[Optional[str]]]
+                  ) -> List[Tuple[str, dict, dict, dict]]:
+    """Build grad-op descs for forward op ``op``.
+
+    Returns a list of (type, inputs, outputs, attrs). Uses the op's custom
+    maker when registered; otherwise the default convention:
+
+        type:    <fw_type>_grad
+        inputs:  all fw input slots (minus grad_drops_inputs)
+                 + fw outputs listed in grad_needs_outputs
+                 + <out_slot>@GRAD for each grad-carrying output
+        outputs: <in_slot>@GRAD for each wanted input grad
+    """
+    d = get_op_def(op.type)
+    if d.not_differentiable:
+        return []
+    if d.custom_grad_maker is not None:
+        return d.custom_grad_maker(op, out_grad_names, wanted_input_grads)
+
+    g_inputs: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        if slot in d.grad_drops_inputs:
+            continue
+        g_inputs[slot] = list(names)
+    for slot in d.grad_needs_outputs:
+        if slot in op.outputs:
+            g_inputs[slot] = list(op.outputs[slot])
+    g_attrs = dict(op.attrs)
+    has_incoming = False
+    out_present: Dict[str, List[bool]] = {}
+    for slot, gnames in out_grad_names.items():
+        if any(g is not None for g in gnames):
+            has_incoming = True
+            g_inputs[f"{slot}{GRAD_SLOT_SUFFIX}"] = [
+                g for g in gnames if g is not None]
+            if any(g is None for g in gnames):
+                out_present[slot] = [g is not None for g in gnames]
+    if not has_incoming:
+        return []
+    if out_present:
+        g_attrs["__out_grad_present__"] = out_present
+
+    g_outputs: Dict[str, List[str]] = {}
+    in_wanted: Dict[str, List[bool]] = {}
+    for slot, gnames in wanted_input_grads.items():
+        if slot in d.no_grad_slots:
+            continue
+        targets = [g for g in gnames if g is not None]
+        if targets:
+            g_outputs[f"{slot}{GRAD_SLOT_SUFFIX}"] = targets
+            if any(g is None for g in gnames):
+                in_wanted[slot] = [g is not None for g in gnames]
+    if not g_outputs:
+        return []
+    if in_wanted:
+        g_attrs["__in_grad_wanted__"] = in_wanted
+    return [(f"{op.type}_grad", g_inputs, g_outputs, g_attrs)]
+
+
+def as_array(x) -> jax.Array:
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
+def np_dtype(name: str):
+    import jax.numpy as jnp  # local: bfloat16 comes from ml_dtypes via jnp
+    return jnp.dtype(name)

@@ -1,0 +1,515 @@
+"""Tensor manipulation / creation op lowerings.
+
+Analogs of reference operators: reshape_op, transpose_op, concat_op,
+split_op, slice_op, stack_op, squeeze/unsqueeze, expand_v2, gather,
+fill_constant, assign... (paddle/fluid/operators/*.cc top level).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.program import convert_dtype
+from .registry import register
+
+
+@register("fill_constant", not_differentiable=True)
+def _fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    if ins.get("ShapeTensor"):
+        raise NotImplementedError(
+            "dynamic ShapeTensor is not XLA-compatible; use static shape attr")
+    return {"Out": [jnp.full(tuple(int(d) for d in shape), value, dtype=dtype)]}
+
+
+@register("fill_constant_like", not_differentiable=True)
+def _fill_constant_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    dtype = attrs.get("dtype")
+    dtype = x.dtype if dtype is None else convert_dtype(dtype)
+    return {"Out": [jnp.full(x.shape, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register("fill_any_like", not_differentiable=True)
+def _fill_any_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    dtype = attrs.get("dtype")
+    dtype = x.dtype if dtype in (None, -1) else convert_dtype(dtype)
+    return {"Out": [jnp.full(x.shape, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register("fill_zeros_like", not_differentiable=True)
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("assign_value", not_differentiable=True)
+def _assign_value(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    values = np.asarray(attrs["values"], dtype=dtype)
+    return {"Out": [jnp.asarray(values.reshape(attrs["shape"]))]}
+
+
+@register("shape", not_differentiable=True)
+def _shape(ctx, ins, attrs):
+    # Static under XLA: shapes are trace-time constants.
+    return {"Out": [jnp.asarray(np.asarray(ins["Input"][0].shape, np.int64))]}
+
+
+@register("size", not_differentiable=True)
+def _size(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(int(np.prod(ins["Input"][0].shape)), jnp.int64)]}
+
+
+def _infer_reshape(x, shape):
+    shape = [int(s) for s in shape]
+    out = []
+    neg = -1
+    for i, s in enumerate(shape):
+        if s == -1:
+            neg = i
+            out.append(1)
+        elif s == 0:  # paddle: 0 = copy input dim
+            out.append(x.shape[i])
+        else:
+            out.append(s)
+    if neg >= 0:
+        known = int(np.prod(out))
+        out[neg] = int(np.prod(x.shape)) // known
+    return tuple(out)
+
+
+@register("reshape2", grad_needs_outputs=("XShape",), grad_drops_inputs=("X",))
+def _reshape2(ctx, ins, attrs):
+    x = ins["X"][0]
+    if ins.get("Shape") or ins.get("ShapeTensor"):
+        raise NotImplementedError("tensor-valued reshape shape is not static")
+    out = x.reshape(_infer_reshape(x, attrs["shape"]))
+    return {"Out": [out],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("reshape2_grad")
+def _reshape2_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    xshape = ins["XShape"][0].shape[1:]
+    return {"X@GRAD": [g.reshape(xshape)]}
+
+
+@register("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_infer_reshape(x, attrs["shape"]))]}
+
+
+@register("transpose2", grad_drops_inputs=("X",))
+def _transpose2(ctx, ins, attrs):
+    x = ins["X"][0]
+    perm = attrs["axis"]
+    return {"Out": [jnp.transpose(x, perm)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("transpose2_grad")
+def _transpose2_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    perm = attrs["axis"]
+    inv = np.argsort(perm)
+    return {"X@GRAD": [jnp.transpose(g, inv)]}
+
+
+@register("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register("flatten_contiguous_range", grad_needs_outputs=("XShape",), grad_drops_inputs=("X",))
+def _flatten_contiguous_range(ctx, ins, attrs):
+    x = ins["X"][0]
+    start = attrs.get("start_axis", 1)
+    stop = attrs.get("stop_axis", -1)
+    if stop < 0:
+        stop += x.ndim
+    shape = x.shape[:start] + (int(np.prod(x.shape[start:stop + 1])),) + x.shape[stop + 1:]
+    return {"Out": [x.reshape(shape)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("flatten_contiguous_range_grad")
+def _flatten_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    xshape = ins["XShape"][0].shape[1:]
+    return {"X@GRAD": [g.reshape(xshape)]}
+
+
+@register("flatten2")
+def _flatten2(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = attrs.get("axis", 1)
+    shape = (int(np.prod(x.shape[:ax])), int(np.prod(x.shape[ax:])))
+    return {"Out": [x.reshape(shape)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.concatenate(ins["X"], axis=axis)]}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1])
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    decrease_axis = attrs.get("decrease_axis", [])
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        idx[ax] = slice(int(st), int(en))
+    out = x[tuple(idx)]
+    if decrease_axis:
+        out = out.reshape([d for i, d in enumerate(out.shape)
+                           if i not in set(decrease_axis)])
+    return {"Out": [out]}
+
+
+@register("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                              attrs["strides"]):
+        idx[ax] = slice(int(st), int(en), int(sd))
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = x.shape[axis]
+    outs = [jnp.squeeze(s, axis=axis) for s in jnp.split(x, num, axis=axis)]
+    return {"Y": outs}
+
+
+@register("unbind")
+def _unbind(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    outs = [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, x.shape[axis], axis=axis)]
+    return {"Out": outs}
+
+
+@register("squeeze2", grad_needs_outputs=("XShape",), grad_drops_inputs=("X",))
+def _squeeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes)
+        shape = [d for i, d in enumerate(x.shape)
+                 if not (i in axes and d == 1)]
+    else:
+        shape = [d for d in x.shape if d != 1]
+    return {"Out": [x.reshape(shape)],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("squeeze2_grad")
+def _squeeze2_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    xshape = ins["XShape"][0].shape[1:]
+    return {"X@GRAD": [g.reshape(xshape)]}
+
+
+@register("unsqueeze2", grad_needs_outputs=("XShape",), grad_drops_inputs=("X",))
+def _unsqueeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = sorted(a % (x.ndim + len(attrs["axes"])) for a in attrs["axes"])
+    out = x
+    for a in axes:
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out],
+            "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("unsqueeze2_grad")
+def _unsqueeze2_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    xshape = ins["XShape"][0].shape[1:]
+    return {"X@GRAD": [g.reshape(xshape)]}
+
+
+@register("expand_v2")
+def _expand_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    # -1 means keep input dim
+    xshape = (1,) * (len(shape) - x.ndim) + x.shape
+    tgt = tuple(xs if s == -1 else s for s, xs in zip(shape, xshape))
+    return {"Out": [jnp.broadcast_to(x.reshape(xshape), tgt)]}
+
+
+@register("expand_as_v2")
+def _expand_as_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    tgt = attrs.get("target_shape")
+    if tgt is None:
+        tgt = ins["Y"][0].shape
+    return {"Out": [jnp.broadcast_to(x, tuple(tgt))]}
+
+
+@register("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], attrs["repeat_times"])]}
+
+
+@register("gather", no_grad_slots=("Index",))
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.take(x, idx, axis=axis)]}
+
+
+@register("gather_nd", no_grad_slots=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": [x[flat_idx]]}
+
+
+@register("scatter", no_grad_slots=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    overwrite = attrs.get("overwrite", True)
+    if overwrite:
+        return {"Out": [x.at[ids].set(updates)]}
+    return {"Out": [x.at[ids].add(updates)]}
+
+
+@register("index_select", no_grad_slots=("Index",))
+def _index_select(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx, axis=attrs.get("dim", 0))]}
+
+
+@register("where")
+def _where(ctx, ins, attrs):
+    cond, x, y = ins["Condition"][0], ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.where(cond, x, y)]}
+
+
+@register("where_index", not_differentiable=True)
+def _where_index(ctx, ins, attrs):
+    raise NotImplementedError(
+        "where_index (nonzero) has data-dependent output shape — not "
+        "XLA-compatible; use masked ops instead")
+
+
+@register("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    paddings = attrs["paddings"]
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, cfg, constant_values=value)]}
+
+
+@register("pad3d")
+def _pad3d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCDHW
+    p = attrs["paddings"]  # [l, r, top, bottom, front, back]
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("value", 0.0)
+    cfg = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, cfg, constant_values=value)]}
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return {"Out": [jnp.pad(x, cfg, mode=jmode)]}
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    p = attrs["paddings"]  # [top, bottom, l, r]
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, cfg, constant_values=value)]}
+    jmode = {"reflect": "reflect", "edge": "edge", "circular": "wrap"}[mode]
+    return {"Out": [jnp.pad(x, cfg, mode=jmode)]}
+
+
+@register("tril_triu")
+def _tril_triu(ctx, ins, attrs):
+    x = ins["X"][0]
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": [jnp.tril(x, diag)]}
+    return {"Out": [jnp.triu(x, diag)]}
+
+
+@register("range", not_differentiable=True)
+def _range(ctx, ins, attrs):
+    start = attrs.get("start")
+    end = attrs.get("end")
+    step = attrs.get("step", 1)
+    if start is None and ins.get("Start"):
+        raise NotImplementedError("tensor-valued range bounds are not static")
+    dtype = convert_dtype(attrs.get("dtype", "int64"))
+    return {"Out": [jnp.arange(start, end, step, dtype=dtype)]}
+
+
+@register("arg_max", not_differentiable=True)
+def _arg_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    keepdims = attrs.get("keepdims", False)
+    out = jnp.argmax(x, axis=axis).astype(
+        convert_dtype(attrs.get("dtype", "int64")))
+    if keepdims:
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out]}
+
+
+@register("arg_min", not_differentiable=True)
+def _arg_min(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    keepdims = attrs.get("keepdims", False)
+    out = jnp.argmin(x, axis=axis).astype(
+        convert_dtype(attrs.get("dtype", "int64")))
+    if keepdims:
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out]}
+
+
+@register("argsort", nondiff_outputs=("Indices",))
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("top_k_v2", nondiff_outputs=("Indices",), no_grad_slots=())
+def _top_k_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", True)
+    if axis % x.ndim != x.ndim - 1:
+        x_m = jnp.moveaxis(x, axis, -1)
+    else:
+        x_m = x
+    vals, idx = jax.lax.top_k(x_m if largest else -x_m, k)
+    if not largest:
+        vals = -vals
+    if axis % x.ndim != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("top_k", nondiff_outputs=("Indices",))
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("one_hot_v2", not_differentiable=True)
+def _one_hot_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    if x.ndim > 0 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register("eye", not_differentiable=True)
+def _eye(ctx, ins, attrs):
+    n = attrs["num_rows"]
+    m = attrs.get("num_columns", n)
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.eye(int(n), int(m) if m > 0 else int(n), dtype=dtype)]}
+
+
+@register("linspace", not_differentiable=True)
+def _linspace(ctx, ins, attrs):
+    start = ins["Start"][0] if ins.get("Start") else attrs["start"]
+    stop = ins["Stop"][0] if ins.get("Stop") else attrs["stop"]
+    num = attrs.get("num") or int(ins["Num"][0])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.linspace(start, stop, int(num), dtype=dtype)]}
+
+
+@register("flip")
+def _flip(ctx, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0], attrs["axis"])]}
+
+
+@register("roll")
+def _roll(ctx, ins, attrs):
+    axis = attrs.get("axis", None)
+    return {"Out": [jnp.roll(ins["X"][0], attrs["shifts"],
+                             axis=tuple(axis) if axis else None)]}
+
+
+@register("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}

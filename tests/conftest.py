@@ -1,0 +1,22 @@
+"""Test harness config: run on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding logic is
+validated on XLA:CPU with 8 virtual devices (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the axon sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon, so plain env vars are too late here — we must go
+through jax.config.update before any backend is touched.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# float32 matmuls at full precision for numerical test parity
+jax.config.update("jax_default_matmul_precision", "highest")

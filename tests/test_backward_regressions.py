@@ -1,0 +1,126 @@
+"""Regression tests for autodiff/executor edge cases found in review."""
+
+import numpy as np
+
+from paddle_tpu.framework import (Executor, Program, Scope, append_backward,
+                                  gradients)
+
+
+def _scope_with(**kw):
+    import jax.numpy as jnp
+    s = Scope()
+    for k, v in kw.items():
+        s.set_var(k, jnp.asarray(v))
+    return s
+
+
+def test_partial_grad_multi_output_split():
+    """Only one of split's outputs feeds the loss — positional alignment."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_parameter("w", shape=[6])
+    for n in ("o1", "o2", "o3"):
+        blk.create_var(n)
+    blk.append_op("split", {"X": "w"}, {"Out": ["o1", "o2", "o3"]}, {"num": 3})
+    blk.create_var("loss")
+    # loss depends only on the MIDDLE output
+    blk.append_op("reduce_sum", {"X": "o2"}, {"Out": "loss"},
+                  {"reduce_all": True})
+    pg = append_backward(blk.var("loss"))
+    scope = _scope_with(w=np.arange(6, dtype=np.float32))
+    exe = Executor()
+    (gw,) = exe.run(prog, fetch_list=[pg[0][1].name], scope=scope)
+    np.testing.assert_allclose(gw, [0, 0, 1, 1, 0, 0])
+
+
+def test_partial_grad_multi_input_concat():
+    """concat where only one input needs grad."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("c", shape=[2], is_data=True, stop_gradient=True)
+    blk.create_parameter("w", shape=[3])
+    blk.create_var("cat")
+    blk.append_op("concat", {"X": ["c", "w"]}, {"Out": "cat"}, {"axis": 0})
+    blk.create_var("idx")
+    blk.create_var("loss")
+    blk.append_op("reduce_sum", {"X": "cat"}, {"Out": "loss"},
+                  {"reduce_all": True})
+    pg = append_backward(blk.var("loss"))
+    scope = _scope_with(w=np.ones(3, np.float32))
+    exe = Executor()
+    (gw,) = exe.run(prog, feed={"c": np.zeros(2, np.float32)},
+                    fetch_list=[pg[0][1].name], scope=scope)
+    assert gw.shape == (3,)
+    np.testing.assert_allclose(gw, np.ones(3))
+
+
+def test_program_mutation_invalidates_cache():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    exe = Executor()
+    x = np.ones(3, np.float32)
+    (y,) = exe.run(prog, feed={"x": x}, fetch_list=["y"], scope=Scope())
+    np.testing.assert_allclose(y, 2.0 * x)
+    # mutate the program after a run — must recompile
+    blk.append_op("scale", {"X": "y"}, {"Out": "z"}, {"scale": 5.0})
+    blk.create_var("z")
+    (z,) = exe.run(prog, feed={"x": x}, fetch_list=["z"], scope=Scope())
+    np.testing.assert_allclose(z, 10.0 * x)
+
+
+def test_scope_population_invalidates_cache():
+    """Running before the scope is populated must not poison the cache."""
+    import jax.numpy as jnp
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_parameter("w", shape=[3])
+    blk.create_var("o")
+    blk.append_op("elementwise_mul", {"X": "x", "Y": "w"}, {"Out": "o"})
+    exe = Executor()
+    scope = Scope()
+    x = np.ones(3, np.float32)
+    try:
+        exe.run(prog, feed={"x": x}, fetch_list=["o"], scope=scope)
+        raised = False
+    except KeyError:
+        raised = True
+    assert raised
+    scope.set_var("w", jnp.asarray(np.arange(3, dtype=np.float32)))
+    (o,) = exe.run(prog, feed={"x": x}, fetch_list=["o"], scope=scope)
+    np.testing.assert_allclose(o, [0, 1, 2])
+
+
+def test_gradients_api_accumulates():
+    """gradients() returns the SUM over multiple consumers."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", shape=[3], is_data=True)
+    blk.vars["x"].stop_gradient = False
+    blk.create_var("a")
+    blk.append_op("scale", {"X": "x"}, {"Out": "a"}, {"scale": 2.0})
+    blk.create_var("b")
+    blk.append_op("scale", {"X": "x"}, {"Out": "b"}, {"scale": 3.0})
+    blk.create_var("s")
+    blk.append_op("elementwise_add", {"X": "a", "Y": "b"}, {"Out": "s"})
+    blk.create_var("loss")
+    blk.append_op("reduce_sum", {"X": "s"}, {"Out": "loss"},
+                  {"reduce_all": True})
+    (gx,) = gradients(blk.var("loss"), blk.var("x"))
+    assert gx is not None
+    exe = Executor()
+    (g,) = exe.run(prog, feed={"x": np.ones(3, np.float32)},
+                   fetch_list=[gx.name], scope=Scope())
+    np.testing.assert_allclose(g, 5.0 * np.ones(3))
+
+
+def test_cumsum_exclusive_reverse():
+    from paddle_tpu.ops import execute, LoweringContext
+    import jax.numpy as jnp
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    out = execute(LoweringContext(eager=True), "cumsum", {"X": [x]},
+                  {"axis": 0, "exclusive": True, "reverse": True})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), [5.0, 3.0, 0.0])
