@@ -14,3 +14,8 @@ from . import framework
 from .framework import (Program, Executor, Scope, global_scope,
                         default_main_program, default_startup_program,
                         program_guard, append_backward)
+from . import initializer
+from . import layers
+from . import optimizer
+from . import optimizer_lr
+from .param_attr import ParamAttr

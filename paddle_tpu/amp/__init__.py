@@ -1,0 +1,8 @@
+"""AMP — automatic mixed precision (bf16-first on TPU).
+
+Dygraph: auto_cast/GradScaler. Static: rewrite_program pass (static_amp).
+"""
+
+from .auto_cast import amp_guard, auto_cast, maybe_autocast_inputs
+from .grad_scaler import AmpScaler, GradScaler
+from .lists import BLACK_LIST, WHITE_LIST, AutoMixedPrecisionLists

@@ -1,0 +1,79 @@
+"""Dygraph AMP autocast.
+
+Analog of paddle/fluid/imperative/amp_auto_cast.cc (AutoCastInputs) +
+python dygraph/amp/auto_cast.py (amp_guard). Under ``auto_cast`` (O1),
+white-list ops cast float32 inputs to bf16 before execution; black-list
+ops cast low-precision inputs back to float32. O2 casts everything except
+black-list ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from .lists import BLACK_LIST, WHITE_LIST
+
+def maybe_autocast_inputs(op_type: str, ins: Dict[str, List], amp_dtype: str,
+                          level: str):
+    """Cast inputs per white/black list. Uses the cast op so gradients
+    flow through the cast (straight-through in matching dtype)."""
+    from ..dygraph.tape import default_tracer
+
+    def cast_all(target):
+        from ..dygraph.tensor import Tensor
+        tracer = default_tracer()
+        out = {}
+        for slot, ts in ins.items():
+            new = []
+            for t in ts:
+                if jnp.issubdtype(t.value.dtype, jnp.floating) and \
+                        str(t.value.dtype) != target:
+                    prev = tracer._amp_level
+                    tracer._amp_level = "O0"  # avoid recursion
+                    try:
+                        nt = tracer.trace_op(
+                            "cast", {"X": [t]},
+                            {"out_dtype": target,
+                             "in_dtype": str(t.value.dtype)})["Out"][0]
+                    finally:
+                        tracer._amp_level = prev
+                    new.append(nt)
+                else:
+                    new.append(t)
+            out[slot] = new
+        return out
+
+    if level == "O1":
+        if op_type in WHITE_LIST:
+            return cast_all(amp_dtype)
+        if op_type in BLACK_LIST:
+            return cast_all("float32")
+        return ins
+    if level == "O2":
+        if op_type in BLACK_LIST:
+            return cast_all("float32")
+        return cast_all(amp_dtype)
+    return ins
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16"):
+    """amp_guard analog (dygraph/amp/auto_cast.py:90)."""
+    from ..dygraph.tape import default_tracer
+    tracer = default_tracer()
+    prev_level, prev_dtype = tracer._amp_level, tracer._amp_dtype
+    tracer._amp_level = level if enable else "O0"
+    tracer._amp_dtype = dtype
+    try:
+        yield
+    finally:
+        tracer._amp_level = prev_level
+        tracer._amp_dtype = prev_dtype
+
+
+amp_guard = auto_cast

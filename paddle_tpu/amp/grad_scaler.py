@@ -1,0 +1,101 @@
+"""Loss scaling for AMP.
+
+Analog of python/paddle/fluid/dygraph/amp/loss_scaler.py (AmpScaler) and
+the static check_finite_and_unscale flow. bf16 training on TPU rarely
+needs loss scaling (same exponent range as f32), but the capability is
+kept for parity and for f16 experiments.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.**15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer_or_params):
+        """Unscale grads in place; detect non-finite values."""
+        if not self._enable:
+            return
+        params = (optimizer_or_params
+                  if isinstance(optimizer_or_params, (list, tuple))
+                  else optimizer_or_params._parameter_list or [])
+        found = False
+        from ..dygraph.tensor import Tensor
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad.value / self._scale
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found = True
+            p.grad = Tensor(g, stop_gradient=True)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        """minimize-style step honoring found_inf."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good_steps = d["good_steps"]
+        self._bad_steps = d["bad_steps"]
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,225 @@
+"""Dygraph tracer + autograd engine.
+
+Analog of paddle/fluid/imperative/tracer.cc:48 (TraceOp) and
+basic_engine.cc:161 (BasicEngine::Execute). Every eager op dispatches
+through run_op: execute the lowering on concrete jax.Arrays and — when any
+input requires grad — record a grad node. Grad nodes form a GRAPH owned by
+the output tensors (Tensor._grad_node), not a global tape, so forwards
+whose outputs are dropped (eval loops, metrics) free their activations via
+normal GC — the analog of the reference's refcounted autograd graph.
+
+``backward`` walks the graph from the loss in reverse execution order,
+wiring grad ops with the SAME make_grad_ops convention as static
+append_backward, accumulating multi-consumer grads by summation
+(GradientAccumulator analog).
+
+Because every op is a jnp call, an entire dygraph train step can also be
+traced by jax.jit via the jit module (dygraph-to-static) — the
+performance path on TPU, where per-op eager dispatch is slow.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..ops import registry as _reg
+from .tensor import Parameter, Tensor
+
+_node_counter = itertools.count()
+
+
+class _OpStub:
+    """Shaped like framework.Operator for make_grad_ops (name-based)."""
+
+    __slots__ = ("type", "inputs", "outputs", "attrs")
+
+    def __init__(self, type, inputs, outputs, attrs):  # noqa: A002
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+
+
+class GradNode:
+    """One recorded op in the autograd graph (OpBase/GradOpNode analog)."""
+
+    __slots__ = ("id", "stub", "env", "in_tensors", "parents")
+
+    def __init__(self, stub, env, in_tensors):
+        self.id = next(_node_counter)      # execution order
+        self.stub = stub
+        self.env = env                     # name -> jax array (fw values)
+        self.in_tensors = in_tensors       # name -> Tensor
+        # parent nodes = creators of our inputs (kept alive through here)
+        self.parents = [t._grad_node for t in in_tensors.values()
+                        if getattr(t, "_grad_node", None) is not None]
+
+
+class Tracer:
+    def __init__(self):
+        self.enabled = True         # False under no_grad
+        self._amp_level = "O0"
+        self._amp_dtype = "bfloat16"
+
+    # -- op execution ------------------------------------------------------
+    def trace_op(self, op_type: str, ins: Dict[str, List[Tensor]],
+                 attrs: Dict) -> Dict[str, List[Tensor]]:
+        d = _reg.OPS.get(op_type)
+        if self._amp_level in ("O1", "O2"):
+            from ..amp.auto_cast import maybe_autocast_inputs
+            ins = maybe_autocast_inputs(op_type, ins, self._amp_dtype,
+                                        self._amp_level)
+        ctx = _reg.LoweringContext(eager=True)
+        arr_ins = {s: [t.value for t in ts] for s, ts in ins.items()}
+        arr_outs = _reg.execute(ctx, op_type, arr_ins, attrs)
+
+        out_tensors = {s: [Tensor(a, stop_gradient=True) for a in vals]
+                       for s, vals in arr_outs.items()}
+
+        needs_grad = self.enabled and any(
+            not t.stop_gradient for ts in ins.values() for t in ts)
+        differentiable = d is None or not d.not_differentiable
+        if needs_grad and differentiable:
+            in_names = {s: [t.name for t in ts] for s, ts in ins.items()}
+            out_names = {s: [t.name for t in ts]
+                         for s, ts in out_tensors.items()}
+            stub = _OpStub(op_type, in_names, out_names, dict(attrs))
+            env, in_tensors = {}, {}
+            for s, ts in ins.items():
+                for t in ts:
+                    env[t.name] = t.value
+                    in_tensors[t.name] = t
+            for s, ts in out_tensors.items():
+                for t in ts:
+                    env[t.name] = t.value
+            node = GradNode(stub, env, in_tensors)
+            nondiff = set(d.nondiff_outputs) if d else set()
+            for slot, ts in out_tensors.items():
+                if slot in nondiff:
+                    continue
+                for t in ts:
+                    t.stop_gradient = False
+                    t.is_leaf = False
+                    t._grad_node = node
+        return out_tensors
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, loss: Tensor, grad_tensor: Optional[Tensor] = None,
+                 retain_graph: bool = False):
+        root = getattr(loss, "_grad_node", None)
+        if root is None:
+            return
+        # collect reachable nodes; node.id gives execution order
+        nodes: Dict[int, GradNode] = {}
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            if n.id in nodes:
+                continue
+            nodes[n.id] = n
+            stack.extend(n.parents)
+        ordered = sorted(nodes.values(), key=lambda n: n.id, reverse=True)
+
+        grads: Dict[str, object] = {}
+        grads[loss.name] = (grad_tensor.value if grad_tensor is not None
+                            else jnp.ones_like(loss.value))
+        ctx = _reg.LoweringContext(eager=True)
+        leaf_grads: Dict[str, tuple] = {}
+        for node in ordered:
+            stub = node.stub
+            out_grad_names: Dict[str, List[Optional[str]]] = {}
+            any_g = False
+            for slot, names in stub.outputs.items():
+                gs = []
+                for n in names:
+                    if n in grads:
+                        gs.append(n + "@G")
+                        any_g = True
+                    else:
+                        gs.append(None)
+                out_grad_names[slot] = gs
+            if not any_g:
+                continue
+            wanted: Dict[str, List[Optional[str]]] = {}
+            tcount: Dict[str, int] = {}
+            for slot, names in stub.inputs.items():
+                ts = []
+                for n in names:
+                    t = node.in_tensors[n]
+                    if not t.stop_gradient:
+                        k = tcount.get(n, 0)
+                        tcount[n] = k + 1
+                        ts.append(f"{n}@G@{k}")
+                    else:
+                        ts.append(None)
+                wanted[slot] = ts
+            descs = _reg.make_grad_ops(stub, out_grad_names, wanted)
+            if not descs:
+                continue
+            env = dict(node.env)
+            for slot, names in stub.outputs.items():
+                for n in names:
+                    if n in grads:
+                        env[n + "@G"] = grads[n]
+            for (g_type, g_in, g_out, g_attrs) in descs:
+                arr_ins = {s: [env[n] for n in names]
+                           for s, names in g_in.items()}
+                arr_outs = _reg.execute(ctx, g_type, arr_ins, g_attrs)
+                for slot, names in g_out.items():
+                    vals = arr_outs.get(slot, [])
+                    for n, v in zip(names, vals):
+                        env[n] = v
+            for slot, names in stub.inputs.items():
+                for n, tgt in zip(names, wanted[slot]):
+                    if tgt is None or tgt not in env:
+                        continue
+                    g = env[tgt]
+                    grads[n] = grads[n] + g if n in grads else g
+                    t = node.in_tensors[n]
+                    if t.is_leaf:
+                        leaf_grads[n] = (t, grads[n])
+        for n, (t, g) in leaf_grads.items():
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad.value + g, stop_gradient=True)
+        if not retain_graph:
+            # drop the graph rooted at loss so activations free promptly
+            for node in ordered:
+                node.parents = []
+                node.env = {}
+            loss._grad_node = None
+
+
+_tracer = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _tracer
+
+
+def run_op(op_type: str, ins: Dict[str, List[Tensor]], attrs: Dict
+           ) -> Dict[str, List[Tensor]]:
+    return _tracer.trace_op(op_type, ins, attrs)
+
+
+class no_grad:
+    """Context manager/decorator disabling grad recording."""
+
+    def __enter__(self):
+        self._prev = _tracer.enabled
+        _tracer.enabled = False
+        return self
+
+    def __exit__(self, *a):
+        _tracer.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+        return wrapper

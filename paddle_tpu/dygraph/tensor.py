@@ -1,0 +1,272 @@
+"""Eager Tensor — the dygraph VarBase analog.
+
+Reference: paddle/fluid/imperative/ (VarBase/VariableWrapper, layer.h) and
+the generated python Tensor surface. Wraps a jax.Array; ops dispatch
+eagerly through the SAME lowering registry as the static executor
+(imperative/tracer.cc:48 TraceOp -> here dygraph.tape.run_op), recording a
+tape for autograd when grad is required.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.program import convert_dtype
+
+_uid_counter = [0]
+
+
+def _next_uid() -> str:
+    _uid_counter[0] += 1
+    return f"t{_uid_counter[0]}"
+
+
+class Tensor:
+    """Eager tensor. ``stop_gradient=True`` (default for raw data) excludes
+    it from autograd, mirroring the reference's VarBase semantics."""
+
+    def __init__(self, value, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value.value
+        arr = jnp.asarray(value, dtype=convert_dtype(dtype) if dtype else None)
+        self.value = arr
+        self.stop_gradient = stop_gradient
+        self.name = name or _next_uid()
+        self.grad: Optional[Tensor] = None
+        self.is_leaf = True
+        self.persistable = False
+        self._grad_node = None  # creator GradNode (autograd graph edge)
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.value.shape)) if self.value.shape else 1
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def item(self):
+        return self.numpy().item()
+
+    def numel(self):
+        return self.size
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self.value, stop_gradient=True)
+        return t
+
+    def clone(self) -> "Tensor":
+        from .tape import run_op
+        return run_op("assign", {"X": [self]}, {})["Out"][0]
+
+    def astype(self, dtype) -> "Tensor":
+        from .tape import run_op
+        return run_op("cast", {"X": [self]},
+                      {"out_dtype": convert_dtype(dtype),
+                       "in_dtype": self.dtype})["Out"][0]
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value.value
+        self.value = jnp.asarray(value, dtype=self.value.dtype)
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        from .tape import default_tracer
+        default_tracer().backward(self, grad_tensor, retain_graph)
+
+    # -- operators ---------------------------------------------------------
+    def _binop(self, other, op_type, reverse=False):
+        from .tape import run_op
+        if not isinstance(other, Tensor):
+            other = Tensor(jnp.asarray(other, self.value.dtype))
+        x, y = (other, self) if reverse else (self, other)
+        return run_op(op_type, {"X": [x], "Y": [y]}, {})["Out"][0]
+
+    def __add__(self, o):
+        return self._binop(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "elementwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "elementwise_pow")
+
+    def __matmul__(self, o):
+        from .tape import run_op
+        return run_op("matmul_v2", {"X": [self], "Y": [o]}, {})["Out"][0]
+
+    def __neg__(self):
+        from .tape import run_op
+        return run_op("scale", {"X": [self]}, {"scale": -1.0})["Out"][0]
+
+    def __lt__(self, o):
+        return self._binop(o, "less_than")
+
+    def __le__(self, o):
+        return self._binop(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binop(o, "greater_equal")
+
+    def __getitem__(self, idx):
+        # basic slicing via jax; no tape (detached view) unless needed —
+        # route through slice op for grad support on plain slices
+        from .tape import run_op
+        if isinstance(idx, (int, slice)) or (
+                isinstance(idx, tuple)
+                and all(isinstance(i, (int, slice)) for i in idx)):
+            idxs = idx if isinstance(idx, tuple) else (idx,)
+            axes, starts, ends, decrease = [], [], [], []
+            ok = True
+            for ax, i in enumerate(idxs):
+                if isinstance(i, int):
+                    d = self.value.shape[ax]
+                    ii = i + d if i < 0 else i
+                    axes.append(ax)
+                    starts.append(ii)
+                    ends.append(ii + 1)
+                    decrease.append(ax)
+                elif isinstance(i, slice):
+                    if i.step not in (None, 1):
+                        ok = False
+                        break
+                    if i.start is None and i.stop is None:
+                        continue
+                    d = self.value.shape[ax]
+                    axes.append(ax)
+                    starts.append(0 if i.start is None else i.start)
+                    ends.append(d if i.stop is None else i.stop)
+            if ok:
+                return run_op("slice", {"X": [self]},
+                              {"axes": axes, "starts": starts, "ends": ends,
+                               "decrease_axis": decrease})["Out"][0]
+        # fallback: advanced indexing, no autograd through it
+        return Tensor(self.value[idx], stop_gradient=True)
+
+    # -- common methods ----------------------------------------------------
+    def reshape(self, shape):
+        from .tape import run_op
+        return run_op("reshape2", {"X": [self]},
+                      {"shape": list(shape)})["Out"][0]
+
+    def transpose(self, perm):
+        from .tape import run_op
+        return run_op("transpose2", {"X": [self]},
+                      {"axis": list(perm)})["Out"][0]
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        from .tape import run_op
+        return run_op("flatten_contiguous_range", {"X": [self]},
+                      {"start_axis": start_axis,
+                       "stop_axis": stop_axis})["Out"][0]
+
+    def sum(self, axis=None, keepdim=False):
+        from .tape import run_op
+        attrs = {"keep_dim": keepdim}
+        if axis is None:
+            attrs["reduce_all"] = True
+        else:
+            attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+        return run_op("reduce_sum", {"X": [self]}, attrs)["Out"][0]
+
+    def mean(self, axis=None, keepdim=False):
+        from .tape import run_op
+        attrs = {"keep_dim": keepdim}
+        if axis is None:
+            attrs["reduce_all"] = True
+        else:
+            attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+        return run_op("reduce_mean", {"X": [self]}, attrs)["Out"][0]
+
+    def max(self, axis=None, keepdim=False):
+        from .tape import run_op
+        attrs = {"keep_dim": keepdim}
+        if axis is None:
+            attrs["reduce_all"] = True
+        else:
+            attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+        return run_op("reduce_max", {"X": [self]}, attrs)["Out"][0]
+
+    def unsqueeze(self, axis):
+        from .tape import run_op
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        return run_op("unsqueeze2", {"X": [self]}, {"axes": axes})["Out"][0]
+
+    def squeeze(self, axis=None):
+        from .tape import run_op
+        axes = [] if axis is None else (
+            [axis] if isinstance(axis, int) else list(axis))
+        return run_op("squeeze2", {"X": [self]}, {"axes": axes})["Out"][0]
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def __len__(self):
+        return self.value.shape[0] if self.value.ndim else 0
+
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_txt},\n"
+                f"       {np.asarray(self.value)!r})")
+
+
+class Parameter(Tensor):
+    """Trainable leaf tensor (analog of framework Parameter/VarBase param)."""
+
+    def __init__(self, value, name=None, trainable: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.is_leaf = True
+        self.regularizer = None
+        self.lr_scale = 1.0
+
+
+def to_tensor(data, dtype=None, stop_gradient: bool = True) -> Tensor:
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+to_variable = to_tensor

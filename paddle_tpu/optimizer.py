@@ -1,0 +1,502 @@
+"""Optimizers over the Program IR.
+
+Analog of python/paddle/fluid/optimizer.py:56-3100: ``minimize(loss)`` runs
+append_backward then appends per-parameter update ops (+ accumulator vars
+initialized by the startup program). Regularization and gradient clipping
+are program rewrites, matching the reference's capability so downstream
+passes (DGC, gradient merge, AMP) can see them.
+
+The same classes also drive dygraph parameters (see dygraph/ engine):
+``apply_gradients`` works on eager tensors through the op lowerings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.program import (Variable, default_main_program,
+                                default_startup_program)
+from .layers.tensor import create_global_var
+
+
+class GradClipBase:
+    def _clip_static(self, params_grads, block):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradClipBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip_static(self, params_grads, block):
+        out = []
+        for p, g in params_grads:
+            clipped = block.create_var(unique_name.generate(g.name + "@CLIP"),
+                                       stop_gradient=True)
+            block.append_op("clip", {"X": g}, {"Out": clipped},
+                            {"min": self.min, "max": self.max,
+                             "op_role": "optimize"})
+            out.append((p, clipped))
+        return out
+
+
+class GradientClipByNorm(GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_static(self, params_grads, block):
+        out = []
+        for p, g in params_grads:
+            clipped = block.create_var(unique_name.generate(g.name + "@CLIP"),
+                                       stop_gradient=True)
+            block.append_op("clip_by_norm", {"X": g}, {"Out": clipped},
+                            {"max_norm": self.clip_norm,
+                             "op_role": "optimize"})
+            out.append((p, clipped))
+        return out
+
+
+class GradientClipByGlobalNorm(GradClipBase):
+    """sqrt(sum ||g||^2) <= clip_norm — the transformer staple."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_static(self, params_grads, block):
+        sq_names = []
+        for _, g in params_grads:
+            sq = block.create_var(unique_name.generate("gsq"),
+                                  stop_gradient=True)
+            block.append_op("squared_l2_norm", {"X": g}, {"Out": sq},
+                            {"op_role": "optimize"})
+            sq_names.append(sq.name)
+        total = block.create_var(unique_name.generate("global_norm_sq"),
+                                 stop_gradient=True)
+        block.append_op("sum", {"X": sq_names}, {"Out": total},
+                        {"op_role": "optimize"})
+        norm = block.create_var(unique_name.generate("global_norm"),
+                                stop_gradient=True)
+        block.append_op("sqrt", {"X": total}, {"Out": norm},
+                        {"op_role": "optimize"})
+        # scale = clip / max(norm, clip)
+        maxed = block.create_var(unique_name.generate("norm_max"),
+                                 stop_gradient=True)
+        clip_v = block.create_var(unique_name.generate("clip_const"),
+                                  stop_gradient=True)
+        block.append_op("fill_constant_like", {"X": norm}, {"Out": clip_v},
+                        {"value": self.clip_norm, "op_role": "optimize"})
+        block.append_op("elementwise_max", {"X": norm, "Y": clip_v},
+                        {"Out": maxed}, {"op_role": "optimize"})
+        scale_var = block.create_var(unique_name.generate("clip_scale"),
+                                     stop_gradient=True)
+        block.append_op("elementwise_div", {"X": clip_v, "Y": maxed},
+                        {"Out": scale_var}, {"op_role": "optimize"})
+        out = []
+        for p, g in params_grads:
+            clipped = block.create_var(unique_name.generate(g.name + "@CLIP"),
+                                       stop_gradient=True)
+            block.append_op("elementwise_mul", {"X": g, "Y": scale_var},
+                            {"Out": clipped},
+                            {"axis": -1, "op_role": "optimize"})
+            out.append((p, clipped))
+        return out
+
+
+class Optimizer:
+    """Base (analog of fluid/optimizer.py:56)."""
+
+    _accum_specs: Sequence[Tuple[str, float]] = ()  # (name, init value)
+
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip: Optional[GradClipBase] = None,
+                 name: Optional[str] = None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or type(self).__name__
+        self._lr_var: Optional[Variable] = None
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self.helper = None
+
+    # -- learning rate -----------------------------------------------------
+    def _create_lr_var(self):
+        if self._lr_var is not None:
+            return self._lr_var
+        lr = self._learning_rate
+        if isinstance(lr, Variable):
+            self._lr_var = lr
+        else:
+            from .optimizer_lr import LRScheduler
+            if isinstance(lr, LRScheduler):
+                self._lr_scheduler = lr
+                lr = lr()
+            self._lr_var = create_global_var(
+                shape=[1], value=float(lr), dtype="float32",
+                persistable=True,
+                name=unique_name.generate("learning_rate"))
+        return self._lr_var
+
+    def get_lr_var(self):
+        return self._lr_var
+
+    def sync_lr(self, scope):
+        """Push the scheduler's current lr into the scope's lr var (static
+        mode). Call after scheduler.step()."""
+        sched = getattr(self, "_lr_scheduler", None)
+        if sched is not None and self._lr_var is not None:
+            import jax.numpy as jnp
+            scope.set_var(self._lr_var.name,
+                          jnp.asarray([sched()], jnp.float32))
+
+    def set_lr(self, value: float, scope=None):
+        from .framework.scope import global_scope
+        import jax.numpy as jnp
+        self._learning_rate = float(value)
+        if self._lr_var is not None:
+            (scope or global_scope()).set_var(
+                self._lr_var.name, jnp.asarray([float(value)], jnp.float32))
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Variable, init_value=0.0,
+                         shape=None, dtype=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        shape = list(shape if shape is not None else param.shape)
+        v = create_global_var(
+            shape=shape, value=float(init_value), dtype=dtype or param.dtype,
+            persistable=True, name=unique_name.generate(f"{param.name}_{name}"))
+        self._accumulators.setdefault(name, {})[param.name] = v
+        return v
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- program rewrites --------------------------------------------------
+    def _append_regularization(self, params_grads, block):
+        out = []
+        for p, g in params_grads:
+            reg = p.regularizer or self.regularization
+            if reg is None:
+                out.append((p, g))
+                continue
+            kind, coeff = (reg if isinstance(reg, tuple)
+                           else (reg.kind, reg.coeff))
+            if kind == "l2":
+                scaled = block.create_var(
+                    unique_name.generate(g.name + "@REG"), stop_gradient=True)
+                block.append_op("scale", {"X": p}, {"Out": scaled},
+                                {"scale": float(coeff),
+                                 "op_role": "optimize"})
+                merged = block.create_var(
+                    unique_name.generate(g.name + "@REGSUM"),
+                    stop_gradient=True)
+                block.append_op("sum", {"X": [g.name, scaled.name]},
+                                {"Out": merged}, {"op_role": "optimize"})
+                out.append((p, merged))
+            elif kind == "l1":
+                sign = block.create_var(
+                    unique_name.generate(g.name + "@SIGN"), stop_gradient=True)
+                block.append_op("sign", {"X": p}, {"Out": sign},
+                                {"op_role": "optimize"})
+                scaled = block.create_var(
+                    unique_name.generate(g.name + "@REG"), stop_gradient=True)
+                block.append_op("scale", {"X": sign}, {"Out": scaled},
+                                {"scale": float(coeff),
+                                 "op_role": "optimize"})
+                merged = block.create_var(
+                    unique_name.generate(g.name + "@REGSUM"),
+                    stop_gradient=True)
+                block.append_op("sum", {"X": [g.name, scaled.name]},
+                                {"Out": merged}, {"op_role": "optimize"})
+                out.append((p, merged))
+            else:
+                raise ValueError(f"unknown regularizer kind {kind!r}")
+        return out
+
+    # -- per-optimizer op --------------------------------------------------
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # -- public ------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        plist = parameter_list or self._parameter_list
+        return append_backward(loss, parameter_list=plist,
+                               no_grad_set=no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        # Operate on the program that owns the parameters — minimize() may
+        # be called outside the program_guard the model was built under.
+        from .framework.program import program_guard
+        program = params_grads[0][0].block.program if params_grads \
+            else default_main_program()
+        with program_guard(program):
+            block = program.global_block()
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip._clip_static(params_grads,
+                                                            block)
+            params_grads = self._append_regularization(params_grads, block)
+            self._create_lr_var()
+            self._create_accumulators(block, [p for p, _ in params_grads])
+            ops = []
+            for p_g in params_grads:
+                ops.append(self._append_optimize_op(block, p_g))
+            self._finish_update(block, params_grads)
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+    def _lr_input(self, param) -> Variable:
+        """Per-param lr (honors ParamAttr.learning_rate scale)."""
+        lr = self._create_lr_var()
+        scale = getattr(param, "lr_scale", 1.0)
+        if scale == 1.0:
+            return lr
+        block = default_main_program().global_block()
+        scaled = block.create_var(
+            unique_name.generate(f"{param.name}_lr"), stop_gradient=True,
+            persistable=False)
+        block.append_op("scale", {"X": lr}, {"Out": scaled},
+                        {"scale": float(scale), "op_role": "optimize"})
+        return scaled
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd", {"Param": p, "Grad": g,
+                    "LearningRate": self._lr_input(p)},
+            {"ParamOut": p}, {"op_role": "optimize"})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": p, "Grad": g, "Velocity": v,
+             "LearningRate": self._lr_input(p)},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov,
+             "op_role": "optimize"})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {"Param": p, "Grad": g, "Velocity": v,
+             "LearningRate": self._lr_input(p)},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay,
+             "op_role": "optimize"})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            {"Param": p, "Grad": g, "Moment": m,
+             "LearningRate": self._lr_input(p)},
+            {"ParamOut": p, "MomentOut": m},
+            {"epsilon": self._epsilon, "op_role": "optimize"})
+
+
+class AdamOptimizer(Optimizer):
+    _op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, init_value=1.0, shape=[1])
+            self._add_accumulator("beta2_pow", p, init_value=1.0, shape=[1])
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon, "op_role": "optimize"}
+        attrs.update(self._extra_attrs())
+        return block.append_op(
+            self._op_type,
+            {"Param": p, "Grad": g,
+             "Moment1": self._get_accumulator("moment1", p),
+             "Moment2": self._get_accumulator("moment2", p),
+             "Beta1Pow": self._get_accumulator("beta1_pow", p),
+             "Beta2Pow": self._get_accumulator("beta2_pow", p),
+             "LearningRate": self._lr_input(p)},
+            {"ParamOut": p,
+             "Moment1Out": self._get_accumulator("moment1", p),
+             "Moment2Out": self._get_accumulator("moment2", p),
+             "Beta1PowOut": self._get_accumulator("beta1_pow", p),
+             "Beta2PowOut": self._get_accumulator("beta2_pow", p)},
+            attrs)
+
+
+class AdamWOptimizer(AdamOptimizer):
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff, "with_decay": True}
+
+
+class LambOptimizer(AdamOptimizer):
+    _op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ins = {"Param": p, "Grad": g,
+               "MeanSquare": self._get_accumulator("mean_square", p),
+               "Moment": self._get_accumulator("moment", p),
+               "LearningRate": self._lr_input(p)}
+        outs = {"ParamOut": p,
+                "MeanSquareOut": self._get_accumulator("mean_square", p),
+                "MomentOut": self._get_accumulator("moment", p)}
+        if self._centered:
+            ins["MeanGrad"] = self._get_accumulator("mean_grad", p)
+            outs["MeanGradOut"] = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            "rmsprop", ins, outs,
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered,
+             "op_role": "optimize"})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "ftrl",
+            {"Param": p, "Grad": g,
+             "SquaredAccumulator": self._get_accumulator("squared", p),
+             "LinearAccumulator": self._get_accumulator("linear", p),
+             "LearningRate": self._lr_input(p)},
+            {"ParamOut": p,
+             "SquaredAccumOut": self._get_accumulator("squared", p),
+             "LinearAccumOut": self._get_accumulator("linear", p)},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power,
+             "op_role": "optimize"})
+
+
+# fluid-style aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adagrad = AdagradOptimizer
+Lamb = LambOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+LarsMomentum = LarsMomentumOptimizer
+
+
+class L1Decay:
+    kind = "l1"
+
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+
+class L2Decay:
+    kind = "l2"
+
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff

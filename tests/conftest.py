@@ -20,3 +20,6 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 # float32 matmuls at full precision for numerical test parity
 jax.config.update("jax_default_matmul_precision", "highest")
+# allow float64 — OpTest numerical grad checks run in fp64 like the
+# reference's op_test.py harness
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,173 @@
+"""OpTest harness — the workhorse op-kernel test pattern.
+
+Analog of the reference's python/paddle/fluid/tests/unittests/op_test.py:170:
+build a one-op program from dict inputs/attrs, check outputs against a
+reference, and check gradients NUMERICALLY (central differences over the
+forward program) against the program-level analytic grads emitted by
+append_backward + the grad-op lowerings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.framework import (Executor, Program, Scope, append_backward,
+                                  program_guard, unique_name)
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs, outputs, attrs (like the reference).
+
+    inputs/outputs: {slot: np.ndarray} or {slot: [(name, np.ndarray), ...]}
+    """
+
+    op_type: str = ""
+    inputs: Dict = {}
+    outputs: Dict = {}
+    attrs: Dict = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _norm_io(self, d):
+        """-> {slot: [(name, array), ...]}"""
+        out = {}
+        for slot, v in d.items():
+            if isinstance(v, (list, tuple)) and v and isinstance(v[0], tuple):
+                out[slot] = [(n, np.asarray(a)) for n, a in v]
+            else:
+                out[slot] = [(f"{slot}_0", np.asarray(v))]
+        return out
+
+    def _build_program(self):
+        prog = Program()
+        prog.random_seed = 2024
+        blk = prog.global_block()
+        ins = self._norm_io(self.inputs)
+        outs = self._norm_io(self.outputs)
+        in_names, feed = {}, {}
+        for slot, pairs in ins.items():
+            in_names[slot] = []
+            for name, arr in pairs:
+                blk.create_var(name, shape=arr.shape, dtype=str(arr.dtype),
+                               is_data=True, stop_gradient=False)
+                in_names[slot].append(name)
+                feed[name] = arr
+        out_names = {}
+        for slot, pairs in outs.items():
+            out_names[slot] = []
+            for name, _ in pairs:
+                blk.create_var(name)
+                out_names[slot].append(name)
+        blk.append_op(self.op_type, inputs=in_names, outputs=out_names,
+                      attrs=dict(self.attrs))
+        return prog, blk, feed, outs
+
+    # -- checks ------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        prog, blk, feed, outs = self._build_program()
+        fetch = []
+        expected = []
+        for slot, pairs in outs.items():
+            if slot in no_check_set:
+                continue
+            for name, arr in pairs:
+                fetch.append(name)
+                expected.append(arr)
+        exe = Executor()
+        got = exe.run(prog, feed=feed, fetch_list=fetch, scope=Scope())
+        for g, e, name in zip(got, expected, fetch):
+            np.testing.assert_allclose(
+                g, e, atol=atol, rtol=rtol,
+                err_msg=f"op {self.op_type} output {name} mismatch")
+
+    def check_grad(self, inputs_to_check: Sequence[str], output_name: str,
+                   max_relative_error: float = 0.005, delta: float = 1e-5,
+                   no_grad_set=()):
+        """Central-difference numerical grads vs program-level analytic."""
+        # Scalar target = mean(out * W) with a fixed random projection W so
+        # the gradient signal is non-degenerate (plain mean of e.g. softmax
+        # is constant -> zero grads vs FD noise).
+        out_shape = None
+        for slot, pairs in self._norm_io(self.outputs).items():
+            for name, arr in pairs:
+                if name == output_name:
+                    out_shape = arr.shape
+        proj = np.random.RandomState(99).uniform(0.5, 1.5, out_shape)
+
+        def add_loss(blk):
+            blk.create_var("projw__", stop_gradient=True)
+            blk.append_op("assign_value", {}, {"Out": "projw__"},
+                          {"shape": list(proj.shape), "dtype": "float64",
+                           "values": proj.reshape(-1).tolist()})
+            blk.create_var("outc__", stop_gradient=False)
+            blk.append_op("cast", {"X": output_name}, {"Out": "outc__"},
+                          {"out_dtype": "float64"})
+            blk.create_var("weighted__")
+            blk.append_op("elementwise_mul",
+                          {"X": "outc__", "Y": "projw__"},
+                          {"Out": "weighted__"})
+            blk.create_var("loss__")
+            blk.append_op("mean", {"X": "weighted__"}, {"Out": "loss__"})
+
+        def promote_feed(prog, blk, feed):
+            """Run grad checks in fp64 like the reference harness."""
+            out = {}
+            for k, v in feed.items():
+                if np.issubdtype(np.asarray(v).dtype, np.floating):
+                    out[k] = np.asarray(v, np.float64)
+                    blk.vars[k].dtype = "float64"
+                else:
+                    out[k] = v
+            return out
+
+        from paddle_tpu.framework.backward import _append_backward_impl
+        exe = Executor()
+
+        # analytic grads via program-level backward
+        prog2, blk2, feed2, _ = self._build_program()
+        feed2 = promote_feed(prog2, blk2, feed2)
+        add_loss(blk2)
+        _, grad_map = _append_backward_impl(
+            blk2.var("loss__"), no_grad_set=set(no_grad_set),
+            extra_vars=list(inputs_to_check))
+        fetch = [grad_map[n] for n in inputs_to_check]
+        assert all(f is not None for f in fetch), \
+            f"no analytic grad for some of {inputs_to_check}"
+        analytic = exe.run(prog2, feed=feed2, fetch_list=fetch, scope=Scope())
+
+        # numerical grads over the forward-only program
+        fwd_prog, fwd_blk, fwd_feed, _ = self._build_program()
+        fwd_feed = promote_feed(fwd_prog, fwd_blk, fwd_feed)
+        feed = fwd_feed
+        add_loss(fwd_blk)
+        fexe = Executor()
+
+        def loss_at(feed_override):
+            (v,) = fexe.run(fwd_prog, feed=feed_override,
+                            fetch_list=["loss__"], scope=Scope())
+            return float(v)
+
+        for name, ana in zip(inputs_to_check, analytic):
+            base = np.asarray(feed[name], np.float64)
+            num = np.zeros_like(base)
+            flat = base.reshape(-1)
+            nflat = num.reshape(-1)
+            for i in range(flat.size):
+                fp = dict(fwd_feed)
+                plus = flat.copy()
+                plus[i] += delta
+                fp[name] = plus.reshape(base.shape).astype(feed[name].dtype)
+                lp = loss_at(fp)
+                minus = flat.copy()
+                minus[i] -= delta
+                fp[name] = minus.reshape(base.shape).astype(feed[name].dtype)
+                lm = loss_at(fp)
+                nflat[i] = (lp - lm) / (2 * delta)
+            abs_err = np.abs(np.asarray(ana, np.float64) - num)
+            denom = np.maximum(np.maximum(np.abs(num), np.abs(ana)), 1e-3)
+            rel = (abs_err / denom).max()
+            assert rel <= max_relative_error, (
+                f"op {self.op_type} grad w.r.t. {name}: max rel err {rel:.5f}"
+                f" > {max_relative_error}\nanalytic={np.asarray(ana)}\n"
+                f"numeric={num}")

@@ -1,0 +1,107 @@
+"""End-to-end LeNet training via the layers API.
+
+Mirrors the reference's book test (python/paddle/fluid/tests/book/
+test_recognize_digits.py): build LeNet with fluid-style layers, run the
+startup program, train with the static executor, and require the model to
+learn. Uses a synthetic 10-class "digits" dataset (class-template images +
+noise) since the environment has no network access.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  unique_name)
+from paddle_tpu.optimizer import AdamOptimizer
+
+
+def make_digits(n, rng):
+    """Synthetic 1x28x28 10-class data: fixed random class templates."""
+    tmpl_rng = np.random.RandomState(1234)
+    templates = tmpl_rng.rand(10, 1, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, n).astype(np.int64)
+    imgs = templates[labels] + 0.35 * rng.randn(n, 1, 28, 28).astype(np.float32)
+    return imgs, labels.reshape(-1, 1)
+
+
+def lenet(img, label):
+    conv1 = layers.conv2d(img, num_filters=6, filter_size=5, padding=2,
+                          act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = layers.fc(pool2, size=120, act="relu")
+    fc2 = layers.fc(fc1, size=84, act="relu")
+    logits = layers.fc(fc2, size=10)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(logits, label)
+    return avg_loss, acc
+
+
+def test_lenet_trains():
+    main = Program()
+    startup = Program()
+    main.random_seed = 42
+    startup.random_seed = 42
+    with program_guard(main, startup), unique_name.guard():
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        avg_loss, acc = lenet(img, label)
+        opt = AdamOptimizer(learning_rate=1e-3)
+        opt.minimize(avg_loss)
+
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    # all parameters materialized?
+    n_params = len(main.all_parameters())
+    assert n_params == 10  # 3 conv/fc weight+bias pairs + 2 fc pairs
+    assert all(scope.find_var(p.name) is not None
+               for p in main.all_parameters())
+
+    rng = np.random.RandomState(0)
+    first_loss, last_loss, last_acc = None, None, None
+    for step in range(120):
+        x, y = make_digits(64, rng)
+        loss_v, acc_v = exe.run(main, feed={"img": x, "label": y},
+                                fetch_list=[avg_loss, acc], scope=scope)
+        if first_loss is None:
+            first_loss = float(loss_v)
+        last_loss, last_acc = float(loss_v), float(acc_v)
+    assert first_loss > 1.5          # ~ln(10) at start
+    assert last_loss < 0.35, f"loss didn't converge: {last_loss}"
+    assert last_acc > 0.9, f"accuracy too low: {last_acc}"
+
+    # inference program: clone for test, run eval batch
+    test_prog = main.clone(for_test=True)
+    x, y = make_digits(256, rng)
+    loss_v, acc_v = exe.run(test_prog, feed={"img": x, "label": y},
+                            fetch_list=[avg_loss.name, acc.name], scope=scope)
+    assert float(acc_v) > 0.9
+
+
+def test_lenet_momentum_with_global_norm_clip():
+    from paddle_tpu.optimizer import (GradientClipByGlobalNorm,
+                                      MomentumOptimizer)
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 7
+    with program_guard(main, startup), unique_name.guard():
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        avg_loss, acc = lenet(img, label)
+        opt = MomentumOptimizer(0.05, momentum=0.9,
+                                grad_clip=GradientClipByGlobalNorm(1.0))
+        opt.minimize(avg_loss)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(40):
+        x, y = make_digits(64, rng)
+        (l,) = exe.run(main, feed={"img": x, "label": y},
+                       fetch_list=[avg_loss], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, losses[-5:]

@@ -1,0 +1,328 @@
+"""Op kernel tests via the OpTest harness (output + numerical-grad checks).
+
+Mirrors the reference's per-op unittest pattern (SURVEY §4.1).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+_rand_counter = [0]
+
+
+def _rand(*shape, dtype=np.float32, scale=1.0):
+    _rand_counter[0] += 1
+    seed = (hash(shape) + 7919 * _rand_counter[0]) % 2**31
+    return (np.random.RandomState(seed)
+            .uniform(-1, 1, shape) * scale).astype(dtype)
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = _rand(3, 4)
+        y = _rand(3, 4)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x + y)]}
+        self.attrs = {}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestElementwiseAddBcast(OpTest):
+    op_type = "elementwise_add"
+
+    def test(self):
+        x = _rand(2, 3, 4)
+        y = _rand(3)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x + y.reshape(1, 3, 1))]}
+        self.attrs = {"axis": 1}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestMatmulV2(OpTest):
+    op_type = "matmul_v2"
+
+    def test(self):
+        x, y = _rand(3, 5), _rand(5, 4)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x @ y)]}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+    def test_trans(self):
+        x, y = _rand(5, 3), _rand(4, 5)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x.T @ y.T)]}
+        self.attrs = {"trans_x": True, "trans_y": True}
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test(self):
+        x, y = _rand(2, 3, 4), _rand(12, 5)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x.reshape(2, 12) @ y)]}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test(self):
+        x = _rand(4, 7)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", e / e.sum(-1, keepdims=True))]}
+        self.attrs = {"axis": -1}
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        logits = _rand(5, 8, scale=2.0)
+        label = np.random.RandomState(1).randint(0, 8, (5, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label[:, 0]]).reshape(5, 1)
+        self.inputs = {"Logits": [("logits", logits)],
+                       "Label": [("label", label)]}
+        self.outputs = {"Softmax": [("softmax", sm.astype(np.float32))],
+                        "Loss": [("loss", loss.astype(np.float32))]}
+        self.attrs = {}
+        self.check_output(atol=1e-4)
+        self.check_grad(["logits"], "loss")
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def test(self):
+        x = _rand(2, 3, 8, 8)
+        w = _rand(4, 3, 3, 3, scale=0.5)
+        import jax
+        import jax.numpy as jnp
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=dn))
+        self.inputs = {"Input": [("x", x)], "Filter": [("w", w)]}
+        self.outputs = {"Output": [("out", ref)]}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.check_output()
+        self.check_grad(["w"], "out", max_relative_error=0.01)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = _rand(2, 3, 4, 4)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", ref)]}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2]}
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = _rand(2, 3, 4, 4)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", ref)]}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2]}
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test(self):
+        x = _rand(4, 6)
+        scale = _rand(6) + 1.0
+        bias = _rand(6)
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        y = (x - m) / np.sqrt(v + 1e-5) * scale + bias
+        self.inputs = {"X": [("x", x)], "Scale": [("scale", scale)],
+                       "Bias": [("bias", bias)]}
+        self.outputs = {"Y": [("y", y.astype(np.float32))],
+                        "Mean": [("m", m.reshape(4).astype(np.float32))],
+                        "Variance": [("v", v.reshape(4).astype(np.float32))]}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        self.check_output(atol=1e-4)
+        self.check_grad(["x", "scale", "bias"], "y",
+                        max_relative_error=0.01)
+
+
+class TestBatchNormInfer(OpTest):
+    op_type = "batch_norm"
+
+    def test(self):
+        x = _rand(4, 3, 2, 2)
+        scale = np.ones(3, np.float32)
+        bias = np.zeros(3, np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + 1e-5)
+        self.inputs = {"X": [("x", x)], "Scale": [("scale", scale)],
+                       "Bias": [("bias", bias)], "Mean": [("mean", mean)],
+                       "Variance": [("var", var)]}
+        self.outputs = {"Y": [("y", y.astype(np.float32))]}
+        self.attrs = {"is_test": True, "epsilon": 1e-5}
+        self.check_output(atol=1e-4)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def test(self):
+        x = _rand(3, 4, 5)
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", x.sum(axis=1))]}
+        self.attrs = {"dim": [1]}
+        self.check_output()
+        self.check_grad(["x"], "out")
+
+
+class TestLookupTableV2(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test(self):
+        w = _rand(10, 4)
+        ids = np.array([[1, 2], [3, 9]], np.int64)
+        self.inputs = {"W": [("w", w)], "Ids": [("ids", ids)]}
+        self.outputs = {"Out": [("out", w[ids])]}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["w"], "out")
+
+
+class TestDropoutTrain(OpTest):
+    op_type = "dropout"
+
+    def test_statistics(self):
+        # Can't match exact mask; check mean preservation (upscale mode)
+        import paddle_tpu
+        from paddle_tpu.framework import Executor, Program, Scope
+        prog = Program()
+        prog.random_seed = 5
+        blk = prog.global_block()
+        blk.create_var("x", is_data=True)
+        blk.create_var("out")
+        blk.create_var("mask")
+        blk.append_op("dropout", {"X": "x"}, {"Out": "out", "Mask": "mask"},
+                      {"dropout_prob": 0.3, "is_test": False,
+                       "dropout_implementation": "upscale_in_train"})
+        exe = Executor()
+        x = np.ones((1000,), np.float32)
+        out, mask = exe.run(prog, feed={"x": x},
+                            fetch_list=["out", "mask"], scope=Scope())
+        keep_rate = mask.mean()
+        assert abs(keep_rate - 0.7) < 0.05
+        np.testing.assert_allclose(out[mask > 0], 1.0 / 0.7, rtol=1e-5)
+
+    def test_infer(self):
+        x = _rand(4, 4)
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", x)]}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                      "dropout_implementation": "upscale_in_train"}
+        self.check_output(no_check_set=("Mask",))
+
+
+class TestGelu(OpTest):
+    op_type = "gelu"
+
+    def test(self):
+        x = _rand(3, 4, scale=2.0)
+        try:
+            from scipy.stats import norm
+            cdf = norm.cdf(x)
+        except ImportError:
+            from math import erf
+            cdf = 0.5 * (1 + np.vectorize(erf)(x / np.sqrt(2)))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", (x * cdf).astype(np.float32))]}
+        self.attrs = {}
+        self.check_output(atol=1e-4)
+        self.check_grad(["x"], "out")
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def test(self):
+        x = _rand(2, 3, 4)
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", x.transpose(2, 0, 1))]}
+        self.attrs = {"axis": [2, 0, 1]}
+        self.check_output(no_check_set=("XShape",))
+        self.check_grad(["x"], "out")
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def test(self):
+        a, b = _rand(2, 3), _rand(2, 5)
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.outputs = {"Out": [("out", np.concatenate([a, b], axis=1))]}
+        self.attrs = {"axis": 1}
+        self.check_output()
+        self.check_grad(["a", "b"], "out")
+
+
+class TestAdamOp(OpTest):
+    op_type = "adam"
+
+    def test(self):
+        p = _rand(4)
+        g = _rand(4)
+        m1 = _rand(4, scale=0.1)
+        m2 = np.abs(_rand(4, scale=0.1))
+        b1p = np.array([0.9], np.float32)
+        b2p = np.array([0.999], np.float32)
+        lr = np.array([0.01], np.float32)
+        beta1, beta2, eps = 0.9, 0.999, 1e-8
+        m1o = beta1 * m1 + (1 - beta1) * g
+        m2o = beta2 * m2 + (1 - beta2) * g * g
+        b1o, b2o = b1p * beta1, b2p * beta2
+        lr_t = lr * np.sqrt(1 - b2o) / (1 - b1o)
+        po = p - lr_t * m1o / (np.sqrt(m2o) + eps)
+        self.inputs = {"Param": [("p", p)], "Grad": [("g", g)],
+                       "Moment1": [("m1", m1)], "Moment2": [("m2", m2)],
+                       "Beta1Pow": [("b1p", b1p)], "Beta2Pow": [("b2p", b2p)],
+                       "LearningRate": [("lr", lr)]}
+        self.outputs = {"ParamOut": [("po", po.astype(np.float32))],
+                        "Moment1Out": [("m1o", m1o.astype(np.float32))],
+                        "Moment2Out": [("m2o", m2o.astype(np.float32))],
+                        "Beta1PowOut": [("b1o", b1o.astype(np.float32))],
+                        "Beta2PowOut": [("b2o", b2o.astype(np.float32))]}
+        self.attrs = {"beta1": beta1, "beta2": beta2, "epsilon": eps}
+        self.check_output(atol=1e-5)
