@@ -3,8 +3,8 @@
 Brand-new JAX/XLA/Pallas implementation with the capability surface of
 PaddlePaddle Fluid (reference: zlsh80826/Paddle): static-graph Program IR
 with program-level autodiff, a trace-once XLA executor, an eager (dygraph)
-engine, fleet-style distributed training on GSPMD meshes, AMP, and a 2.0
-nn/optimizer/tensor API.
+engine with jit compilation, fleet-style distributed training on GSPMD
+meshes, AMP, and the 2.0 nn/optimizer/tensor API.
 """
 
 __version__ = "0.1.0"
@@ -16,6 +16,58 @@ from .framework import (Program, Executor, Scope, global_scope,
                         program_guard, append_backward)
 from . import initializer
 from . import layers
-from . import optimizer
 from . import optimizer_lr
 from .param_attr import ParamAttr
+
+# 2.0 surface
+from . import nn
+from . import amp
+from . import jit
+from .dygraph import no_grad, to_tensor, to_variable
+from .dygraph.layers import seed
+from .dygraph.tensor import Parameter, Tensor
+from .framework_io import (load, load_inference_model, load_persistables,
+                           save, save_inference_model, save_persistables)
+from .tensor_api import *  # noqa: F401,F403
+from . import tensor_api as tensor
+
+# paddle.optimizer 2.0 names (the optimizer module itself carries both the
+# fluid-style classes and the 2.0 aliases; schedulers live at optimizer.lr)
+from . import optimizer
+from .optimizer import (Adam, AdamW, Adagrad, Ftrl, Lamb, LarsMomentum,
+                        Momentum, RMSProp, SGD, L1Decay, L2Decay)
+from .optimizer import (GradientClipByGlobalNorm, GradientClipByNorm,
+                        GradientClipByValue)
+
+
+def disable_static(place=None):
+    """2.0 default mode is dygraph; kept for API parity (no-op)."""
+
+
+def enable_static():
+    """Switch to static-graph mode: build Programs + Executor (the layers/
+    framework APIs are always available; this is an API-parity marker)."""
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def set_device(device: str):
+    import jax
+    if device.startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    return device
+
+
+def get_device() -> str:
+    import jax
+    return jax.default_backend()

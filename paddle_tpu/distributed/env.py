@@ -1,0 +1,42 @@
+"""Distributed environment state.
+
+Tracks the active mesh/axis context so layers (e.g. SyncBatchNorm) and
+collective ops can find the data-parallel axis when running under
+shard_map/pjit. Analog of the reference's global NCCLCommContext registry
+(platform/collective_helper.h:62) — ring ids become mesh axis names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# ring_id -> mesh axis name; populated by init_parallel_env / fleet
+_ring_to_axis: Dict[int, str] = {}
+_data_axis: Optional[str] = None
+_mesh = None
+
+
+def register_ring(ring_id: int, axis_name: str):
+    _ring_to_axis[int(ring_id)] = axis_name
+
+
+def axis_for_ring(ring_id: int) -> Optional[str]:
+    return _ring_to_axis.get(int(ring_id))
+
+
+def set_data_axis(axis_name: Optional[str]):
+    global _data_axis
+    _data_axis = axis_name
+
+
+def current_data_axis() -> Optional[str]:
+    return _data_axis
+
+
+def set_mesh(mesh):
+    global _mesh
+    _mesh = mesh
+
+
+def current_mesh():
+    return _mesh

@@ -1,0 +1,119 @@
+"""Checkpoint I/O: save/load for state dicts, scopes, and programs.
+
+Analog of python/paddle/fluid/io.py (save_persistables / load_persistables /
+save_inference_model) and dygraph/checkpoint.py (paddle.save/load). Format:
+numpy .npz for tensor payloads (combined single-file, like the reference's
+save_combine op) + JSON for Program IR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _to_numpy_dict(state: Dict) -> Dict[str, np.ndarray]:
+    from .dygraph.tensor import Tensor
+    out = {}
+    for k, v in state.items():
+        if isinstance(v, Tensor):
+            out[k] = v.numpy()
+        else:
+            out[k] = np.asarray(v)
+    return out
+
+
+def save_state_dict(state: Dict, path: str):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **_to_numpy_dict(state))
+    # np.savez appends .npz; normalize to exact path
+    if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+        os.replace(path + ".npz", path)
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save(obj, path: str):
+    """paddle.save analog (state dicts / tensor dicts)."""
+    from .dygraph.tensor import Tensor
+    if isinstance(obj, dict):
+        save_state_dict(obj, path)
+    elif isinstance(obj, Tensor):
+        save_state_dict({"tensor": obj}, path)
+    else:
+        raise TypeError(f"cannot save {type(obj)}")
+
+
+def load(path: str):
+    return load_state_dict(path)
+
+
+# -- static-graph persistables (scope-based) ---------------------------------
+
+def save_persistables(executor, dirname: str, main_program=None,
+                      scope=None, filename: Optional[str] = "params"):
+    """Save all persistable vars of a program from the scope (combined
+    format — analog of save_combine_op)."""
+    from .framework.program import default_main_program
+    from .framework.scope import global_scope
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    payload = {}
+    for v in program.list_vars():
+        if v.persistable:
+            arr = scope.find_var(v.name)
+            if arr is not None:
+                payload[v.name] = np.asarray(arr)
+    save_state_dict(payload, os.path.join(dirname, filename or "params"))
+
+
+def load_persistables(executor, dirname: str, main_program=None,
+                      scope=None, filename: Optional[str] = "params"):
+    import jax.numpy as jnp
+    from .framework.program import default_main_program
+    from .framework.scope import global_scope
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    payload = load_state_dict(os.path.join(dirname, filename or "params"))
+    missing = []
+    for v in program.list_vars():
+        if v.persistable:
+            if v.name in payload:
+                scope.set_var(v.name, jnp.asarray(payload[v.name]))
+            else:
+                missing.append(v.name)
+    return missing
+
+
+def save_inference_model(dirname: str, feeded_var_names, target_vars,
+                         executor, main_program=None, scope=None):
+    """Prune to the inference slice + save program JSON and params
+    (analog of fluid/io.py save_inference_model)."""
+    from .framework.program import Variable, default_main_program
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    inference = program.clone(for_test=True)
+    meta = {
+        "feed": list(feeded_var_names),
+        "fetch": [v.name if isinstance(v, Variable) else str(v)
+                  for v in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__.json"), "w") as f:
+        json.dump({"program": inference.to_dict(), "meta": meta}, f)
+    save_persistables(executor, dirname, program, scope)
+
+
+def load_inference_model(dirname: str, executor, scope=None):
+    from .framework.program import Program
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        blob = json.load(f)
+    program = Program.from_dict(blob["program"])
+    load_persistables(executor, dirname, program, scope)
+    return program, blob["meta"]["feed"], blob["meta"]["fetch"]

@@ -1,0 +1,158 @@
+"""jit — dygraph-to-static compilation.
+
+Analog of python/paddle/fluid/dygraph/jit.py + dygraph_to_static/ (the
+ProgramTranslator AST transpiler, program_translator.py:667). The TPU-native
+design is radically simpler: every dygraph op is already a jnp call, so an
+entire eager train step can be traced by jax.jit. ``to_static`` wraps a
+function, threading all mutable framework state (parameter values, grads,
+optimizer accumulators, PRNG) through the traced function as inputs/outputs
+— so param mutation by ``optimizer.step()`` and ``.grad`` accumulation by
+``backward()`` happen ON TRACERS inside the compiled computation and are
+written back to the eager objects after each call.
+
+This is the dygraph performance path on TPU: one XLA computation per step
+instead of per-op dispatch (which is pathologically slow on remote TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dygraph.layers import Layer
+from .dygraph.tensor import Parameter, Tensor
+
+
+class _StateSpec:
+    """Collects the mutable state a traced step touches."""
+
+    def __init__(self, layers: Sequence[Layer], optimizers: Sequence):
+        self.params: List[Parameter] = []
+        self.buffers: List[Tensor] = []
+        seen = set()
+        for layer in layers:
+            for p in layer.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    self.params.append(p)
+            for sub in layer.sublayers(include_self=True):
+                for b in sub._buffers.values():
+                    if id(b) not in seen:
+                        seen.add(id(b))
+                        self.buffers.append(b)
+        self.optimizers = list(optimizers)
+
+    def snapshot(self):
+        """-> pytree of current state arrays."""
+        opt_states = []
+        for opt in self.optimizers:
+            opt_states.append({k: v for k, v in opt._eager_state.items()})
+        return {
+            "params": [p.value for p in self.params],
+            "grads": [None if p.grad is None else p.grad.value
+                      for p in self.params],
+            "buffers": [b.value for b in self.buffers],
+            "opt": opt_states,
+        }
+
+    def load(self, state):
+        for p, v in zip(self.params, state["params"]):
+            p.value = v
+        for p, g in zip(self.params, state["grads"]):
+            p.grad = None if g is None else Tensor(g, stop_gradient=True)
+        for b, v in zip(self.buffers, state["buffers"]):
+            b.value = v
+        for opt, os in zip(self.optimizers, state["opt"]):
+            opt._eager_state = dict(os)
+
+
+def to_static(function: Optional[Callable] = None, *, layers=None,
+              optimizers=None, donate_state: bool = True):
+    """Compile a dygraph function into one XLA computation.
+
+    - forward-only: ``fast = to_static(model)`` or
+      ``@to_static(layers=[model])`` — params thread automatically.
+    - train step: ``@to_static(layers=[model], optimizers=[opt])`` around a
+      function that calls backward() and opt.step(); param/accumulator
+      updates happen inside the compiled computation.
+
+    Inputs may be Tensors or arrays; outputs mirror the function's returns
+    with Tensors for traced arrays. Retraces on new input shapes/dtypes.
+    """
+    if function is not None and isinstance(function, Layer) and layers is None:
+        layer = function
+        return to_static(lambda *a, **kw: layer(*a, **kw), layers=[layer],
+                         optimizers=optimizers, donate_state=donate_state)
+
+    def deco(fn):
+        spec_holder = {}
+
+        def get_spec():
+            if "spec" not in spec_holder:
+                spec_holder["spec"] = _StateSpec(layers or [],
+                                                 optimizers or [])
+            return spec_holder["spec"]
+
+        compiled_holder = {}
+
+        def make_compiled(grads_present):
+            def traced(state, args):
+                spec = get_spec()
+                spec.load(state)
+                targs = jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), args)
+                out = fn(*targs)
+                out_arrays = jax.tree_util.tree_map(
+                    lambda t: t.value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+                new_state = spec.snapshot()
+                return out_arrays, new_state
+            donate = (0,) if donate_state else ()
+            return jax.jit(traced, donate_argnums=donate)
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            spec = get_spec()
+            state = spec.snapshot()
+            grads_present = tuple(g is not None for g in state["grads"])
+            key = grads_present
+            if key not in compiled_holder:
+                compiled_holder[key] = make_compiled(grads_present)
+            arr_args = jax.tree_util.tree_map(
+                lambda a: a.value if isinstance(a, Tensor) else jnp.asarray(a),
+                tuple(args),
+                is_leaf=lambda t: isinstance(t, Tensor))
+            try:
+                out_arrays, new_state = compiled_holder[key](state, arr_args)
+            except Exception:
+                # tracing assigns tracers into the eager Parameters; if the
+                # user fn raised mid-trace, restore concrete state so the
+                # model isn't left holding dead tracers
+                spec.load(state)
+                raise
+            spec.load(new_state)
+            return jax.tree_util.tree_map(
+                lambda a: Tensor(a, stop_gradient=True) if isinstance(
+                    a, jax.Array) else a, out_arrays)
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def save(layer, path: str, input_spec=None):
+    """jit.save analog: persist a Layer's state dict + a traced Program is
+    future work; state dict + config restores via jit.load."""
+    from .framework_io import save_state_dict
+    save_state_dict(layer.state_dict(), path + ".pdparams")
+
+
+def load(path: str):
+    from .framework_io import load_state_dict
+    return load_state_dict(path + ".pdparams")

@@ -1,0 +1,15 @@
+"""paddle_tpu.nn — the 2.0 layer API (analog of python/paddle/nn/)."""
+
+from ..dygraph.layers import Layer, LayerList, ParameterList, Sequential
+from . import functional
+from .layers_common import (
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm, BatchNorm1D, BatchNorm2D,
+    BatchNorm3D, BCEWithLogitsLoss, Conv2D, Conv2DTranspose,
+    CrossEntropyLoss, Dropout, ELU, Embedding, Flatten, GELU, GroupNorm,
+    Hardsigmoid, Hardswish, KLDivLoss, L1Loss, LayerNorm, LeakyReLU, Linear,
+    LogSoftmax, MaxPool2D, MSELoss, NLLLoss, Pad2D, ReLU, ReLU6, Sigmoid,
+    SiLU, SmoothL1Loss, Softmax, Softplus, Swish, SyncBatchNorm, Tanh,
+    Upsample)
+from .transformer import (MultiHeadAttention, Transformer,
+                          TransformerDecoder, TransformerDecoderLayer,
+                          TransformerEncoder, TransformerEncoderLayer)

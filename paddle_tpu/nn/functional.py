@@ -1,0 +1,379 @@
+"""nn.functional — eager functional ops over dygraph Tensors.
+
+Analog of paddle.nn.functional (python/paddle/nn/functional/). Dispatches
+through the dygraph tracer so autograd and AMP work; under jit tracing
+these become pure jnp calls fused by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..dygraph.tape import run_op
+from ..dygraph.tensor import Tensor
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# -- activations -------------------------------------------------------------
+
+def relu(x):
+    return run_op("relu", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def relu6(x):
+    return run_op("relu6", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def gelu(x, approximate: bool = False):
+    return run_op("gelu", {"X": [_t(x)]},
+                  {"approximate": approximate})["Out"][0]
+
+
+def sigmoid(x):
+    return run_op("sigmoid", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def tanh(x):
+    return run_op("tanh", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def softmax(x, axis: int = -1):
+    return run_op("softmax", {"X": [_t(x)]}, {"axis": axis})["Out"][0]
+
+
+def log_softmax(x, axis: int = -1):
+    return run_op("log_softmax", {"X": [_t(x)]}, {"axis": axis})["Out"][0]
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return run_op("leaky_relu", {"X": [_t(x)]},
+                  {"alpha": negative_slope})["Out"][0]
+
+
+def elu(x, alpha: float = 1.0):
+    return run_op("elu", {"X": [_t(x)]}, {"alpha": alpha})["Out"][0]
+
+
+def silu(x):
+    return run_op("silu", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def swish(x):
+    return run_op("swish", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def hardswish(x):
+    return run_op("hard_swish", {"X": [_t(x)]}, {})["Out"][0]
+
+
+def hardsigmoid(x):
+    return run_op("hard_sigmoid", {"X": [_t(x)]},
+                  {"slope": 1.0 / 6, "offset": 0.5})["Out"][0]
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    return run_op("softplus", {"X": [_t(x)]},
+                  {"beta": beta, "threshold": threshold})["Out"][0]
+
+
+def prelu(x, weight, data_format="NCHW"):
+    mode = "all" if weight.size == 1 else "channel"
+    return run_op("prelu", {"X": [_t(x)], "Alpha": [_t(weight)]},
+                  {"mode": mode})["Out"][0]
+
+
+# -- linear / conv -----------------------------------------------------------
+
+def linear(x, weight, bias=None):
+    out = run_op("matmul_v2", {"X": [_t(x)], "Y": [_t(weight)]}, {})["Out"][0]
+    if bias is not None:
+        out = run_op("elementwise_add", {"X": [out], "Y": [_t(bias)]},
+                     {"axis": -1})["Out"][0]
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW"):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    out = run_op("conv2d", {"Input": [_t(x)], "Filter": [_t(weight)]},
+                 {"strides": _pair(stride), "paddings": _pair(padding),
+                  "dilations": _pair(dilation), "groups": groups,
+                  "data_format": data_format})["Output"][0]
+    if bias is not None:
+        axis = 1 if data_format == "NCHW" else 3
+        out = run_op("elementwise_add", {"X": [out], "Y": [_t(bias)]},
+                     {"axis": axis})["Out"][0]
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     dilation=1, groups: int = 1):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    out = run_op("conv2d_transpose",
+                 {"Input": [_t(x)], "Filter": [_t(weight)]},
+                 {"strides": _pair(stride), "paddings": _pair(padding),
+                  "dilations": _pair(dilation),
+                  "groups": groups})["Output"][0]
+    if bias is not None:
+        out = run_op("elementwise_add", {"X": [out], "Y": [_t(bias)]},
+                     {"axis": 1})["Out"][0]
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return run_op("pool2d", {"X": [_t(x)]},
+                  {"pooling_type": "max", "ksize": _pair(kernel_size),
+                   "strides": _pair(stride or kernel_size),
+                   "paddings": _pair(padding),
+                   "ceil_mode": ceil_mode})["Out"][0]
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return run_op("pool2d", {"X": [_t(x)]},
+                  {"pooling_type": "avg", "ksize": _pair(kernel_size),
+                   "strides": _pair(stride or kernel_size),
+                   "paddings": _pair(padding), "ceil_mode": ceil_mode,
+                   "exclusive": exclusive})["Out"][0]
+
+
+def adaptive_avg_pool2d(x, output_size):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return run_op("pool2d", {"X": [_t(x)]},
+                  {"pooling_type": "avg", "ksize": _pair(output_size),
+                   "adaptive": True})["Out"][0]
+
+
+def embedding(x, weight, padding_idx: Optional[int] = None, sparse=False):
+    if padding_idx is None:
+        pidx = -1
+    elif padding_idx < 0:
+        pidx = weight.shape[0] + padding_idx
+    else:
+        pidx = padding_idx
+    return run_op("lookup_table_v2", {"W": [_t(weight)], "Ids": [_t(x)]},
+                  {"padding_idx": pidx})["Out"][0]
+
+
+# -- norm --------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None,
+               epsilon: float = 1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = _t(x).ndim - len(normalized_shape)
+    ins = {"X": [_t(x)]}
+    if weight is not None:
+        ins["Scale"] = [_t(weight)]
+    if bias is not None:
+        ins["Bias"] = [_t(bias)]
+    return run_op("layer_norm", ins,
+                  {"epsilon": epsilon, "begin_norm_axis": begin})["Y"][0]
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    outs = run_op("batch_norm",
+                  {"X": [_t(x)], "Scale": [_t(weight)], "Bias": [_t(bias)],
+                   "Mean": [_t(running_mean)], "Variance": [_t(running_var)]},
+                  {"momentum": momentum, "epsilon": epsilon,
+                   "is_test": not training, "data_format": data_format})
+    if training:
+        running_mean.set_value(outs["MeanOut"][0].value)
+        running_var.set_value(outs["VarianceOut"][0].value)
+    return outs["Y"][0]
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    ins = {"X": [_t(x)]}
+    if weight is not None:
+        ins["Scale"] = [_t(weight)]
+    if bias is not None:
+        ins["Bias"] = [_t(bias)]
+    return run_op("group_norm", ins,
+                  {"groups": num_groups, "epsilon": epsilon})["Y"][0]
+
+
+def dropout(x, p: float = 0.5, training: bool = True,
+            mode: str = "upscale_in_train"):
+    return run_op("dropout", {"X": [_t(x)]},
+                  {"dropout_prob": p, "is_test": not training,
+                   "dropout_implementation": mode})["Out"][0]
+
+
+# -- losses ------------------------------------------------------------------
+
+def cross_entropy(input, label, soft_label: bool = False,
+                  ignore_index: int = -100, reduction: str = "mean",
+                  axis: int = -1):
+    outs = run_op("softmax_with_cross_entropy",
+                  {"Logits": [_t(input)], "Label": [_t(label)]},
+                  {"soft_label": soft_label, "ignore_index": ignore_index,
+                   "axis": axis})
+    loss = outs["Loss"][0]
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    out = run_op("mse_loss", {"Input": [_t(input)], "Label": [_t(label)]},
+                 {})["Out"][0]
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    d = run_op("elementwise_sub", {"X": [_t(input)], "Y": [_t(label)]},
+               {})["Out"][0]
+    out = run_op("abs", {"X": [d]}, {})["Out"][0]
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction: str = "mean"):
+    out = run_op("sigmoid_cross_entropy_with_logits",
+                 {"X": [_t(logit)], "Label": [_t(label)]},
+                 {"ignore_index": -100})["Out"][0]
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def nll_loss(input, label, reduction: str = "mean"):
+    # input is log-probabilities; stay on traced ops so jit.to_static works
+    it = _t(input)
+    lt = _t(label)
+    n = it.shape[0]
+    rows = Tensor(np.arange(n, dtype=np.int64))
+    if lt.ndim > 1:
+        lt = lt.reshape([n])
+    idx = run_op("stack", {"X": [rows, lt]}, {"axis": -1})["Y"][0]
+    picked = run_op("gather_nd", {"X": [it], "Index": [idx]}, {})["Out"][0]
+    loss = -picked
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def kl_div(input, label, reduction: str = "mean"):
+    return run_op("kldiv_loss", {"X": [_t(input)], "Target": [_t(label)]},
+                  {"reduction": reduction})["Loss"][0]
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    out = run_op("huber_loss", {"X": [_t(input)], "Y": [_t(label)]},
+                 {"delta": delta})["Out"][0]
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    ins = {"X": [_t(label)]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [_t(prior_dist)]
+    return run_op("label_smooth", ins, {"epsilon": epsilon})["Out"][0]
+
+
+def one_hot(x, num_classes):
+    return run_op("one_hot_v2", {"X": [_t(x)]},
+                  {"depth": num_classes})["Out"][0]
+
+
+# -- attention ---------------------------------------------------------------
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None,
+                                 dropout_p: float = 0.0,
+                                 is_causal: bool = False,
+                                 training: bool = True):
+    """Fused attention entry point. Uses the pallas flash-attention kernel
+    when available on TPU for long sequences; otherwise the XLA-composed
+    softmax(qk^T/sqrt(d))v. q/k/v: [batch, heads, seq, head_dim]."""
+    qt, kt, vt = _t(q), _t(k), _t(v)
+    if dropout_p > 0.0 and training:
+        # composed path: dropout on the probabilities must be a real op so
+        # its mask replays in the backward pass
+        import math as _math
+        scale = 1.0 / _math.sqrt(qt.shape[-1])
+        ktt = kt.transpose([0, 1, 3, 2])
+        logits = run_op("matmul_v2", {"X": [qt], "Y": [ktt]}, {})["Out"][0]
+        logits = logits * scale
+        if is_causal:
+            s_q, s_k = logits.shape[-2], logits.shape[-1]
+            cm = np.triu(np.full((s_q, s_k), np.finfo(np.float32).min,
+                                 np.float32), 1)
+            logits = logits + Tensor(cm)
+        if attn_mask is not None:
+            logits = logits + _t(attn_mask)
+        probs = softmax(logits, axis=-1)
+        probs = dropout(probs, dropout_p, training=True)
+        return run_op("matmul_v2", {"X": [probs], "Y": [vt]}, {})["Out"][0]
+    ins = {"Q": [qt], "K": [kt], "V": [vt]}
+    if attn_mask is not None:
+        ins["Mask"] = [_t(attn_mask)]
+    return run_op("fused_attention_qkv", ins, {"causal": is_causal})["Out"][0]
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCDHW"):
+    xt = _t(x)
+    if len(pad) == 4 and xt.ndim == 4:
+        return run_op("pad2d", {"X": [xt]},
+                      {"paddings": [pad[2], pad[3], pad[0], pad[1]],
+                       "mode": mode, "pad_value": value})["Out"][0]
+    if len(pad) == 6 and xt.ndim == 5:
+        return run_op("pad3d", {"X": [xt]},
+                      {"paddings": list(pad), "mode": mode,
+                       "value": value})["Out"][0]
+    cfg = [0] * (2 * xt.ndim)
+    # paddle pad spec is last-dim-first pairs
+    nd = len(pad) // 2
+    for i in range(nd):
+        ax = xt.ndim - 1 - i
+        cfg[2 * ax] = pad[2 * i]
+        cfg[2 * ax + 1] = pad[2 * i + 1]
+    return run_op("pad", {"X": [xt]},
+                  {"paddings": cfg, "pad_value": value})["Out"][0]
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    raise NotImplementedError("unfold: planned with pallas im2col")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest"):
+    xt = _t(x)
+    n, c, h, w = xt.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor, scale_factor]
+        size = [int(h * sf[0]), int(w * sf[1])]
+    op = {"nearest": "nearest_interp_v2", "bilinear": "bilinear_interp_v2",
+          "bicubic": "bicubic_interp_v2"}[mode]
+    return run_op(op, {"X": [xt]},
+                  {"out_h": int(size[0]), "out_w": int(size[1])})["Out"][0]

@@ -10,3 +10,5 @@ from . import nn_ops  # noqa: F401
 from . import reduce_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import attention_ops  # noqa: F401
+from . import collective_ops  # noqa: F401

@@ -1,0 +1,60 @@
+"""Fused attention ops.
+
+The reference's only fused attention is the inference-only
+multihead_matmul (paddle/fluid/operators/fused/multihead_matmul_op.cc:118);
+training attention is composed in python (nn/layer/transformer.py:68).
+Here fused attention is first-class and differentiable: one op the
+executor can lower either to an XLA-composed softmax(qk)v (fused well by
+XLA) or to the pallas flash-attention kernel (ops/pallas/) for long
+sequences. Dropout inside attention is intentionally NOT part of this op
+(masks wouldn't replay under the vjp-derived grad); callers compose a
+dropout op on the probabilities when needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# Toggled by paddle_tpu.flags: use pallas flash attention when beneficial.
+_PALLAS_MIN_SEQ = 1024
+
+
+def _composed_attention(q, k, v, mask, causal, scale):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+        logits = jnp.where(causal_mask, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+@register("fused_attention_qkv", no_grad_slots=("Mask",))
+def _fused_attention_qkv(ctx, ins, attrs):
+    """q/k/v: [batch, heads, seq, head_dim]. Mask broadcastable to
+    [batch, heads, q_seq, k_seq] (additive, -inf for masked)."""
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale") or (1.0 / math.sqrt(q.shape[-1]))
+
+    use_pallas = (attrs.get("use_pallas", "auto") != "never"
+                  and q.shape[-2] >= _PALLAS_MIN_SEQ
+                  and mask is None)
+    if use_pallas:
+        try:
+            from .pallas.flash_attention import flash_attention
+        except ImportError:
+            flash_attention = None
+        if flash_attention is not None:
+            return {"Out": [flash_attention(q, k, v, causal=causal,
+                                            scale=scale)]}
+    return {"Out": [_composed_attention(q, k, v, mask, causal, scale)]}

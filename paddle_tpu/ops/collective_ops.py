@@ -1,0 +1,225 @@
+"""Collective op lowerings — NCCL c_* ops become XLA collectives.
+
+Analog of paddle/fluid/operators/collective/ (c_allreduce_op.h:109,
+c_broadcast_op, c_allgather_op, c_reducescatter_op, c_comm_init_op.cc,
+barrier_op...). The reference launches ncclAllReduce on per-ring comms;
+here each op lowers to a jax.lax collective bound to a mesh axis. The
+``ring_id`` attr maps to an axis name through the LoweringContext's
+axis_env (set by the parallel executor / shard_map runner) or the global
+distributed env — the direct translation of the reference's
+ring_id -> NCCLComm registry (platform/collective_helper.h:62).
+
+Outside any mesh (single-process eager), collectives are identity —
+matching the reference's single-trainer behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import LoweringContext, register
+
+
+def _axis(ctx: LoweringContext, attrs) -> str | None:
+    ring = attrs.get("ring_id", 0)
+    ax = ctx.axis_name(ring)
+    if ax is None:
+        from ..distributed import env as dist_env
+        ax = dist_env.axis_for_ring(ring)
+    return ax
+
+
+def _allreduce(name, op):
+    @register(name)
+    def _lower(ctx, ins, attrs, _op=op):
+        x = ins["X"][0]
+        ax = _axis(ctx, attrs)
+        if ax is None:
+            return {"Out": [x]}
+        if _op == "sum":
+            return {"Out": [jax.lax.psum(x, ax)]}
+        if _op == "max":
+            return {"Out": [jax.lax.pmax(x, ax)]}
+        if _op == "min":
+            return {"Out": [jax.lax.pmin(x, ax)]}
+        if _op == "prod":
+            # no native pprod; log-space would lose sign — use all_gather
+            g = jax.lax.all_gather(x, ax)
+            return {"Out": [jnp.prod(g, axis=0)]}
+        if _op == "avg":
+            return {"Out": [jax.lax.pmean(x, ax)]}
+        raise ValueError(_op)
+    return _lower
+
+
+_allreduce("c_allreduce_sum", "sum")
+_allreduce("c_allreduce_max", "max")
+_allreduce("c_allreduce_min", "min")
+_allreduce("c_allreduce_prod", "prod")
+_allreduce("c_allreduce_avg", "avg")
+_allreduce("allreduce", "sum")  # legacy operators/collective/allreduce_op
+
+
+@register("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    root = attrs.get("root", 0)
+    # broadcast = select root's shard on every device
+    src = jax.lax.all_gather(x, ax)
+    return {"Out": [src[root]]}
+
+
+@register("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    g = jax.lax.all_gather(x, ax)  # [n, ...]
+    return {"Out": [g.reshape((-1,) + x.shape[1:])]}
+
+
+@register("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [jax.lax.psum_scatter(x, ax, tiled=True)]}
+
+
+@register("c_reduce_sum")
+def _c_reduce_sum(ctx, ins, attrs):
+    # reduce-to-root: psum everywhere, callers on non-root ignore (XLA has
+    # no rooted reduce; GSPMD would DCE unused results)
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [jax.lax.psum(x, ax)]}
+
+
+@register("c_scatter")
+def _c_scatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    nranks = attrs.get("nranks", 1)
+    idx = jax.lax.axis_index(ax)
+    chunk = x.shape[0] // nranks
+    return {"Out": [jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, 0)]}
+
+
+@register("c_concat")
+def _c_concat(ctx, ins, attrs):
+    return _c_allgather(ctx, ins, attrs)
+
+
+@register("c_split")
+def _c_split(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    nranks = attrs.get("nranks", 1)
+    idx = jax.lax.axis_index(ax)
+    chunk = x.shape[-1] // nranks
+    return {"Out": [jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, -1)]}
+
+
+@register("c_identity")
+def _c_identity(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("c_sync_calc_stream", not_differentiable=True)
+def _c_sync_calc(ctx, ins, attrs):
+    # stream sync is a no-op under XLA's dataflow execution model
+    return {"Out": [ins["X"][0]]}
+
+
+@register("c_sync_comm_stream", not_differentiable=True)
+def _c_sync_comm(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("barrier", not_differentiable=True)
+def _barrier(ctx, ins, attrs):
+    x = ins["X"][0] if ins.get("X") else jnp.zeros((1,), jnp.float32)
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    # a psum forces a rendezvous on the axis
+    return {"Out": [x + 0 * jax.lax.psum(jnp.zeros((), x.dtype), ax)]}
+
+
+@register("c_embedding", no_grad_slots=("Ids",))
+def _c_embedding(ctx, ins, attrs):
+    """Vocab-sharded embedding lookup (model parallel): each rank holds a
+    vocab shard; out-of-shard ids produce zeros, psum combines."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ax = _axis(ctx, attrs)
+    start = attrs.get("start_index", 0)
+    if ax is None:
+        return {"Out": [jnp.take(w, ids - start, axis=0)]}
+    vocab_per = w.shape[0]
+    local = ids - start
+    in_range = (local >= 0) & (local < vocab_per)
+    safe = jnp.clip(local, 0, vocab_per - 1)
+    emb = jnp.take(w, safe, axis=0)
+    emb = jnp.where(in_range[..., None], emb, 0.0)
+    return {"Out": [jax.lax.psum(emb, ax)]}
+
+
+@register("partial_allgather")
+def _partial_allgather(ctx, ins, attrs):
+    return _c_allgather(ctx, ins, attrs)
+
+
+@register("sync_batch_norm", no_grad_slots=("Mean", "Variance"),
+          nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                           "SavedVariance", "ReserveSpace"))
+def _sync_batch_norm(ctx, ins, attrs):
+    """Cross-replica batch norm (reference operators/sync_batch_norm_op.cu):
+    batch statistics psum'd over the data-parallel axis; grads flow via the
+    generic vjp (the psum's transpose is psum — correct cross-replica
+    gradient)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    ax = _axis(ctx, attrs)
+    caxis = 1 if attrs.get("data_format", "NCHW") == "NCHW" else x.ndim - 1
+    raxes = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = [1] * x.ndim
+    bshape[caxis] = x.shape[caxis]
+
+    if is_test or attrs.get("use_global_stats", False):
+        m, v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        cnt = 1.0
+        for i in raxes:
+            cnt *= x.shape[i]
+        s = jnp.sum(x, axis=raxes)
+        sq = jnp.sum(jnp.square(x), axis=raxes)
+        if ax is not None:
+            s = jax.lax.psum(s, ax)
+            sq = jax.lax.psum(sq, ax)
+            cnt = jax.lax.psum(jnp.asarray(cnt, x.dtype), ax)
+        m = s / cnt
+        v = sq / cnt - m * m
+        mean_out = momentum * mean + (1 - momentum) * m
+        var_out = momentum * var + (1 - momentum) * v
+    inv = jax.lax.rsqrt(v + eps)
+    y = (x - m.reshape(bshape)) * inv.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [m], "SavedVariance": [v]}

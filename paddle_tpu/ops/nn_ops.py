@@ -526,3 +526,27 @@ def _auc(ctx, ins, attrs):
     auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
     return {"AUC": [auc.astype(jnp.float64) if auc.dtype == jnp.float64 else auc.astype(jnp.float32)],
             "StatPosOut": [stat_pos], "StatNegOut": [stat_neg]}
+
+
+def _interp(name, method):
+    @register(name)
+    def _lower(ctx, ins, attrs, _method=method):
+        """reference operators/interpolate_op.cc — resize via jax.image
+        (differentiable; vjp gives the adjoint resize)."""
+        x = ins["X"][0]  # NCHW
+        out_h = attrs.get("out_h", -1)
+        out_w = attrs.get("out_w", -1)
+        scale = attrs.get("scale", 0.0)
+        if (out_h is None or out_h <= 0) and scale:
+            out_h = int(x.shape[2] * scale)
+            out_w = int(x.shape[3] * scale)
+        shape = (x.shape[0], x.shape[1], int(out_h), int(out_w))
+        return {"Out": [jax.image.resize(x, shape, method=_method)]}
+    return _lower
+
+
+_interp("nearest_interp_v2", "nearest")
+_interp("bilinear_interp_v2", "linear")
+_interp("bicubic_interp_v2", "cubic")
+_interp("nearest_interp", "nearest")
+_interp("bilinear_interp", "linear")

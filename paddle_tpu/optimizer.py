@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .framework import unique_name
 from .framework.backward import append_backward
+from . import optimizer_lr as lr  # paddle.optimizer.lr namespace
 from .framework.program import (Variable, default_main_program,
                                 default_startup_program)
 from .layers.tensor import create_global_var
@@ -30,6 +31,14 @@ class GradientClipByValue(GradClipBase):
     def __init__(self, max, min=None):  # noqa: A002
         self.max = float(max)
         self.min = float(min) if min is not None else -float(max)
+
+    def _clip_eager(self, params):
+        import jax.numpy as jnp
+        from .dygraph.tensor import Tensor
+        for p in params:
+            if p.grad is not None:
+                p.grad = Tensor(jnp.clip(p.grad.value, self.min, self.max),
+                                stop_gradient=True)
 
     def _clip_static(self, params_grads, block):
         out = []
@@ -46,6 +55,18 @@ class GradientClipByValue(GradClipBase):
 class GradientClipByNorm(GradClipBase):
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
+
+    def _clip_eager(self, params):
+        import jax.numpy as jnp
+        from .dygraph.tensor import Tensor
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad.value
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            p.grad = Tensor(g * scale, stop_gradient=True)
 
     def _clip_static(self, params_grads, block):
         out = []
@@ -64,6 +85,19 @@ class GradientClipByGlobalNorm(GradClipBase):
 
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
+
+    def _clip_eager(self, params):
+        import jax.numpy as jnp
+        from .dygraph.tensor import Tensor
+        gs = [p.grad.value for p in params if p.grad is not None]
+        if not gs:
+            return
+        total = sum(jnp.sum(jnp.square(g)) for g in gs)
+        norm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+        for p in params:
+            if p.grad is not None:
+                p.grad = Tensor(p.grad.value * scale, stop_gradient=True)
 
     def _clip_static(self, params_grads, block):
         sq_names = []
@@ -105,21 +139,69 @@ class GradientClipByGlobalNorm(GradClipBase):
         return out
 
 
+# Declarative spec for the eager (dygraph) step path: per op type, the
+# accumulator slots (slot name, accum key, init, shape override) and the
+# output->state writeback map. Drives Optimizer.step() through the same
+# op lowerings the static executor uses.
+_EAGER_SPECS = {
+    "sgd": dict(accums=[], outs={"ParamOut": "param"}),
+    "momentum": dict(accums=[("Velocity", "velocity", 0.0, None)],
+                     outs={"ParamOut": "param", "VelocityOut": "velocity"}),
+    "lars_momentum": dict(accums=[("Velocity", "velocity", 0.0, None)],
+                          outs={"ParamOut": "param",
+                                "VelocityOut": "velocity"}),
+    "adagrad": dict(accums=[("Moment", "moment", 0.0, None)],
+                    outs={"ParamOut": "param", "MomentOut": "moment"}),
+    "adam": dict(accums=[("Moment1", "m1", 0.0, None),
+                         ("Moment2", "m2", 0.0, None),
+                         ("Beta1Pow", "b1p", 1.0, (1,)),
+                         ("Beta2Pow", "b2p", 1.0, (1,))],
+                 outs={"ParamOut": "param", "Moment1Out": "m1",
+                       "Moment2Out": "m2", "Beta1PowOut": "b1p",
+                       "Beta2PowOut": "b2p"}),
+    "rmsprop": dict(accums=[("MeanSquare", "ms", 0.0, None),
+                            ("Moment", "mom", 0.0, None)],
+                    outs={"ParamOut": "param", "MeanSquareOut": "ms",
+                          "MomentOut": "mom"}),
+    "ftrl": dict(accums=[("SquaredAccumulator", "sq", 0.0, None),
+                         ("LinearAccumulator", "lin", 0.0, None)],
+                 outs={"ParamOut": "param", "SquaredAccumOut": "sq",
+                       "LinearAccumOut": "lin"}),
+}
+_EAGER_SPECS["adamw"] = _EAGER_SPECS["adam"]
+_EAGER_SPECS["lamb"] = _EAGER_SPECS["adam"]
+
+
 class Optimizer:
-    """Base (analog of fluid/optimizer.py:56)."""
+    """Base (analog of fluid/optimizer.py:56).
+
+    Serves both modes: ``minimize(loss)`` rewrites a static Program;
+    ``step()`` applies updates eagerly to dygraph Parameters passed via
+    ``parameters=``/``parameter_list`` (2.0 paddle.optimizer surface).
+    """
 
     _accum_specs: Sequence[Tuple[str, float]] = ()  # (name, init value)
+    _eager_op: Optional[str] = None  # op type for the eager step path
 
     def __init__(self, learning_rate=0.001, parameter_list=None,
-                 regularization=None, grad_clip: Optional[GradClipBase] = None,
+                 parameters=None, regularization=None, weight_decay=None,
+                 grad_clip: Optional[GradClipBase] = None,
                  name: Optional[str] = None):
         self._learning_rate = learning_rate
-        self._parameter_list = parameter_list
+        self._parameter_list = (list(parameters) if parameters is not None
+                                else (list(parameter_list)
+                                      if parameter_list is not None else None))
+        if regularization is None and weight_decay is not None and \
+                not isinstance(weight_decay, float):
+            regularization = weight_decay
+        elif regularization is None and isinstance(weight_decay, float):
+            regularization = L2Decay(weight_decay)
         self.regularization = regularization
         self._grad_clip = grad_clip
         self._name = name or type(self).__name__
         self._lr_var: Optional[Variable] = None
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._eager_state: Dict[tuple, object] = {}
         self.helper = None
 
     # -- learning rate -----------------------------------------------------
@@ -264,6 +346,102 @@ class Optimizer:
         opt_ops = self.apply_gradients(params_grads)
         return opt_ops, params_grads
 
+    # -- dygraph (2.0) eager path -----------------------------------------
+    def _eager_attrs(self) -> dict:
+        return {}
+
+    def _current_lr(self) -> float:
+        lr = self._learning_rate
+        from .optimizer_lr import LRScheduler
+        if isinstance(lr, LRScheduler):
+            return float(lr())
+        return float(lr)
+
+    def get_lr(self) -> float:
+        return self._current_lr()
+
+    @property
+    def _parameters_or_raise(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "eager step() requires parameters= at construction "
+                "(2.0 dygraph mode)")
+        return self._parameter_list
+
+    def step(self):
+        """Apply one eager update to all dygraph parameters with grads."""
+        import jax.numpy as jnp
+        from .ops import registry as _reg
+        op_type = self._eager_op
+        if op_type is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no eager step path")
+        spec = _EAGER_SPECS[op_type]
+        ctx = _reg.LoweringContext(eager=True)
+        if self._grad_clip is not None:
+            self._grad_clip._clip_eager(self._parameters_or_raise)
+        lr = self._current_lr()
+        for p in self._parameters_or_raise:
+            if p.grad is None or not getattr(p, "trainable", True):
+                continue
+            g = p.grad.value
+            # per-param regularization (L2/L1 decay into the gradient)
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None and op_type != "adamw":
+                kind, coeff = (reg if isinstance(reg, tuple)
+                               else (reg.kind, reg.coeff))
+                if kind == "l2":
+                    g = g + coeff * p.value
+                elif kind == "l1":
+                    g = g + coeff * jnp.sign(p.value)
+            lr_arr = jnp.asarray([lr * getattr(p, "lr_scale", 1.0)],
+                                 jnp.float32)
+            ins = {"Param": [p.value], "Grad": [g], "LearningRate": [lr_arr]}
+            for slot, key, init, shape in spec["accums"]:
+                skey = (id(p), key)
+                if skey not in self._eager_state:
+                    self._eager_state[skey] = jnp.full(
+                        shape or p.value.shape, init, p.value.dtype)
+                ins[slot] = [self._eager_state[skey]]
+            outs = _reg.execute(ctx, op_type, ins, self._eager_attrs())
+            for oslot, target in spec["outs"].items():
+                val = outs[oslot][0]
+                if target == "param":
+                    p.value = val
+                else:
+                    self._eager_state[(id(p), target)] = val
+    def clear_grad(self):
+        for p in self._parameters_or_raise:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self) -> dict:
+        """Accumulator state keyed by PARAMETER NAME (stable across
+        processes when models are built in the same order)."""
+        by_id = {id(p): p.name for p in (self._parameter_list or [])}
+        out = {"_lr": self._current_lr()}
+        for (pid, key), v in self._eager_state.items():
+            pname = by_id.get(pid, str(pid))
+            out[f"{pname}:{key}"] = v
+        return out
+
+    def set_state_dict(self, state: dict):
+        import jax.numpy as jnp
+        by_name = {p.name: p for p in (self._parameter_list or [])}
+        for k, v in state.items():
+            if k == "_lr":
+                from .optimizer_lr import LRScheduler
+                if not isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate = float(v)
+                continue
+            pname, _, key = k.rpartition(":")
+            p = by_name.get(pname)
+            if p is not None:
+                self._eager_state[(id(p), key)] = jnp.asarray(v)
+
+    load_state_dict = set_state_dict
+
     def _lr_input(self, param) -> Variable:
         """Per-param lr (honors ParamAttr.learning_rate scale)."""
         lr = self._create_lr_var()
@@ -280,6 +458,8 @@ class Optimizer:
 
 
 class SGDOptimizer(Optimizer):
+    _eager_op = "sgd"
+
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
         return block.append_op(
@@ -293,6 +473,10 @@ class MomentumOptimizer(Optimizer):
         super().__init__(learning_rate, **kw)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._eager_op = "momentum"
+
+    def _eager_attrs(self):
+        return {"mu": self._momentum, "use_nesterov": self._use_nesterov}
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -317,6 +501,11 @@ class LarsMomentumOptimizer(Optimizer):
         self._momentum = momentum
         self._lars_coeff = lars_coeff
         self._lars_weight_decay = lars_weight_decay
+        self._eager_op = "lars_momentum"
+
+    def _eager_attrs(self):
+        return {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay}
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -336,9 +525,14 @@ class LarsMomentumOptimizer(Optimizer):
 
 
 class AdagradOptimizer(Optimizer):
+    _eager_op = "adagrad"
+
     def __init__(self, learning_rate, epsilon=1e-6, **kw):
         super().__init__(learning_rate, **kw)
         self._epsilon = epsilon
+
+    def _eager_attrs(self):
+        return {"epsilon": self._epsilon}
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -357,6 +551,7 @@ class AdagradOptimizer(Optimizer):
 
 class AdamOptimizer(Optimizer):
     _op_type = "adam"
+    _eager_op = "adam"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_mode=False, **kw):
@@ -372,6 +567,12 @@ class AdamOptimizer(Optimizer):
 
     def _extra_attrs(self):
         return {}
+
+    def _eager_attrs(self):
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs())
+        return attrs
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -396,6 +597,7 @@ class AdamOptimizer(Optimizer):
 
 class AdamWOptimizer(AdamOptimizer):
     _op_type = "adamw"
+    _eager_op = "adamw"
 
     def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
         super().__init__(learning_rate, **kw)
@@ -407,6 +609,7 @@ class AdamWOptimizer(AdamOptimizer):
 
 class LambOptimizer(AdamOptimizer):
     _op_type = "lamb"
+    _eager_op = "lamb"
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
@@ -424,6 +627,12 @@ class RMSPropOptimizer(Optimizer):
         super().__init__(learning_rate, **kw)
         self._rho, self._epsilon = rho, epsilon
         self._momentum, self._centered = momentum, centered
+        if not centered:
+            self._eager_op = "rmsprop"
+
+    def _eager_attrs(self):
+        return {"decay": self._rho, "epsilon": self._epsilon,
+                "momentum": self._momentum, "centered": self._centered}
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -455,6 +664,10 @@ class FtrlOptimizer(Optimizer):
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
         super().__init__(learning_rate, **kw)
         self._l1, self._l2, self._lr_power = l1, l2, lr_power
+        self._eager_op = "ftrl"
+
+    def _eager_attrs(self):
+        return {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power}
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
