@@ -21,6 +21,9 @@ from .param_attr import ParamAttr
 
 # 2.0 surface
 from . import nn
+from . import distributed
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .dygraph.parallel import DataParallel
 from . import amp
 from . import jit
 from .dygraph import no_grad, to_tensor, to_variable

@@ -1,0 +1,128 @@
+"""Static-graph AMP: program rewrite to bf16.
+
+Analog of python/paddle/fluid/contrib/mixed_precision/fp16_utils.py:190
+(rewrite_program) + decorator.py:218 (decorate). Walks the forward program
+inserting cast ops so white-list ops (matmul/conv) consume bf16 while
+black-list ops (softmax/norm/reductions) stay float32. Parameters remain
+float32 masters; casts are real ops the backward pass differentiates
+through (cast_grad casts cotangents back).
+
+On TPU bf16 needs no loss scaling (f32 exponent range); the
+check_finite_and_unscale/update_loss_scaling ops are provided for parity
+and for f16 experiments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..framework import unique_name
+from ..framework.program import Block, Program
+from .lists import AutoMixedPrecisionLists
+
+_FLOAT = ("float32", "float64")
+
+
+def _cast_input(block: Block, op_idx: int, op, slot: str, name: str,
+                dst_dtype: str, cast_cache: Dict[str, str]) -> int:
+    """Insert a cast op before op_idx; returns ops inserted (0 or 1)."""
+    key = f"{name}->{dst_dtype}"
+    if key in cast_cache:
+        new_name = cast_cache[key]
+        op.inputs[slot] = [new_name if n == name else n
+                           for n in op.inputs[slot]]
+        return 0
+    try:
+        v = block.var(name)
+        src_dtype = v.dtype
+    except KeyError:
+        src_dtype = "float32"
+    if src_dtype not in _FLOAT and src_dtype != "bfloat16":
+        return 0
+    if src_dtype == dst_dtype:
+        return 0
+    new_name = unique_name.generate(f"{name}.cast_{dst_dtype}")
+    block.create_var(new_name, dtype=dst_dtype, stop_gradient=True)
+    from ..framework.program import Operator
+    cast_op = Operator(block, "cast",
+                       {"X": [name]}, {"Out": [new_name]},
+                       {"in_dtype": src_dtype, "out_dtype": dst_dtype,
+                        "op_role": "forward"})
+    block.ops.insert(op_idx, cast_op)
+    op.inputs[slot] = [new_name if n == name else n for n in op.inputs[slot]]
+    cast_cache[key] = new_name
+    return 1
+
+
+def rewrite_program(program: Program, amp_lists: Optional[
+        AutoMixedPrecisionLists] = None, dest_dtype: str = "bfloat16"):
+    """In-place bf16 rewrite of the (forward) program."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    block = program.global_block()
+    i = 0
+    cast_cache: Dict[str, str] = {}
+    while i < len(block.ops):
+        op = block.ops[i]
+        inserted = 0
+        if op.type in amp_lists.white_list:
+            for slot, names in list(op.inputs.items()):
+                for name in list(names):
+                    inserted += _cast_input(block, i, op, slot, name,
+                                            dest_dtype, cast_cache)
+        elif op.type in amp_lists.black_list:
+            for slot, names in list(op.inputs.items()):
+                for name in list(names):
+                    try:
+                        if block.var(name).dtype == dest_dtype:
+                            inserted += _cast_input(block, i, op, slot, name,
+                                                    "float32", cast_cache)
+                    except KeyError:
+                        pass
+        else:
+            i += 1
+            continue
+        # mark low-precision outputs so downstream black ops re-cast
+        if op.type in amp_lists.white_list:
+            for names in op.outputs.values():
+                for n in names:
+                    try:
+                        block.var(n).dtype = dest_dtype
+                    except KeyError:
+                        block.create_var(n, dtype=dest_dtype)
+        i += inserted + 1
+    program.bump_version()
+    return program
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling: float = 2.**15,
+             use_dynamic_loss_scaling: bool = True, use_pure_bf16=False,
+             dest_dtype: str = "bfloat16"):
+    """Wrap an optimizer so minimize() runs the AMP rewrite first
+    (analog of mixed_precision/decorator.py:218)."""
+
+    class OptimizerWithMixedPrecision:
+        def __init__(self, opt):
+            self._optimizer = opt
+            self._amp_lists = amp_lists
+            self._loss_scaling = init_loss_scaling
+
+        def __getattr__(self, name):
+            return getattr(self._optimizer, name)
+
+        def minimize(self, loss, startup_program=None, parameter_list=None,
+                     no_grad_set=None):
+            rewrite_program(loss.block.program, self._amp_lists, dest_dtype)
+            return self._optimizer.minimize(loss, startup_program,
+                                            parameter_list, no_grad_set)
+
+        def backward(self, loss, **kw):
+            rewrite_program(loss.block.program, self._amp_lists, dest_dtype)
+            return self._optimizer.backward(loss, **kw)
+
+        def apply_gradients(self, params_grads):
+            return self._optimizer.apply_gradients(params_grads)
+
+        def get_loss_scaling(self):
+            return self._loss_scaling
+
+    return OptimizerWithMixedPrecision(optimizer)

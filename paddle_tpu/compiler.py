@@ -1,0 +1,191 @@
+"""CompiledProgram — the ParallelExecutor front door.
+
+Analog of python/paddle/fluid/compiler.py:87 (CompiledProgram
+.with_data_parallel) and the whole C++ multi-device stack it drives
+(parallel_executor.cc:448, multi_devices_graph_pass.h, details/
+all_reduce_op_handle.cc — SURVEY §3.2). The TPU translation: instead of
+cloning the graph per device and inserting NCCL AllReduceOpHandles, the
+step function traced from the Program runs under jax.shard_map over a
+device Mesh. Feeds shard on the batch axis; params replicate; the
+``c_allreduce_sum`` ops that the fleet optimizer inserted after each
+gradient lower to lax.psum on the data axis. One jit-compiled SPMD
+computation replaces the threaded SSA executor.
+
+BuildStrategy/ExecutionStrategy knobs are accepted for API parity; the
+ones with XLA equivalents map through (e.g. gradient merge -> microbatch
+scan), the scheduling knobs are no-ops by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .framework.executor import _BlockRunner, _collect_io
+from .framework.program import Program, Variable
+from .framework.scope import Scope, global_scope
+
+
+class BuildStrategy:
+    """API-parity knob struct (details/build_strategy.h)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = 0
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_reduce_ops = True   # XLA combines collectives anyway
+        self.fuse_broadcast_ops = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1              # XLA owns scheduling
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = True
+
+
+class CompiledProgram:
+    def __init__(self, program: Program, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = ExecutionStrategy()
+        self._data_parallel = False
+        self._loss_name = None
+        self._share_vars_from = None
+        self._mesh = None
+        self._data_axis = "dp"
+        self._cache = {}
+        self._nprng = np.random.RandomState(1234)
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy: Optional[ExecutionStrategy] = None,
+                           share_vars_from=None, places=None):
+        """Analog of compiler.py:160. Chooses/creates the mesh lazily."""
+        self._data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
+        self._share_vars_from = share_vars_from
+        return self
+
+    # executor protocol ----------------------------------------------------
+    def _compile_for_executor(self, executor):
+        return _ParallelRunner(self, executor)
+
+
+class _ParallelRunner:
+    """Executes a CompiledProgram SPMD over the mesh (the ParallelExecutor
+    analog: parallel_executor.cc:448 ctor + FastThreadedSSAGraphExecutor
+    collapse into one shard_map'd jit)."""
+
+    def __init__(self, compiled: CompiledProgram, executor):
+        self.c = compiled
+        self.executor = executor
+
+    def _mesh(self):
+        if self.c._mesh is not None:
+            return self.c._mesh
+        from .distributed import env as dist_env
+        mesh = dist_env.current_mesh()
+        if mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            mesh = Mesh(np.asarray(jax.devices()), (self.c._data_axis,))
+            dist_env.set_mesh(mesh)
+            dist_env.register_ring(0, self.c._data_axis)
+        self.c._mesh = mesh
+        return mesh
+
+    def run(self, feed=None, fetch_list=None, scope=None, return_numpy=True):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        program = self.c._program
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        mesh = self._mesh()
+        axis = self.c._data_axis
+        ndev = mesh.shape[axis]
+
+        feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
+        for k, v in feed_arrays.items():
+            if v.ndim == 0 or v.shape[0] % ndev != 0:
+                raise ValueError(
+                    f"feed {k!r} batch dim {v.shape} not divisible by "
+                    f"mesh axis {axis}={ndev}")
+        feed_sig = tuple(sorted(
+            (k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items()))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               id(scope), hash(frozenset(scope.all_var_names())))
+        entry = self.c._cache.get(key)
+        if entry is None:
+            entry = self._build(program, feed_arrays, fetch_names, scope,
+                                mesh, axis)
+            self.c._cache[key] = entry
+        compiled, state_in, written = entry
+
+        state = {n: scope.find_var(n) for n in state_in}
+        missing = [n for n, v in state.items() if v is None]
+        if missing:
+            raise KeyError(f"vars not in scope (run startup first): {missing}")
+        rng = jax.random.PRNGKey(int(self.c._nprng.randint(0, 2**31 - 1)))
+        fetches, new_state = compiled(state, feed_arrays, rng)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        # ParallelExecutor fetch semantics: concatenate per-device results
+        out = []
+        for f in fetches:
+            if f.ndim >= 2:
+                f = f.reshape((-1,) + f.shape[2:])
+            out.append(np.asarray(f) if return_numpy else f)
+        return out
+
+    def _build(self, program, feed_arrays, fetch_names, scope, mesh, axis):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        block = program.global_block()
+        state_in, written = _collect_io(block, feed_arrays.keys(), scope)
+        runner = _BlockRunner(program, mesh=mesh, axis_env={0: axis})
+
+        def shard_step(state, feed, rng):
+            # per-device RNG stream: fold in the device's position so
+            # dropout masks differ across shards (reference: per-device
+            # curand states)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            env = dict(state)
+            env.update(feed)
+            env = runner.run_block(0, env, rng)
+            fetches = []
+            for n in fetch_names:
+                if n not in env:
+                    raise KeyError(f"fetch var {n!r} not produced")
+                # leading device axis -> concatenated result (ParallelExecutor
+                # fetch semantics: per-device results stacked on axis 0)
+                fetches.append(env[n][None])
+            new_state = {n: env.get(n, state.get(n)) for n in written}
+            return fetches, new_state
+
+        in_specs = ({n: P() for n in state_in},
+                    {k: P(axis) for k in feed_arrays},
+                    P())
+        out_specs = ([P(axis) for _ in fetch_names], {n: P() for n in written})
+        fn = jax.shard_map(shard_step, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        return jax.jit(fn), state_in, written

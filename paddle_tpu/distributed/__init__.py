@@ -1,0 +1,7 @@
+"""paddle_tpu.distributed — collective + fleet + PS distributed training."""
+
+from . import env
+from . import fleet
+from .collective import (ReduceOp, all_gather, all_reduce, barrier,
+                         broadcast, reduce, reduce_scatter, scatter, split)
+from .parallel import ParallelEnv, get_rank, get_world_size, init_parallel_env

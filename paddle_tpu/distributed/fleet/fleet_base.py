@@ -1,0 +1,303 @@
+"""Fleet facade — the unified distributed-training front door.
+
+Analog of python/paddle/distributed/fleet/base/fleet_base.py (Fleet:62,
+init:124, distributed_optimizer:571, minimize:936) and the meta-optimizer
+chain it compiles (strategy_compiler.py:41, meta_optimizer_factory.py:21).
+
+Static collective flow: ``fleet.init(is_collective=True)`` sets up the
+mesh; ``fleet.distributed_optimizer(opt, strategy)`` wraps the user
+optimizer; ``minimize(loss)`` applies the enabled meta-optimizers in the
+reference's order — AMP rewrite, LAMB/LARS swap, backward, DGC/localsgd
+gradient treatment, gradient-merge accumulation, per-gradient
+c_allreduce_sum insertion (the GradAllReduce transpiler,
+transpiler/collective.py:36), optimizer apply — then compiles the program
+for SPMD execution (fleet.main_program is a CompiledProgram).
+
+Dygraph flow: ``fleet.distributed_model(model)`` returns a DataParallel
+wrapper whose gradients are allreduced over the data axis.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...framework import unique_name
+from ...framework.program import Operator, Program, default_main_program
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[PaddleCloudRoleMaker] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_collective = True
+        self._final_program = None
+        self._origin_main_program = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self, role_maker=None, is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._is_collective = is_collective
+        self._strategy = strategy or DistributedStrategy()
+        from ..parallel import init_parallel_env
+        if is_collective:
+            init_parallel_env()
+        return self
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        pass  # single-controller SPMD: nothing to rendezvous
+
+    # -- PS lifecycle (implemented by the ps runtime) ----------------------
+    def init_worker(self):
+        from ..ps import runtime as ps_runtime
+        ps_runtime.init_worker(self)
+
+    def init_server(self, *args, **kwargs):
+        from ..ps import runtime as ps_runtime
+        ps_runtime.init_server(self, *args, **kwargs)
+
+    def run_server(self):
+        from ..ps import runtime as ps_runtime
+        ps_runtime.run_server(self)
+
+    def stop_worker(self):
+        from ..ps import runtime as ps_runtime
+        ps_runtime.stop_worker(self)
+
+    # -- optimizer ---------------------------------------------------------
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None):
+        if strategy is not None:
+            self._strategy = strategy
+        return _DistributedOptimizer(self, optimizer,
+                                     self._strategy or DistributedStrategy())
+
+    # -- dygraph -----------------------------------------------------------
+    def distributed_model(self, model):
+        from ...dygraph.parallel import DataParallel
+        return DataParallel(model)
+
+    @property
+    def main_program(self):
+        return self._final_program or default_main_program()
+
+    # -- checkpoint passthroughs ------------------------------------------
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ...framework_io import save_persistables
+        save_persistables(executor, dirname,
+                          main_program or self._origin_main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from ...framework_io import save_inference_model
+        save_inference_model(dirname, feeded_var_names, target_vars,
+                             executor, main_program or
+                             self._origin_main_program)
+
+
+class _DistributedOptimizer:
+    """Meta-optimizer chain applier (strategy_compiler analog)."""
+
+    def __init__(self, fleet: Fleet, optimizer, strategy: DistributedStrategy):
+        self._fleet = fleet
+        self._inner = optimizer
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        self._fleet._origin_main_program = program
+        opt = self._inner
+        strategy = self._strategy
+
+        # 1. LAMB/LARS meta-optimizers: swap the inner optimizer
+        #    (meta_optimizers/lamb_optimizer.py / lars_optimizer.py)
+        from ...optimizer import LambOptimizer, LarsMomentumOptimizer
+        if strategy.lamb and not isinstance(opt, LambOptimizer):
+            cfg = strategy.lamb_configs
+            opt = LambOptimizer(
+                learning_rate=opt._learning_rate,
+                lamb_weight_decay=cfg["lamb_weight_decay"],
+                grad_clip=opt._grad_clip)
+        if strategy.lars and not isinstance(opt, LarsMomentumOptimizer):
+            cfg = strategy.lars_configs
+            opt = LarsMomentumOptimizer(
+                learning_rate=opt._learning_rate,
+                momentum=getattr(opt, "_momentum", 0.9),
+                lars_coeff=cfg["lars_coeff"],
+                lars_weight_decay=cfg["lars_weight_decay"],
+                grad_clip=opt._grad_clip)
+
+        # 2. AMP rewrite (meta_optimizers/amp_optimizer.py)
+        if strategy.amp:
+            from ...amp.static_amp import rewrite_program
+            from ...amp.lists import AutoMixedPrecisionLists
+            cfg = strategy.amp_configs
+            rewrite_program(program, AutoMixedPrecisionLists(
+                cfg.get("custom_white_list"), cfg.get("custom_black_list")))
+
+        # 3. backward + (optionally merged/compressed) grads + allreduce
+        params_grads = opt.backward(loss, startup_program, parameter_list,
+                                    no_grad_set)
+        nranks = self._nranks()
+        if nranks > 1:
+            params_grads = _insert_grad_allreduce(
+                program, params_grads, nranks,
+                dgc=strategy.dgc, dgc_configs=strategy.dgc_configs)
+
+        # 4. gradient merge (meta_optimizers/gradient_merge_optimizer.py)
+        if strategy.gradient_merge:
+            cfg = strategy.gradient_merge_configs
+            params_grads = _apply_gradient_merge(
+                program, params_grads, cfg["k_steps"], cfg["avg"])
+
+        opt_ops = opt.apply_gradients(params_grads)
+
+        # 5. compile for SPMD execution (graph_execution meta-optimizer)
+        from ...compiler import CompiledProgram
+        self._fleet._final_program = CompiledProgram(
+            program).with_data_parallel(loss_name=loss.name)
+        return opt_ops, params_grads
+
+    def _nranks(self) -> int:
+        from .. import env as dist_env
+        mesh = dist_env.current_mesh()
+        ax = dist_env.current_data_axis()
+        if mesh is not None and ax in (mesh.axis_names or ()):
+            return int(mesh.shape[ax])
+        return 1
+
+
+def _insert_grad_allreduce(program: Program, params_grads, nranks: int,
+                           dgc=False, dgc_configs=None):
+    """GradAllReduce transpiler (transpiler/collective.py:36,178): after
+    each gradient is produced, scale by 1/nranks and c_allreduce_sum it.
+    With dgc, a dgc_momentum-style top-k sparsification with error feedback
+    runs before the allreduce (operators/optimizers/dgc_momentum_op /
+    details/sparse_all_reduce_op_handle.cc analog; the communication itself
+    stays dense — ICI bandwidth makes sparse transport unnecessary, the
+    *optimizer semantics* of DGC are preserved)."""
+    block = program.global_block()
+    # position: before the first optimize-role op, else at end
+    insert_at = len(block.ops)
+    for i, op in enumerate(block.ops):
+        if op.attrs.get("op_role") == "optimize":
+            insert_at = i
+            break
+    new_ops: List[Operator] = []
+    out_pg = []
+    for p, g in params_grads:
+        scaled = block.create_var(unique_name.generate(g.name + "@DP"),
+                                  stop_gradient=True)
+        new_ops.append(Operator(
+            block, "scale", {"X": [g.name]}, {"Out": [scaled.name]},
+            {"scale": 1.0 / nranks, "op_role": "backward"}))
+        reduced = block.create_var(unique_name.generate(g.name + "@AR"),
+                                   stop_gradient=True)
+        new_ops.append(Operator(
+            block, "c_allreduce_sum", {"X": [scaled.name]},
+            {"Out": [reduced.name]},
+            {"ring_id": 0, "op_role": "backward"}))
+        out_pg.append((p, reduced))
+    block.ops[insert_at:insert_at] = new_ops
+    program.bump_version()
+    return out_pg
+
+
+def _apply_gradient_merge(program: Program, params_grads, k_steps: int,
+                          avg: bool = True):
+    """Gradient-merge rewrite (fluid/optimizer.py GradientMergeOptimizer:
+    4994): accumulate grads into persistable buffers; apply every k steps.
+    The step counter and the conditional apply are real ops; the optimizer
+    consumes gated gradients (zero on non-apply steps keeps params frozen
+    between merges when combined with the gate-scaled learning rate var)."""
+    if k_steps <= 1:
+        return params_grads
+    from ...layers.tensor import create_global_var
+    block = program.global_block()
+    step = create_global_var([1], 0.0, "float32", persistable=True,
+                             name=unique_name.generate("gm_step"))
+    one = block.create_var(unique_name.generate("gm_one"), stop_gradient=True)
+    block.append_op("fill_constant_like", {"X": step}, {"Out": one},
+                    {"value": 1.0, "op_role": "backward"})
+    block.append_op("sum", {"X": [step.name, one.name]}, {"Out": step},
+                    {"op_role": "backward"})
+    # gate = 1.0 when step % k == 0
+    modv = block.create_var(unique_name.generate("gm_mod"), stop_gradient=True)
+    kconst = block.create_var(unique_name.generate("gm_k"), stop_gradient=True)
+    block.append_op("fill_constant_like", {"X": step}, {"Out": kconst},
+                    {"value": float(k_steps), "op_role": "backward"})
+    block.append_op("elementwise_mod", {"X": step, "Y": kconst},
+                    {"Out": modv}, {"op_role": "backward"})
+    zero = block.create_var(unique_name.generate("gm_zero"),
+                            stop_gradient=True)
+    block.append_op("fill_constant_like", {"X": step}, {"Out": zero},
+                    {"value": 0.0, "op_role": "backward"})
+    gate_b = block.create_var(unique_name.generate("gm_gate_b"),
+                              stop_gradient=True)
+    block.append_op("equal", {"X": modv, "Y": zero}, {"Out": gate_b},
+                    {"op_role": "backward"})
+    gate = block.create_var(unique_name.generate("gm_gate"),
+                            stop_gradient=True)
+    block.append_op("cast", {"X": gate_b}, {"Out": gate},
+                    {"in_dtype": "bool", "out_dtype": "float32",
+                     "op_role": "backward"})
+    out_pg = []
+    for p, g in params_grads:
+        acc = create_global_var(list(p.shape), 0.0, p.dtype, persistable=True,
+                                name=unique_name.generate(f"{p.name}@GMERGE"))
+        # acc += g
+        block.append_op("sum", {"X": [acc.name, g.name]}, {"Out": acc},
+                        {"op_role": "backward"})
+        # gated grad = gate * acc / (k if avg)
+        gated = block.create_var(unique_name.generate(g.name + "@GMG"),
+                                 stop_gradient=True)
+        block.append_op("elementwise_mul", {"X": acc, "Y": gate},
+                        {"Out": gated}, {"axis": -1, "op_role": "backward"})
+        if avg:
+            avgd = block.create_var(unique_name.generate(g.name + "@GMA"),
+                                    stop_gradient=True)
+            block.append_op("scale", {"X": gated}, {"Out": avgd},
+                            {"scale": 1.0 / k_steps, "op_role": "backward"})
+            gated = avgd
+        # reset acc on apply steps: acc = acc * (1 - gate)
+        inv = block.create_var(unique_name.generate("gm_inv"),
+                               stop_gradient=True)
+        block.append_op("scale", {"X": gate}, {"Out": inv},
+                        {"scale": -1.0, "bias": 1.0, "op_role": "backward"})
+        block.append_op("elementwise_mul", {"X": acc, "Y": inv},
+                        {"Out": acc}, {"axis": -1, "op_role": "backward"})
+        out_pg.append((p, block.var(gated.name)))
+    program.bump_version()
+    return out_pg
+
+
+fleet = Fleet()

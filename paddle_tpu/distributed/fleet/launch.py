@@ -1,0 +1,114 @@
+"""Launcher — ``python -m paddle_tpu.distributed.launch train.py``.
+
+Analog of python/paddle/distributed/fleet/launch.py (launch_collective:188,
+launch_ps:227) + launch_utils.py. Execution-model translation: the
+reference spawns one process per GPU and wires NCCL ranks through
+PADDLE_TRAINER_* env vars. On TPU, one python process drives all local
+chips SPMD, so the collective launcher's per-host job is: initialize
+jax.distributed (multi-host rendezvous over DCN — the analog of the
+gen_nccl_id gRPC exchange), set the PADDLE_* env vars for RoleMaker
+parity, and exec the training script once per host. PS mode spawns server
+and worker processes like the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", default="127.0.0.1",
+                   help="comma-separated host ips (multi-host DCN)")
+    p.add_argument("--host_rank", type=int,
+                   default=int(os.getenv("HOST_RANK", "0")))
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port for jax.distributed")
+    p.add_argument("--servers", default="",
+                   help="PS mode: comma-separated server endpoints")
+    p.add_argument("--workers", default="",
+                   help="PS mode: comma-separated worker endpoints")
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--worker_num", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch_collective(args):
+    hosts = args.ips.split(",")
+    nhosts = len(hosts)
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(args.host_rank))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nhosts))
+    os.environ.setdefault("PADDLE_TRAINER_ENDPOINTS",
+                          ",".join(f"{h}:8910" for h in hosts))
+    os.environ.setdefault("PADDLE_CURRENT_ENDPOINT",
+                          f"{hosts[args.host_rank]}:8910")
+    if nhosts > 1:
+        import jax
+        coordinator = args.coordinator or f"{hosts[0]}:8476"
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nhosts,
+                                   process_id=args.host_rank)
+    sys.argv = [args.training_script] + args.training_script_args
+    runpy.run_path(args.training_script, run_name="__main__")
+
+
+def launch_ps(args):
+    """Spawn PS server + worker subprocesses on this host
+    (launch_ps:227 analog)."""
+    servers = (args.servers.split(",") if args.servers else
+               [f"127.0.0.1:{8700 + i}" for i in range(args.server_num)])
+    n_workers = args.worker_num or 1
+    procs: List[subprocess.Popen] = []
+    for i, ep in enumerate(servers):
+        env = dict(os.environ,
+                   TRAINING_ROLE="PSERVER",
+                   PADDLE_PSERVERS_IP_PORT_LIST=",".join(servers),
+                   PADDLE_PORT_ID=str(i))
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] +
+            args.training_script_args, env=env))
+    for i in range(n_workers):
+        env = dict(os.environ,
+                   TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(i),
+                   PADDLE_TRAINERS_NUM=str(n_workers),
+                   PADDLE_PSERVERS_IP_PORT_LIST=",".join(servers))
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] +
+            args.training_script_args, env=env))
+    # watch children; terminate the pod on any failure (launch.py:188-226)
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    for q in procs:
+                        q.terminate()
+                    sys.exit(ret)
+            time.sleep(1)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.servers or args.server_num:
+        launch_ps(args)
+    else:
+        launch_collective(args)
+
+
+if __name__ == "__main__":
+    main()

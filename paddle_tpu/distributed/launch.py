@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.distributed.launch`` — reference-parity entry
+(python -m paddle.distributed.launch). Delegates to fleet.launch."""
+
+from .fleet.launch import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Process/mesh initialization for distributed training.
+
+Analog of python/paddle/distributed/parallel.py (init_parallel_env:32,
+ParallelEnv) — but TPU-native: instead of one OS process per GPU with NCCL
+rank bootstrap (reference imperative/nccl_context.cc TCP ncclUniqueId
+exchange), a single python process drives all local chips SPMD through a
+jax.sharding.Mesh, and multi-host scaling uses jax.distributed (ICI/DCN
+handled by the runtime). "ranks" are mesh positions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class ParallelEnv:
+    """Analog of fluid/dygraph/parallel.py ParallelEnv:62 — env-derived
+    topology (PADDLE_TRAINER_ID etc. honored for launcher parity)."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    # legacy names
+    local_rank = rank
+    nranks = world_size
+
+
+def init_parallel_env(data_axis: str = "dp",
+                      mesh_shape: Optional[dict] = None):
+    """Create the device mesh and register ring 0 -> data axis.
+
+    Single host: mesh over all local devices. Multi-host: call
+    jax.distributed.initialize first (the launcher does).
+    Returns the ParallelEnv.
+    """
+    import jax
+    from jax.sharding import Mesh
+    from . import env as dist_env
+
+    devices = np.asarray(jax.devices())
+    if mesh_shape:
+        names = tuple(mesh_shape.keys())
+        sizes = tuple(mesh_shape.values())
+        mesh = Mesh(devices.reshape(sizes), names)
+    else:
+        mesh = Mesh(devices, (data_axis,))
+    dist_env.set_mesh(mesh)
+    dist_env.set_data_axis(data_axis if data_axis in mesh.axis_names else None)
+    dist_env.register_ring(0, data_axis)
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    import jax
+    ws = ParallelEnv().world_size
+    if ws > 1:
+        return ws
+    from . import env as dist_env
+    mesh = dist_env.current_mesh()
+    if mesh is not None:
+        return int(np.prod(list(mesh.shape.values())))
+    return len(jax.devices())

@@ -1,0 +1,4 @@
+"""Parameter-server tier: host-RAM sparse tables + communicator."""
+
+from .sparse_table import REGISTRY, SparseTable, TableRegistry
+from . import runtime

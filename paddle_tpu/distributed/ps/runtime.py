@@ -1,0 +1,136 @@
+"""Parameter-server runtime.
+
+Analog of fleet/runtime/parameter_server_runtime.py:28 + the C++
+communicator stack (operators/distributed/communicator.h:180-396:
+Async/HalfAsync/Sync/Geo). Execution model translation: the reference
+splits the program into trainer/pserver halves connected by gRPC
+send/recv; here the dense model runs on TPU while sparse tables live in
+the host-RAM SparseTable tier. The communicator batches pushes on a
+background thread (async mode) or applies synchronously (sync mode); geo
+mode accumulates local deltas and syncs every k steps.
+
+Single-process backend today; the wire-protocol (gRPC) server for
+multi-node PS plugs in behind SparseTable without changing this API.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .sparse_table import REGISTRY, SparseTable
+
+
+class Communicator:
+    """Background push applier (communicator.h:180 AsyncCommunicator)."""
+
+    def __init__(self, mode: str = "sync", send_queue_size: int = 20,
+                 geo_k_steps: int = 100):
+        self.mode = mode
+        self._q: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._geo_k = geo_k_steps
+        self._geo_deltas: Dict[str, Dict[int, np.ndarray]] = {}
+        self._geo_counter = 0
+
+    def start(self):
+        if self.mode == "sync":
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self):
+        while self._running:
+            item = self._q.get()
+            if item is None:
+                break
+            name, ids, grads = item
+            table = REGISTRY.get(name)
+            if table is not None:
+                table.push(ids, grads)
+
+    def push_sparse(self, name: str, ids, grads):
+        if self.mode == "sync":
+            table = REGISTRY.get(name)
+            if table is not None:
+                table.push(ids, grads)
+        elif self.mode == "geo":
+            self._geo_accumulate(name, ids, grads)
+        else:  # async / half_async
+            self._q.put((name, np.asarray(ids), np.asarray(grads)))
+
+    def _geo_accumulate(self, name, ids, grads):
+        """GeoCommunicator: accumulate deltas locally, sync every k steps
+        (communicator.h:396)."""
+        d = self._geo_deltas.setdefault(name, {})
+        flat = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        for i, k in enumerate(flat):
+            d[int(k)] = d.get(int(k), 0) + g[i]
+        self._geo_counter += 1
+        if self._geo_counter >= self._geo_k:
+            self.flush_geo()
+
+    def flush_geo(self):
+        for name, deltas in self._geo_deltas.items():
+            table = REGISTRY.get(name)
+            if table is None or not deltas:
+                continue
+            ids = np.fromiter(deltas.keys(), np.int64)
+            grads = np.stack(list(deltas.values()))
+            table.push(ids, grads)
+        self._geo_deltas.clear()
+        self._geo_counter = 0
+
+
+_communicator: Optional[Communicator] = None
+
+
+def get_communicator() -> Optional[Communicator]:
+    return _communicator
+
+
+def init_worker(fleet):
+    global _communicator
+    strategy = fleet._strategy
+    if strategy is not None and strategy.a_sync:
+        k = strategy.a_sync_configs.get("k_steps", -1)
+        mode = "geo" if k > 0 else "async"
+    else:
+        mode = "sync"
+    _communicator = Communicator(mode=mode,
+                                 geo_k_steps=max(
+                                     1, strategy.a_sync_configs["k_steps"]
+                                     if strategy else 100))
+    _communicator.start()
+
+
+def init_server(fleet, *args, **kwargs):
+    # tables are created lazily by distributed_lookup_table; nothing to
+    # bind in the single-process backend
+    pass
+
+
+def run_server(fleet):
+    pass
+
+
+def stop_worker(fleet):
+    global _communicator
+    if _communicator is not None:
+        if _communicator.mode == "geo":
+            _communicator.flush_geo()
+        _communicator.stop()
+        _communicator = None

@@ -1,0 +1,120 @@
+"""Host-resident sparse parameter tables.
+
+Analog of the reference's large-scale KV store
+(operators/distributed/large_scale_kv.h:160,255 SparseVariable/ValueBlock)
+serving distributed_lookup_table. Rows live in host RAM (the tables are
+the "trillions of parameters" tier that never fits on-chip); the TPU sees
+only the gathered dense rows per batch. This python implementation is the
+single-process backend; the C++ gRPC-served variant (multi-node PS) plugs
+in behind the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SparseTable:
+    """One embedding table, sharded by id hash into blocks (ValueBlock
+    analog) with per-block locks for concurrent pull/push."""
+
+    def __init__(self, name: str, value_dim: int, shard_num: int = 8,
+                 initializer=None, optimizer: str = "sgd",
+                 lr: float = 0.01):
+        self.name = name
+        self.value_dim = value_dim
+        self.shard_num = shard_num
+        self._shards: List[Dict[int, np.ndarray]] = [
+            {} for _ in range(shard_num)]
+        self._locks = [threading.Lock() for _ in range(shard_num)]
+        self._init = initializer or (
+            lambda rng, dim: (rng.standard_normal(dim) * 0.01).astype(
+                np.float32))
+        self._rng = np.random.RandomState(hash(name) % 2**31)
+        self.optimizer = optimizer
+        self.lr = lr
+        # per-row optimizer state (adagrad accumulators)
+        self._accum: List[Dict[int, np.ndarray]] = [
+            {} for _ in range(shard_num)]
+
+    def _shard(self, key: int) -> int:
+        return int(key) % self.shard_num
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """Gather rows (init-on-miss, like the reference's prefetch)."""
+        flat = np.asarray(ids).reshape(-1)
+        out = np.empty((flat.size, self.value_dim), np.float32)
+        for i, k in enumerate(flat):
+            s = self._shard(k)
+            with self._locks[s]:
+                row = self._shards[s].get(int(k))
+                if row is None:
+                    row = self._init(self._rng, self.value_dim)
+                    self._shards[s][int(k)] = row
+                out[i] = row
+        return out.reshape(tuple(np.asarray(ids).shape) + (self.value_dim,))
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        """Apply gradients to rows (sgd or adagrad per-row update)."""
+        flat = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, self.value_dim)
+        # combine duplicate ids first (scatter-add semantics)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        combined = np.zeros((uniq.size, self.value_dim), np.float32)
+        np.add.at(combined, inv, g)
+        for i, k in enumerate(uniq):
+            s = self._shard(k)
+            with self._locks[s]:
+                row = self._shards[s].get(int(k))
+                if row is None:
+                    continue
+                if self.optimizer == "adagrad":
+                    acc = self._accum[s].setdefault(
+                        int(k), np.zeros(self.value_dim, np.float32))
+                    acc += combined[i] ** 2
+                    row -= self.lr * combined[i] / (np.sqrt(acc) + 1e-6)
+                else:
+                    row -= self.lr * combined[i]
+
+    def size(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    def state(self):
+        """Serializable snapshot (checkpoint tier)."""
+        rows = {}
+        for s in self._shards:
+            rows.update({str(k): v for k, v in s.items()})
+        return rows
+
+    def load_state(self, rows: Dict[str, np.ndarray]):
+        for k, v in rows.items():
+            key = int(k)
+            self._shards[self._shard(key)][key] = np.asarray(v, np.float32)
+
+
+class TableRegistry:
+    """Process-global registry (FleetWrapper singleton analog,
+    framework/fleet/fleet_wrapper.h)."""
+
+    def __init__(self):
+        self._tables: Dict[str, SparseTable] = {}
+
+    def get_or_create(self, name: str, value_dim: int, **kw) -> SparseTable:
+        if name not in self._tables:
+            self._tables[name] = SparseTable(name, value_dim, **kw)
+        return self._tables[name]
+
+    def get(self, name: str) -> Optional[SparseTable]:
+        return self._tables.get(name)
+
+    def tables(self):
+        return dict(self._tables)
+
+    def clear(self):
+        self._tables.clear()
+
+
+REGISTRY = TableRegistry()

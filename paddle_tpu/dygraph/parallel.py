@@ -1,0 +1,49 @@
+"""Dygraph data parallel.
+
+Analog of python/paddle/fluid/dygraph/parallel.py (DataParallel:236,
+scale_loss:337, apply_collective_grads:449). The reference coalesces grads
+into buckets and ncclAllReduces them across processes; here gradients are
+allreduced over the mesh data axis through the c_allreduce_sum lowering —
+inside a shard_map/pjit step that is a real ICI collective, and XLA does
+the coalescing (no manual bucketing needed). Outside a mesh it is
+identity, so the same script runs single- or multi-chip.
+"""
+
+from __future__ import annotations
+
+from .layers import Layer
+from .tape import run_op
+from .tensor import Tensor
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB=25,
+                 last_comm_buffer_size_MB=1):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss: Tensor) -> Tensor:
+        """Kept for API parity: with psum-mean allreduce the loss needs no
+        rescale (the reference divides by nranks before allreduce-sum)."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Allreduce-mean every parameter gradient over the data axis."""
+        for p in self._layers.parameters():
+            if p.grad is None:
+                continue
+            reduced = run_op("c_allreduce_avg", {"X": [p.grad]},
+                             {"ring_id": 0})["Out"][0]
+            p.grad = Tensor(reduced.value, stop_gradient=True)
+
+    def state_dict(self, prefix: str = ""):
+        return self._layers.state_dict(prefix)
+
+    def set_state_dict(self, state, use_structured_name=True):
+        return self._layers.set_state_dict(state, use_structured_name)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)

@@ -141,6 +141,10 @@ class Executor:
             return program._compile_for_executor(self).run(
                 feed=feed, fetch_list=fetch_list, scope=scope,
                 return_numpy=return_numpy)
+        if not isinstance(program, Program):
+            raise TypeError(
+                f"Executor.run expects a Program or CompiledProgram, got "
+                f"{type(program).__name__}")
         feed = dict(feed or {})
         scope = scope or global_scope()
         fetch_names = [f.name if isinstance(f, Variable) else str(f)

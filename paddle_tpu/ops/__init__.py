@@ -12,3 +12,4 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
+from . import ps_ops  # noqa: F401

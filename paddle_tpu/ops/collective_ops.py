@@ -27,6 +27,14 @@ def _axis(ctx: LoweringContext, attrs) -> str | None:
     if ax is None:
         from ..distributed import env as dist_env
         ax = dist_env.axis_for_ring(ring)
+    if ax is None:
+        return None
+    # the ring may be registered globally while we execute outside any
+    # shard_map/pmap binding of that axis (e.g. plain eager) — probe it
+    try:
+        jax.lax.axis_index(ax)
+    except NameError:
+        return None
     return ax
 
 

@@ -1,0 +1,108 @@
+"""Parameter-server ops: host sparse tables <-> device dense compute.
+
+Analogs of operators/distributed_ops/ (distributed_lookup_table_op,
+send_op/recv_op, lookup_sparse_table ops) and the prefetch path
+(operators/distributed/parameter_prefetch.cc). The pull crosses the
+host<->device boundary via jax.pure_callback (rows gathered on host from
+the SparseTable tier, dense activations fed to the TPU); the push flows
+through the Communicator (sync/async/geo).
+
+These ops are host-interacting: under jit they become host callbacks; the
+recommended pattern (like the reference's DownpourWorker) is pull -> dense
+jit step -> push.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+@register("distributed_lookup_table", no_grad_slots=("Ids",),
+          grad_drops_inputs=("W",))
+def _distributed_lookup_table(ctx, ins, attrs):
+    """Pull rows from the host sparse table (init-on-miss)."""
+    from ..distributed.ps.sparse_table import REGISTRY
+    ids = ins["Ids"][0]
+    table_name = attrs["table_names"][0] if isinstance(
+        attrs.get("table_names"), (list, tuple)) else attrs.get(
+            "table_name", attrs.get("table_names"))
+    dim = int(attrs["value_dim"])
+    table = REGISTRY.get_or_create(table_name, dim,
+                                   optimizer=attrs.get("sparse_optimizer",
+                                                       "sgd"),
+                                   lr=attrs.get("sparse_lr", 0.01))
+
+    def _pull(ids_np):
+        return table.pull(np.asarray(ids_np)).astype(np.float32)
+
+    out_shape = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
+    out = jax.pure_callback(_pull, out_shape, ids)
+    return {"Out": [out]}
+
+
+@register("distributed_lookup_table_grad")
+def _distributed_lookup_table_grad(ctx, ins, attrs):
+    """Push: route the gradient to the communicator (send_op analog)."""
+    from ..distributed.ps import runtime as ps_runtime
+    from ..distributed.ps.sparse_table import REGISTRY
+    ids = ins["Ids"][0]
+    g = ins["Out@GRAD"][0]
+    table_name = attrs["table_names"][0] if isinstance(
+        attrs.get("table_names"), (list, tuple)) else attrs.get(
+            "table_name", attrs.get("table_names"))
+
+    def _push(ids_np, g_np):
+        comm = ps_runtime.get_communicator()
+        if comm is not None:
+            comm.push_sparse(table_name, np.asarray(ids_np),
+                             np.asarray(g_np))
+        else:
+            table = REGISTRY.get(table_name)
+            if table is not None:
+                table.push(np.asarray(ids_np), np.asarray(g_np))
+        return np.zeros((), np.float32)
+
+    token = jax.pure_callback(_push, jax.ShapeDtypeStruct((), jnp.float32),
+                              ids, g)
+    # the op has no dense W grad (rows update host-side); emit a token-
+    # shaped zero so the grad op has an output binding
+    return {"W@GRAD": [token]}
+
+
+@register("send", not_differentiable=True)
+def _send(ctx, ins, attrs):
+    """Dense var push to the PS tier (send_op.cc analog): in the
+    single-process backend, a host callback storing into the registry."""
+    from ..distributed.ps.sparse_table import REGISTRY
+    x = ins["X"][0]
+    name = attrs.get("send_varnames", ["var"])[0]
+
+    def _store(x_np):
+        t = REGISTRY.get_or_create(f"__dense__{name}", int(np.prod(
+            x_np.shape)))
+        t._dense = np.asarray(x_np)
+        return np.zeros((), np.float32)
+
+    token = jax.pure_callback(_store, jax.ShapeDtypeStruct((), jnp.float32),
+                              x)
+    return {"Out": [token]}
+
+
+@register("recv", not_differentiable=True)
+def _recv(ctx, ins, attrs):
+    from ..distributed.ps.sparse_table import REGISTRY
+    name = attrs.get("recv_varnames", ["var"])[0]
+    shape = tuple(attrs["shape"])
+
+    def _load():
+        t = REGISTRY.get(f"__dense__{name}")
+        if t is None or not hasattr(t, "_dense"):
+            return np.zeros(shape, np.float32)
+        return t._dense.reshape(shape).astype(np.float32)
+
+    out = jax.pure_callback(_load, jax.ShapeDtypeStruct(shape, jnp.float32))
+    return {"Out": [out]}
