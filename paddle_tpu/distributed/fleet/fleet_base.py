@@ -179,7 +179,7 @@ class _DistributedOptimizer:
             params_grads = _apply_gradient_merge(
                 program, params_grads, cfg["k_steps"], cfg["avg"])
 
-        opt_ops = opt.apply_gradients(params_grads)
+        opt_ops = opt.apply_gradients(params_grads, startup_program)
 
         # 5. compile for SPMD execution (graph_execution meta-optimizer)
         from ...compiler import CompiledProgram

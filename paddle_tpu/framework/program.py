@@ -445,6 +445,14 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
     _main_program = main_program
     if startup_program is not None:
         _startup_program = startup_program
+    # Remember which startup program this main program was built against so
+    # later rewrites (optimizer accumulators created outside the original
+    # guard) append their init ops to the startup program the user will
+    # actually run. Don't clobber an explicit pairing on re-entry without
+    # startup_program.
+    if startup_program is not None or not hasattr(main_program,
+                                                  "_startup_ref"):
+        main_program._startup_ref = _startup_program
     try:
         yield
     finally:

@@ -469,6 +469,8 @@ def accuracy(input, label, k=1, name=None):
 def one_hot(input, depth, name=None):
     helper = LayerHelper("one_hot", name=name)
     out = helper.create_variable_for_type_inference("float32")
-    helper.append_op("one_hot_v2", inputs={"X": input},
+    # legacy fluid.layers.one_hot squeezes a trailing dim of 1 ([N,1] ->
+    # [N,depth]); the v2 op appends depth to the unmodified shape
+    helper.append_op("one_hot", inputs={"X": input},
                      outputs={"Out": out}, attrs={"depth": depth})
     return out

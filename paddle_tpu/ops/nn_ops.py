@@ -182,12 +182,15 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
         lbl = label
         if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis)
+        # Mask label == ignore_index for ANY value (reference kernel semantics;
+        # conventional default is -100). Clamp before the gather so an
+        # out-of-range index never feeds take_along_axis.
+        valid = lbl != ignore_index
+        n_class = logits.shape[axis]
+        safe_lbl = jnp.clip(jnp.where(valid, lbl, 0), 0, n_class - 1)
         picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
-        loss = -picked
-        if ignore_index >= 0:
-            mask = jnp.expand_dims(lbl, axis) != ignore_index
-            loss = jnp.where(mask, loss, 0.0)
+            logp, jnp.expand_dims(safe_lbl, axis).astype(jnp.int32), axis=axis)
+        loss = jnp.where(jnp.expand_dims(valid, axis), -picked, 0.0)
     return {"Softmax": [softmax], "Loss": [loss]}
 
 

@@ -2,10 +2,14 @@
 
 Analogs of operators/distributed_ops/ (distributed_lookup_table_op,
 send_op/recv_op, lookup_sparse_table ops) and the prefetch path
-(operators/distributed/parameter_prefetch.cc). The pull crosses the
-host<->device boundary via jax.pure_callback (rows gathered on host from
-the SparseTable tier, dense activations fed to the TPU); the push flows
-through the Communicator (sync/async/geo).
+(operators/distributed/parameter_prefetch.cc). The pull/push cross the
+host<->device boundary via ``jax.experimental.io_callback`` with
+``ordered=True``: these are *effectful* host interactions (the table
+mutates between steps), so they must never be constant-folded, deduped, or
+DCE'd by XLA the way ``pure_callback`` results can be, and pull->push
+order within a step must be preserved. The reference gets the same
+guarantee from executing send/recv ops imperatively in program order
+(listen_and_serv_op.cc RunSyncLoop).
 
 These ops are host-interacting: under jit they become host callbacks; the
 recommended pattern (like the reference's DownpourWorker) is pull -> dense
@@ -17,19 +21,43 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import io_callback
 
 from .registry import register
 
 
+def _table_name(attrs):
+    tn = attrs.get("table_names")
+    if isinstance(tn, (list, tuple)):
+        return tn[0]
+    return attrs.get("table_name", tn)
+
+
+def _lookup_table_grad_maker(op, out_grad_names, wanted_input_grads):
+    """Always emit the push op when a grad flows into Out — the 'parameter'
+    lives host-side, so the default maker (which keys on wanted *input*
+    grads) would silently drop the update. Analog of the reference's
+    send_op insertion by the distribute transpiler."""
+    gs = out_grad_names.get("Out", [])
+    g = next((x for x in gs if x is not None), None)
+    if g is None:
+        return []
+    from ..framework import unique_name
+    token = unique_name.generate(_table_name(op.attrs) + "@PUSH")
+    op.block.create_var(token, stop_gradient=True)
+    g_in = {"Ids": list(op.inputs["Ids"]), "Out@GRAD": [g]}
+    return [("distributed_lookup_table_grad", g_in,
+             {"W@GRAD": [token]}, dict(op.attrs))]
+
+
 @register("distributed_lookup_table", no_grad_slots=("Ids",),
-          grad_drops_inputs=("W",))
+          grad_drops_inputs=("W",),
+          custom_grad_maker=_lookup_table_grad_maker)
 def _distributed_lookup_table(ctx, ins, attrs):
     """Pull rows from the host sparse table (init-on-miss)."""
     from ..distributed.ps.sparse_table import REGISTRY
     ids = ins["Ids"][0]
-    table_name = attrs["table_names"][0] if isinstance(
-        attrs.get("table_names"), (list, tuple)) else attrs.get(
-            "table_name", attrs.get("table_names"))
+    table_name = _table_name(attrs)
     dim = int(attrs["value_dim"])
     table = REGISTRY.get_or_create(table_name, dim,
                                    optimizer=attrs.get("sparse_optimizer",
@@ -40,7 +68,10 @@ def _distributed_lookup_table(ctx, ins, attrs):
         return table.pull(np.asarray(ids_np)).astype(np.float32)
 
     out_shape = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
-    out = jax.pure_callback(_pull, out_shape, ids)
+    # ordered io_callback: the table mutates every step (push / communicator
+    # flush), so the pull must re-execute each run, after the previous
+    # step's push.
+    out = io_callback(_pull, out_shape, ids, ordered=True)
     return {"Out": [out]}
 
 
@@ -51,9 +82,7 @@ def _distributed_lookup_table_grad(ctx, ins, attrs):
     from ..distributed.ps.sparse_table import REGISTRY
     ids = ins["Ids"][0]
     g = ins["Out@GRAD"][0]
-    table_name = attrs["table_names"][0] if isinstance(
-        attrs.get("table_names"), (list, tuple)) else attrs.get(
-            "table_name", attrs.get("table_names"))
+    table_name = _table_name(attrs)
 
     def _push(ids_np, g_np):
         comm = ps_runtime.get_communicator()
@@ -66,10 +95,11 @@ def _distributed_lookup_table_grad(ctx, ins, attrs):
                 table.push(np.asarray(ids_np), np.asarray(g_np))
         return np.zeros((), np.float32)
 
-    token = jax.pure_callback(_push, jax.ShapeDtypeStruct((), jnp.float32),
-                              ids, g)
-    # the op has no dense W grad (rows update host-side); emit a token-
-    # shaped zero so the grad op has an output binding
+    # Effectful: must land even though nothing consumes W@GRAD (the rows
+    # update host-side). pure_callback here was DCE'd by XLA -> no training.
+    token = io_callback(_push, jax.ShapeDtypeStruct((), jnp.float32),
+                        ids, g, ordered=True)
+    # the op has no dense W grad; emit a token-shaped zero binding
     return {"W@GRAD": [token]}
 
 
@@ -87,8 +117,8 @@ def _send(ctx, ins, attrs):
         t._dense = np.asarray(x_np)
         return np.zeros((), np.float32)
 
-    token = jax.pure_callback(_store, jax.ShapeDtypeStruct((), jnp.float32),
-                              x)
+    token = io_callback(_store, jax.ShapeDtypeStruct((), jnp.float32),
+                        x, ordered=True)
     return {"Out": [token]}
 
 
@@ -104,5 +134,6 @@ def _recv(ctx, ins, attrs):
             return np.zeros(shape, np.float32)
         return t._dense.reshape(shape).astype(np.float32)
 
-    out = jax.pure_callback(_load, jax.ShapeDtypeStruct(shape, jnp.float32))
+    out = io_callback(_load, jax.ShapeDtypeStruct(shape, jnp.float32),
+                      ordered=True)
     return {"Out": [out]}

@@ -59,8 +59,9 @@ class LoweringContext:
     def rng(self) -> jax.Array:
         if self._rng is None:
             # Eager mode without an explicit key: draw from a process-global
-            # counter so results vary call to call (like the reference's
-            # global generator).
+            # counter (entropy-seeded at import, like the reference's
+            # entropy-seeded global generators; paddle.seed() overrides it
+            # for deterministic reproduction).
             global _EAGER_SEED
             _EAGER_SEED += 1
             return jax.random.PRNGKey(_EAGER_SEED)
@@ -70,7 +71,17 @@ class LoweringContext:
         return self.axis_env.get(int(ring_id))
 
 
-_EAGER_SEED = 0
+def _init_eager_seed() -> int:
+    # OS entropy so every process/run draws a distinct sequence; fold in the
+    # process index so distributed eager ranks decorrelate (dropout masks,
+    # dpsgd noise) even when launched with identical env entropy.
+    import os
+    base = int.from_bytes(os.urandom(4), "little")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    return (base ^ (rank * 0x9E3779B9)) & 0x7FFFFFFF
+
+
+_EAGER_SEED = _init_eager_seed()
 
 
 # ---------------------------------------------------------------------------

@@ -473,6 +473,17 @@ def _top_k(ctx, ins, attrs):
 
 @register("one_hot_v2", not_differentiable=True)
 def _one_hot_v2(ctx, ins, attrs):
+    """reference operators/one_hot_v2_op.cc InferShape: out = x.shape+[depth]
+    (no squeeze — that is legacy ``one_hot`` behaviour)."""
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register("one_hot", not_differentiable=True)
+def _one_hot(ctx, ins, attrs):
+    """Legacy one_hot (reference operators/one_hot_op.cc): requires trailing
+    dim 1 and replaces it with depth."""
     x = ins["X"][0]
     depth = attrs["depth"]
     if x.ndim > 0 and x.shape[-1] == 1:

@@ -316,13 +316,17 @@ class Optimizer:
         return append_backward(loss, parameter_list=plist,
                                no_grad_set=no_grad_set)
 
-    def apply_gradients(self, params_grads):
+    def apply_gradients(self, params_grads, startup_program=None):
         # Operate on the program that owns the parameters — minimize() may
         # be called outside the program_guard the model was built under.
+        # Accumulator/LR init ops must land in the startup program the user
+        # will run: the one passed in, or the one the main program was built
+        # against (recorded by program_guard).
         from .framework.program import program_guard
         program = params_grads[0][0].block.program if params_grads \
             else default_main_program()
-        with program_guard(program):
+        startup = startup_program or getattr(program, "_startup_ref", None)
+        with program_guard(program, startup):
             block = program.global_block()
             if self._grad_clip is not None:
                 params_grads = self._grad_clip._clip_static(params_grads,
@@ -337,13 +341,13 @@ class Optimizer:
         return ops
 
     def apply_optimize(self, loss, startup_program, params_grads):
-        return self.apply_gradients(params_grads)
+        return self.apply_gradients(params_grads, startup_program)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
-        opt_ops = self.apply_gradients(params_grads)
+        opt_ops = self.apply_gradients(params_grads, startup_program)
         return opt_ops, params_grads
 
     # -- dygraph (2.0) eager path -----------------------------------------
