@@ -9,7 +9,7 @@ sensitive reductions/normalizations stay in float32.
 # Ops that benefit from bf16 (MXU-bound) — the white list.
 WHITE_LIST = {
     "conv2d", "depthwise_conv2d", "conv3d", "conv2d_transpose",
-    "matmul", "matmul_v2", "mul",
+    "matmul", "matmul_v2", "mul", "fused_attention_qkv",
 }
 
 # Numerically dangerous in low precision — forced float32.

@@ -31,6 +31,7 @@ class _StateSpec:
     """Collects the mutable state a traced step touches."""
 
     def __init__(self, layers: Sequence[Layer], optimizers: Sequence):
+        self.layers = list(layers)
         self.params: List[Parameter] = []
         self.buffers: List[Tensor] = []
         seen = set()
@@ -71,7 +72,8 @@ class _StateSpec:
 
 
 def to_static(function: Optional[Callable] = None, *, layers=None,
-              optimizers=None, donate_state: bool = True):
+              optimizers=None, donate_state: bool = True, mesh=None,
+              param_rules=None, arg_specs=None):
     """Compile a dygraph function into one XLA computation.
 
     - forward-only: ``fast = to_static(model)`` or
@@ -79,6 +81,12 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
     - train step: ``@to_static(layers=[model], optimizers=[opt])`` around a
       function that calls backward() and opt.step(); param/accumulator
       updates happen inside the compiled computation.
+    - SPMD: pass ``mesh`` (jax.sharding.Mesh) + ``param_rules``
+      (distributed.sharding.ShardingRules) + ``arg_specs`` (PartitionSpec
+      per step argument) and the whole train step compiles GSPMD-sharded:
+      params/grads/optimizer state laid out per the rules, XLA inserting
+      the collectives. This subsumes the reference's ParallelExecutor +
+      allreduce-insertion machinery for the dygraph path.
 
     Inputs may be Tensors or arrays; outputs mirror the function's returns
     with Tensors for traced arrays. Retraces on new input shapes/dtypes.
@@ -86,7 +94,9 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
     if function is not None and isinstance(function, Layer) and layers is None:
         layer = function
         return to_static(lambda *a, **kw: layer(*a, **kw), layers=[layer],
-                         optimizers=optimizers, donate_state=donate_state)
+                         optimizers=optimizers, donate_state=donate_state,
+                         mesh=mesh, param_rules=param_rules,
+                         arg_specs=arg_specs)
 
     def deco(fn):
         spec_holder = {}
@@ -112,7 +122,19 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
                 new_state = spec.snapshot()
                 return out_arrays, new_state
             donate = (0,) if donate_state else ()
-            return jax.jit(traced, donate_argnums=donate)
+            if mesh is None:
+                return jax.jit(traced, donate_argnums=donate)
+            from jax.sharding import NamedSharding
+            from .distributed.sharding import ShardingRules, state_shardings
+            rules = param_rules or ShardingRules([])
+            st_sh = state_shardings(get_spec(), mesh, rules)
+            st_sh["grads"] = [sh if present else None
+                              for sh, present in zip(st_sh["params"],
+                                                     grads_present)]
+            arg_sh = (tuple(NamedSharding(mesh, s) for s in arg_specs)
+                      if arg_specs is not None else None)
+            return jax.jit(traced, donate_argnums=donate,
+                           in_shardings=(st_sh, arg_sh))
 
         @functools.wraps(fn)
         def wrapper(*args):
@@ -144,6 +166,73 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
     if function is not None:
         return deco(function)
     return deco
+
+
+def to_static_multi_step(fn, *, layers, optimizers=None,
+                         donate_state: bool = True, mesh=None,
+                         param_rules=None, arg_specs=None):
+    """Compile K chained train steps into ONE XLA execution (lax.scan).
+
+    The analog of the reference's ``train_from_dataset`` trainer loop
+    (framework/trainer.h:41, multi_trainer.cc:120): keep the host out of
+    the per-step path entirely. Each wrapper argument carries a leading
+    step dimension [K, ...]; the returned outputs are stacked [K, ...].
+
+    The state pytree must be structurally stable across steps — run ONE
+    ordinary ``to_static`` step first so grads and optimizer accumulators
+    exist, then hand the same layers/optimizers here.
+    """
+    spec = _StateSpec(layers or [], optimizers or [])
+    compiled_holder = {}
+
+    def make_compiled():
+        def body(state, x):
+            spec.load(state)
+            targs = jax.tree_util.tree_map(
+                lambda a: Tensor(a, stop_gradient=True), x)
+            out = fn(*targs)
+            out_arrays = jax.tree_util.tree_map(
+                lambda t: t.value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            return spec.snapshot(), out_arrays
+
+        def traced(state, args):
+            new_state, outs = jax.lax.scan(body, state, args)
+            return outs, new_state
+
+        donate = (0,) if donate_state else ()
+        if mesh is None:
+            return jax.jit(traced, donate_argnums=donate)
+        from jax.sharding import NamedSharding
+        from .distributed.sharding import ShardingRules, state_shardings
+        rules = param_rules or ShardingRules([])
+        st_sh = state_shardings(spec, mesh, rules)
+        st_sh["grads"] = [sh if p.grad is not None else None
+                          for sh, p in zip(st_sh["params"], spec.params)]
+        arg_sh = (tuple(NamedSharding(mesh, s) for s in arg_specs)
+                  if arg_specs is not None else None)
+        return jax.jit(traced, donate_argnums=donate,
+                       in_shardings=(st_sh, arg_sh))
+
+    def wrapper(*args):
+        state = spec.snapshot()
+        if "c" not in compiled_holder:
+            compiled_holder["c"] = make_compiled()
+        arr_args = jax.tree_util.tree_map(
+            lambda a: a.value if isinstance(a, Tensor) else jnp.asarray(a),
+            tuple(args), is_leaf=lambda t: isinstance(t, Tensor))
+        try:
+            outs, new_state = compiled_holder["c"](state, arr_args)
+        except Exception:
+            spec.load(state)
+            raise
+        spec.load(new_state)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True)
+            if isinstance(a, jax.Array) else a, outs)
+
+    wrapper.__wrapped__ = fn
+    return wrapper
 
 
 def save(layer, path: str, input_spec=None):

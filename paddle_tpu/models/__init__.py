@@ -1,0 +1,7 @@
+"""Model zoo (analog of paddle.vision.models + the GPT/ERNIE workloads in
+BASELINE.json; the reference ships the transformer stack at
+python/paddle/nn/layer/transformer.py and vision models under
+python/paddle/vision/models/)."""
+
+from .gpt import (GPT_CONFIGS, GPTForCausalLM, GPTModel, gpt2_medium,
+                  gpt2_small, gpt2_tiny)

@@ -1,0 +1,209 @@
+"""GPT-2-class decoder-only LM — the flagship transformer workload.
+
+Capability analog: the reference's transformer stack
+(python/paddle/nn/layer/transformer.py:387-950) powering the GPT-2/ERNIE
+baselines in BASELINE.json (configs[2]). TPU-first design decisions:
+
+- attention goes through the single differentiable ``fused_attention_qkv``
+  op with ``causal=True`` (no materialized [s, s] mask var; XLA/pallas
+  decide the kernel), instead of the reference's composed matmul+softmax
+  with an additive mask tensor;
+- pre-LN blocks (stable in bf16 — the AMP O2 path keeps master fp32
+  params and casts matmul inputs to bf16 for the MXU);
+- vocab padded to a multiple of 128 so the LM-head matmul tiles the MXU
+  exactly; the pad rows are masked out of the loss with ignore_index.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..dygraph.layers import Layer, LayerList
+from ..dygraph.tape import run_op
+from ..dygraph.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layers_common import Dropout, Embedding, LayerNorm, Linear
+from ..param_attr import ParamAttr
+from ..initializer import NormalInitializer
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded up to a 128 multiple
+    max_position_embeddings: int = 1024
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_hidden_size: int = 4096
+    dropout: float = 0.0
+    init_std: float = 0.02
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self, include_embeddings: bool = True) -> int:
+        h, f, L = self.hidden_size, self.ffn_hidden_size, self.num_layers
+        per_layer = (4 * h * h + 4 * h) + (2 * h * f + h + f) + 4 * h
+        n = L * per_layer + 2 * h  # final LN
+        if include_embeddings:
+            n += (self.vocab_size + self.max_position_embeddings) * h
+        return n
+
+
+GPT_CONFIGS = {
+    # name: (hidden, layers, heads, ffn)
+    "gpt2-tiny": GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                           ffn_hidden_size=512, vocab_size=1024,
+                           max_position_embeddings=128),
+    "gpt2-small": GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                            ffn_hidden_size=3072),
+    "gpt2-medium": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                             ffn_hidden_size=4096),   # the 345M baseline
+    "gpt2-xl": GPTConfig(hidden_size=1600, num_layers=48, num_heads=25,
+                         ffn_hidden_size=6400),
+}
+
+
+class GPTAttention(Layer):
+    """Causal self-attention: fused qkv projection (one [h, 3h] matmul on
+    the MXU) + the differentiable fused attention op."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        w = ParamAttr(initializer=NormalInitializer(0.0, cfg.init_std))
+        # single qkv projection — one MXU matmul instead of three
+        self.qkv_proj = Linear(cfg.hidden_size, 3 * cfg.hidden_size,
+                               weight_attr=w)
+        wo = ParamAttr(initializer=NormalInitializer(
+            0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers)))
+        self.out_proj = Linear(cfg.hidden_size, cfg.hidden_size,
+                               weight_attr=wo)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x, cache=None):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])  # [3, b, h, s, d]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        if cache is not None:
+            k = run_op("concat", {"X": [cache[0], k]}, {"axis": 2})["Out"][0]
+            v = run_op("concat", {"X": [cache[1], v]}, {"axis": 2})["Out"][0]
+            cache = (k, v)
+        out = run_op("fused_attention_qkv",
+                     {"Q": [q], "K": [k], "V": [v]},
+                     {"causal": True})["Out"][0]
+        out = out.transpose([0, 2, 1, 3]).reshape([b, s, cfg.hidden_size])
+        out = self.dropout(self.out_proj(out))
+        return out if cache is None else (out, cache)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        w = ParamAttr(initializer=NormalInitializer(0.0, cfg.init_std))
+        wo = ParamAttr(initializer=NormalInitializer(
+            0.0, cfg.init_std / math.sqrt(2.0 * cfg.num_layers)))
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        self.fc1 = Linear(cfg.hidden_size, cfg.ffn_hidden_size,
+                          weight_attr=w)
+        self.fc2 = Linear(cfg.ffn_hidden_size, cfg.hidden_size,
+                          weight_attr=wo)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x, cache=None):
+        if cache is None:
+            x = x + self.attn(self.ln1(x))
+        else:
+            a, cache = self.attn(self.ln1(x), cache)
+            x = x + a
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)),
+                                             approximate=True)))
+        return x if cache is None else (x, cache)
+
+
+class GPTModel(Layer):
+    """Embeddings + pre-LN decoder stack + final LN."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        w = ParamAttr(initializer=NormalInitializer(0.0, cfg.init_std))
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=w)
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                             weight_attr=w)
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = LayerList([GPTBlock(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, cache=None, position_offset=0):
+        s = input_ids.shape[1]
+        import jax.numpy as jnp
+        pos = Tensor(jnp.arange(position_offset, position_offset + s,
+                                dtype=jnp.int64)[None, :],
+                     stop_gradient=True)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            if cache is None:
+                x = blk(x)
+            else:
+                x, c = blk(x, cache[i])
+                new_caches.append(c)
+        x = self.ln_f(x)
+        return x if cache is None else (x, new_caches)
+
+    def gen_cache(self, batch_size):
+        import jax.numpy as jnp
+        z = Tensor(jnp.zeros((batch_size, self.cfg.num_heads, 0,
+                              self.cfg.head_dim), jnp.float32),
+                   stop_gradient=True)
+        return [(z, z) for _ in range(self.cfg.num_layers)]
+
+
+class GPTForCausalLM(Layer):
+    """LM head tied to the token embedding (weight sharing, like GPT-2)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, labels=None, cache=None,
+                position_offset=0):
+        if cache is None:
+            h = self.gpt(input_ids)
+        else:
+            h, cache = self.gpt(input_ids, cache, position_offset)
+        # tied LM head: h @ wte.T
+        logits = run_op("matmul_v2",
+                        {"X": [h], "Y": [self.gpt.wte.weight]},
+                        {"trans_y": True})["Out"][0]
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]),
+                labels.reshape([-1, 1]), ignore_index=-100)
+            return loss
+        return logits if cache is None else (logits, cache)
+
+
+def gpt2_tiny() -> GPTForCausalLM:
+    return GPTForCausalLM(GPT_CONFIGS["gpt2-tiny"])
+
+
+def gpt2_small() -> GPTForCausalLM:
+    return GPTForCausalLM(GPT_CONFIGS["gpt2-small"])
+
+
+def gpt2_medium() -> GPTForCausalLM:
+    return GPTForCausalLM(GPT_CONFIGS["gpt2-medium"])
