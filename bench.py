@@ -11,14 +11,28 @@ AdamW update, all state donated) with AMP O2 bf16 so matmuls hit the MXU.
 Model FLOPs are counted analytically (fwd matmul FLOPs x3 for fwd+bwd),
 the standard MFU accounting; peak is the chip's bf16 rating
 (v5e: 197 TFLOP/s; override with BENCH_PEAK_FLOPS).
+
+Measurement discipline (each item burned a previous round):
+- the timed call uses the SAME (steps, batch, seq) shapes as the warmup
+  call, so zero recompiles land inside the timed window;
+- synchronization is a real value fetch (np.asarray) inside the window —
+  ``block_until_ready`` does not reliably synchronize through the
+  remote-TPU tunnel;
+- a computed MFU > 100% is physically impossible and aborts the run
+  instead of being printed;
+- each OOM retry runs in a FRESH subprocess (in-process retries don't
+  actually release the failed attempt's remote device buffers).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+OOM_RC = 42  # child exit code meaning "out of device memory"
 
 PEAK_BF16 = (
     # per-chip dense bf16 peak FLOP/s; order matters (longest match first)
@@ -74,68 +88,51 @@ def build_steps(model_name: str):
     return cfg, step, multi
 
 
-def run(model_name: str, batch: int, seq: int, steps: int):
-    """Time `steps` chained train steps inside ONE XLA execution
-    (lax.scan) — per-call dispatch timing is unreliable through the
-    remote-TPU tunnel, and a fused loop is the idiomatic TPU trainer
-    anyway (train_from_dataset analog)."""
-    cfg, step, multi = build_steps(model_name)
-    rng = np.random.RandomState(0)
-    ids1 = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    lab1 = np.roll(ids1, -1, axis=1).astype(np.int32)
-    # warmup single steps: materialize grads + optimizer accumulators so
-    # the scanned state structure is stable
-    for _ in range(2):
-        step(ids1, lab1).value.block_until_ready()
-    ids = rng.randint(0, cfg.vocab_size,
-                      (steps, batch, seq)).astype(np.int32)
-    labels = np.roll(ids, -1, axis=2).astype(np.int32)
-    # compile the scan loop
-    multi(ids[:1], labels[:1]).value.block_until_ready()
-    t0 = time.perf_counter()
-    losses = multi(ids, labels)
-    losses.value.block_until_ready()
-    dt = (time.perf_counter() - t0) / steps
-    return cfg, dt, float(np.asarray(losses.value)[-1])
+def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
+    """Measure one (model, batch, seq, steps) config; print the JSON line.
 
-
-def main():
+    Exit codes: 0 ok; OOM_RC device OOM; 3 implausible measurement.
+    """
     import jax
-
-    model_name = os.environ.get("BENCH_MODEL", "gpt2-medium")
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
 
     dev = jax.devices()[0]
     peak = detect_peak_flops(dev)
 
-    cfg = dt = loss = None
-    err_msg = None
-    while batch >= 1:
-        try:
-            cfg, dt, loss = run(model_name, batch, seq, steps)
-            break
-        except Exception as e:  # OOM -> halve the batch
-            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
-                err_msg = str(e)[:200]
-                # drop the traceback (it pins the failed attempt's arrays
-                # through frame locals) and let the device free before retry
-                e.__traceback__ = None
-                del e
-                import gc
-                gc.collect()
-                time.sleep(3)
-                batch //= 2
-                continue
-            raise
-    if cfg is None:
-        raise RuntimeError(f"OOM even at batch 1: {err_msg}")
+    try:
+        cfg, step, multi = build_steps(model_name)
+        rng = np.random.RandomState(0)
+        ids1 = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        lab1 = np.roll(ids1, -1, axis=1).astype(np.int32)
+        # warmup single steps: materialize grads + optimizer accumulators
+        # so the scanned state structure is stable
+        for _ in range(2):
+            np.asarray(step(ids1, lab1).value)
+        ids = rng.randint(0, cfg.vocab_size,
+                          (steps, batch, seq)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=2).astype(np.int32)
+        # compile + warm the scan at the EXACT shape we will time
+        np.asarray(multi(ids, labels).value)
+        # timed: same shapes => no recompile; fetch inside the window is
+        # the only reliable sync through the remote-TPU tunnel
+        t0 = time.perf_counter()
+        losses = np.asarray(multi(ids, labels).value)
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            sys.stderr.write("OOM: " + msg[:300] + "\n")
+            return OOM_RC
+        raise
 
+    loss = float(losses[-1])
     tokens_per_sec = batch * seq / dt
     fpt = model_flops_per_token(cfg, seq)
     mfu = fpt * tokens_per_sec / peak
-    n_params = cfg.num_params()
+    if mfu > 1.0:
+        sys.stderr.write(
+            f"implausible MFU {mfu * 100:.1f}% (step {dt * 1000:.3f} ms) — "
+            "timing did not synchronize; refusing to report\n")
+        return 3
     print(json.dumps({
         "metric": "gpt2_345m_mfu" if model_name == "gpt2-medium"
         else f"{model_name}_mfu",
@@ -146,12 +143,48 @@ def main():
         "step_time_ms": round(dt * 1000, 2),
         "batch": batch,
         "seq": seq,
-        "n_params": n_params,
+        "n_params": cfg.num_params(),
         "loss": round(loss, 4),
         "device": getattr(dev, "device_kind", str(dev)),
         "peak_flops": peak,
     }))
+    return 0
+
+
+def main() -> int:
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-medium")
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+
+    here = os.path.abspath(__file__)
+    last_err = ""
+    while batch >= 1:
+        proc = subprocess.run(
+            [sys.executable, here, "--child", model_name, str(batch),
+             str(seq), str(steps)],
+            cwd=os.path.dirname(here), capture_output=True, text=True,
+            timeout=3600)
+        if proc.returncode == 0:
+            # relay the child's single JSON line
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("{")][-1]
+            print(line)
+            return 0
+        if proc.returncode == OOM_RC:
+            last_err = proc.stderr.strip().splitlines()[-1] if proc.stderr \
+                else "OOM"
+            batch //= 2   # fresh subprocess => device memory actually freed
+            continue
+        sys.stderr.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        raise RuntimeError(f"bench child failed (rc={proc.returncode})")
+    raise RuntimeError(f"OOM even at batch 1: {last_err}")
 
 
 if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        sys.exit(child_main(sys.argv[i + 1], int(sys.argv[i + 2]),
+                            int(sys.argv[i + 3]), int(sys.argv[i + 4])))
     sys.exit(main())

@@ -149,7 +149,7 @@ class GPTModel(Layer):
         s = input_ids.shape[1]
         import jax.numpy as jnp
         pos = Tensor(jnp.arange(position_offset, position_offset + s,
-                                dtype=jnp.int64)[None, :],
+                                dtype=jnp.int32)[None, :],
                      stop_gradient=True)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
