@@ -31,6 +31,11 @@ from .dygraph.layers import seed
 from .dygraph.tensor import Parameter, Tensor
 from .framework_io import (load, load_inference_model, load_persistables,
                            save, save_inference_model, save_persistables)
+from . import flags as _flags_module
+from .flags import get_flags, set_flags
+from . import io
+from . import dataset
+from .dataset import InMemoryDataset, QueueDataset
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

@@ -119,8 +119,9 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling: float = 2.**15,
             rewrite_program(loss.block.program, self._amp_lists, dest_dtype)
             return self._optimizer.backward(loss, **kw)
 
-        def apply_gradients(self, params_grads):
-            return self._optimizer.apply_gradients(params_grads)
+        def apply_gradients(self, params_grads, startup_program=None):
+            return self._optimizer.apply_gradients(params_grads,
+                                                   startup_program)
 
         def get_loss_scaling(self):
             return self._loss_scaling

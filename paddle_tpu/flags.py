@@ -1,0 +1,105 @@
+"""Global flags registry — the TPU-native analog of the reference's
+gflags plane (paddle/fluid/platform/flags.cc, exposed to Python via
+pybind/global_value_getter_setter.cc as paddle.set_flags/get_flags,
+python/paddle/fluid/framework.py:5576,5599).
+
+Flags are typed, documented at definition, overridable from the
+environment (``FLAGS_<name>``, read at first access), and settable at
+runtime via :func:`set_flags`. Unknown names raise ValueError, matching
+the reference's enforce behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_defs: Dict[str, dict] = {}
+_values: Dict[str, Any] = {}
+# bumped on every set_flags; compile caches (Executor, jit.to_static)
+# fold it into their keys so flag changes retrace instead of silently
+# reusing a computation lowered under the old flag values
+_version = 0
+
+
+def version() -> int:
+    with _lock:
+        return _version
+
+
+def _coerce(value, typ):
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return typ(value)
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    """Register a flag (framework-internal, like a C++ DEFINE_*)."""
+    with _lock:
+        if name in _defs:
+            return
+        _defs[name] = {"default": default, "type": type(default),
+                       "help": help_str}
+
+
+def get_flags(names):
+    """Return {name: value} for a flag name or list of names."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        if name not in _defs:
+            raise ValueError(f"unknown flag {name!r}")
+        with _lock:
+            if name in _values:
+                out[name] = _values[name]
+                continue
+            env = os.environ.get("FLAGS_" + name)
+            d = _defs[name]
+            val = _coerce(env, d["type"]) if env is not None else d["default"]
+            _values[name] = val
+            out[name] = val
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Set flags at runtime: ``set_flags({'check_nan_inf': True})``.
+    Atomic: either every entry applies or none does."""
+    global _version
+    unknown = [n for n in flags if n not in _defs]
+    if unknown:
+        raise ValueError(f"unknown flag(s) {unknown!r}")
+    coerced = {n: _coerce(v, _defs[n]["type"]) for n, v in flags.items()}
+    with _lock:
+        _values.update(coerced)
+        _version += 1
+
+
+def get_flag(name: str):
+    return get_flags(name)[name]
+
+
+def list_flags() -> Dict[str, dict]:
+    """All registered flags with metadata (help/default/current)."""
+    with _lock:
+        return {n: {**d, "current": _values.get(n, d["default"])}
+                for n, d in _defs.items()}
+
+
+# Core flags (analog of platform/flags.cc definitions)
+define_flag("check_nan_inf", False,
+            "Scan every op output for NaN/Inf during execution "
+            "(ref platform/flags.cc:44).")
+define_flag("use_pallas_attention", True,
+            "Lower fused_attention_qkv to the Pallas flash-attention "
+            "kernel when the shapes allow it.")
+define_flag("use_pallas_layer_norm", False,
+            "Lower layer_norm to the fused Pallas kernel (default off: "
+            "XLA's fusion is competitive at small hidden sizes).")
+define_flag("pallas_min_seq", 1024,
+            "Minimum sequence length before attention switches from the "
+            "XLA-composed form to the Pallas flash kernel.")

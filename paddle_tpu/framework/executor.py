@@ -28,9 +28,32 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags as _flags
 from ..ops import registry as _reg
 from .program import Program, Variable, default_main_program
 from .scope import Scope, global_scope
+
+
+class NanInfError(FloatingPointError):
+    """Raised (via host callback) when FLAGS_check_nan_inf finds a
+    non-finite op output — analog of the reference's
+    details/nan_inf_utils_detail.cc scan hooked in operator.cc:1056."""
+
+
+def _nan_inf_callback(op_type, var_name, bad_count):
+    if int(bad_count):
+        raise NanInfError(
+            f"op {op_type!r} output {var_name!r} contains {int(bad_count)} "
+            f"NaN/Inf values (FLAGS_check_nan_inf=true)")
+
+
+def check_nan_inf_hook(op_type: str, name: str, value):
+    """Attach a runtime NaN/Inf scan to a traced value (no-op for
+    non-float arrays)."""
+    if not jnp.issubdtype(jnp.asarray(value).dtype, jnp.inexact):
+        return
+    bad = jnp.size(value) - jnp.sum(jnp.isfinite(value).astype(jnp.int32))
+    jax.debug.callback(_nan_inf_callback, op_type, name, bad)
 
 
 class _BlockRunner:
@@ -65,9 +88,12 @@ class _BlockRunner:
                     vals.append(env[n])
                 ins[slot] = vals
             outs = _reg.execute(ctx, op.type, ins, op.attrs)
+            check = _flags.get_flag("check_nan_inf")
             for slot, names in op.outputs.items():
                 vals = outs.get(slot, [])
                 for n, v in zip(names, vals):
+                    if check:
+                        check_nan_inf_hook(op.type, n, v)
                     env[n] = v
         return env
 
@@ -159,7 +185,7 @@ class Executor:
         # entry so id() reuse after GC can't alias a stale entry.
         scope_sig = hash(frozenset(scope.all_var_names()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               id(scope), scope_sig)
+               id(scope), scope_sig, _flags.version())
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, feed_arrays, fetch_names, scope)
@@ -182,6 +208,42 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def train_from_dataset(self, program=None, dataset=None,
+                           scope: Optional[Scope] = None,
+                           fetch_list: Optional[Sequence[Any]] = None,
+                           fetch_info: Optional[Sequence[str]] = None,
+                           print_period: int = 100, debug: bool = False):
+        """Run one epoch over a Dataset (analog of
+        executor.py:1597 train_from_dataset -> MultiTrainer::Run,
+        multi_trainer.cc:120). The reference spawns C++ device-worker
+        threads; here each padded batch feeds the trace-once compiled
+        step — same capability (no python in the per-op loop), TPU
+        execution model. Returns the list of fetched values from the
+        final batch (and prints periodically like LodTensorPrinter)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        last = None
+        for step, feed in enumerate(dataset.batch_iterator()):
+            last = self.run(program, feed=feed, fetch_list=fetch_names,
+                            scope=scope)
+            if debug and fetch_names and step % print_period == 0:
+                infos = fetch_info or fetch_names
+                msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                                for n, v in zip(infos, last))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None,
+                           scope: Optional[Scope] = None,
+                           fetch_list: Optional[Sequence[Any]] = None,
+                           **kw):
+        """Inference twin of train_from_dataset (executor.py parity)."""
+        return self.train_from_dataset(program, dataset, scope,
+                                       fetch_list, **kw)
 
     def close(self):
         self._cache.clear()

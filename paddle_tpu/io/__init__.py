@@ -1,0 +1,19 @@
+"""paddle_tpu.io — data pipeline: Dataset/Sampler/DataLoader + device
+prefetch.
+
+Analog of python/paddle/fluid/reader.py:414 (DataLoader.from_generator),
+python/paddle/fluid/dataloader/ (Dataset/BatchSampler/fetcher) and the
+C++ double-buffer host->device pipeline
+(operators/reader/buffered_reader.cc).
+"""
+
+from .dataloader import (BatchSampler, DataLoader, Dataset, IterableDataset,
+                         RandomSampler, Sampler, SequenceSampler,
+                         TensorDataset, default_collate_fn)
+from .device_loader import DeviceLoader
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "Sampler",
+    "SequenceSampler", "RandomSampler", "BatchSampler", "DataLoader",
+    "DeviceLoader", "default_collate_fn",
+]

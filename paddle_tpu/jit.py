@@ -141,7 +141,10 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
             spec = get_spec()
             state = spec.snapshot()
             grads_present = tuple(g is not None for g in state["grads"])
-            key = grads_present
+            # flags version: a set_flags() between calls must retrace so
+            # flag-gated lowerings (pallas attention/LN) take effect
+            from . import flags as _flags
+            key = (grads_present, _flags.version())
             if key not in compiled_holder:
                 compiled_holder[key] = make_compiled(grads_present)
             arr_args = jax.tree_util.tree_map(

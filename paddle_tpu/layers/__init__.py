@@ -11,3 +11,7 @@ from .loss import (cross_entropy, sigmoid_cross_entropy_with_logits,
                    softmax_with_cross_entropy, square_error_cost)
 from .tensor import (argmax, assign, create_global_var, create_parameter,
                      fill_constant, increment, ones, zeros)
+from .control_flow import (While, case, cond, equal, greater_equal,
+                           greater_than, less_equal, less_than, logical_and,
+                           logical_not, logical_or, not_equal, switch_case,
+                           while_loop)

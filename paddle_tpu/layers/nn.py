@@ -342,8 +342,21 @@ def _reduce(op, x, dim=None, keep_dim=False, name=None):
     attrs = {"keep_dim": keep_dim}
     if dim is None:
         attrs["reduce_all"] = True
+        if keep_dim:
+            out.shape = ((1,) * len(x.shape) if x.shape is not None
+                         else None)
+        else:
+            out.shape = ()
     else:
-        attrs["dim"] = [dim] if isinstance(dim, int) else list(dim)
+        dims = [dim] if isinstance(dim, int) else list(dim)
+        attrs["dim"] = dims
+        if x.shape is not None:
+            nd = len(x.shape)
+            axes = {d % nd for d in dims}
+            out.shape = tuple(
+                1 if i in axes else s
+                for i, s in enumerate(x.shape)
+                if keep_dim or i not in axes)
     helper.append_op(op, inputs={"X": x}, outputs={"Out": out}, attrs=attrs)
     return out
 
@@ -402,6 +415,15 @@ def flatten(x, axis=1, name=None):
 def concat(input, axis=0, name=None):
     helper = LayerHelper("concat", name=name)
     out = helper.create_variable_for_type_inference(input[0].dtype)
+    shapes = [v.shape for v in input]
+    if all(s is not None for s in shapes):
+        nd = len(shapes[0])
+        ax = axis % nd
+        cat = sum(s[ax] for s in shapes)
+        if any(s[ax] < 0 for s in shapes):
+            cat = -1
+        out.shape = tuple(cat if i == ax else shapes[0][i]
+                          for i in range(nd))
     helper.append_op("concat", inputs={"X": list(input)},
                      outputs={"Out": out}, attrs={"axis": axis})
     return out

@@ -1,0 +1,43 @@
+"""Native (C++) components, compiled on demand and loaded via ctypes.
+
+The reference implements its data/runtime plane in C++ (data_feed.cc,
+executor.cc, distributed/ RPC); this package holds the TPU build's native
+equivalents. Binding is ctypes over a C ABI (pybind11 is unavailable in
+this image). Each component compiles lazily with g++ on first use and
+caches the .so next to the source keyed by source mtime; a pure-Python
+fallback keeps every feature functional where no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_libs = {}
+
+
+def build_and_load(name: str) -> Optional[ctypes.CDLL]:
+    """Compile native/<name>.cpp -> _<name>.so (if stale) and dlopen it.
+    Returns None when no g++ toolchain is available."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, f"{name}.cpp")
+        so = os.path.join(here, f"_{name}.so")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     src, "-o", so],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        _libs[name] = lib
+        return lib

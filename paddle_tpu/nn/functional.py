@@ -224,6 +224,23 @@ def cross_entropy(input, label, soft_label: bool = False,
                    "axis": axis})
     loss = outs["Loss"][0]
     if reduction == "mean":
+        if not soft_label:
+            # Match the reference's nll_loss total_weight semantics
+            # (operators/nll_loss_op.h): the mean is over NON-ignored
+            # labels, not all elements; otherwise padded batches deflate
+            # the loss and gradients.
+            import jax.numpy as jnp
+
+            lbl = _t(label)
+            ignore = Tensor(jnp.full(lbl.shape, ignore_index,
+                                     lbl.value.dtype), stop_gradient=True)
+            valid = run_op("not_equal", {"X": [lbl], "Y": [ignore]},
+                           {})["Out"][0]
+            count = valid.astype("float32").sum()
+            one = Tensor(jnp.asarray(1.0, jnp.float32), stop_gradient=True)
+            denom = run_op("elementwise_max", {"X": [count], "Y": [one]},
+                           {})["Out"][0]
+            return loss.sum() / denom.astype(loss.dtype)
         return loss.mean()
     if reduction == "sum":
         return loss.sum()

@@ -14,15 +14,17 @@ dropout op on the probabilities when needed.
 from __future__ import annotations
 
 import functools
+import logging
 import math
 
 import jax
 import jax.numpy as jnp
 
 from .registry import register
+from .. import flags
 
-# Toggled by paddle_tpu.flags: use pallas flash attention when beneficial.
-_PALLAS_MIN_SEQ = 1024
+_logger = logging.getLogger(__name__)
+_warned_fallback = False
 
 
 def _composed_attention(q, k, v, mask, causal, scale):
@@ -47,14 +49,23 @@ def _fused_attention_qkv(ctx, ins, attrs):
     scale = attrs.get("scale") or (1.0 / math.sqrt(q.shape[-1]))
 
     use_pallas = (attrs.get("use_pallas", "auto") != "never"
-                  and q.shape[-2] >= _PALLAS_MIN_SEQ
+                  and flags.get_flag("use_pallas_attention")
+                  and q.shape[-2] >= flags.get_flag("pallas_min_seq")
+                  and q.shape[-2] == k.shape[-2]
                   and mask is None)
     if use_pallas:
         try:
             from .pallas.flash_attention import flash_attention
-        except ImportError:
-            flash_attention = None
-        if flash_attention is not None:
             return {"Out": [flash_attention(q, k, v, causal=causal,
                                             scale=scale)]}
+        except (ValueError, ImportError) as e:
+            # untileable shapes, or a jax without pallas/Mosaic —
+            # fall back to the XLA-composed form, loudly (once)
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                _logger.warning(
+                    "fused_attention_qkv: pallas flash attention "
+                    "unavailable for shape %s (%s); using XLA-composed "
+                    "attention (O(s^2) memory)", q.shape, e)
     return {"Out": [_composed_attention(q, k, v, mask, causal, scale)]}

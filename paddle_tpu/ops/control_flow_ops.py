@@ -1,0 +1,168 @@
+"""Control-flow op lowerings: nested-block IR -> lax.while_loop / lax.cond.
+
+Capability analog of the reference's controlflow operators
+(operators/controlflow/while_op.cc, conditional_block_op.cc) — redesigned
+for XLA's functional control-flow model instead of scope-juggling
+interpreters:
+
+- the reference's ``while_op`` re-enters the C++ executor per iteration
+  with per-step scopes (while_op.cc RunImpl); here the sub-block is traced
+  ONCE into a ``lax.while_loop`` body — loop-carried variables are an
+  explicit functional carry, shapes/dtypes must be loop-invariant (the
+  XLA contract, and the price of trace-once compilation);
+- the reference's ``conditional_block_op`` runs at most one branch by
+  skipping ops; here both branches are traced and ``lax.cond`` selects at
+  run time (both compiled, one executed — the TPU way);
+- gradients: ``cond`` is differentiated by the registry's generic
+  jax.vjp-derived grad (lax.cond has a VJP). A dynamic-trip-count
+  ``while`` is NOT reverse-differentiable under XLA (unbounded residual
+  storage); setting attr ``differentiable=True`` with ``max_iters=N``
+  lowers to a masked ``lax.scan`` over N steps instead, which is — the
+  honest TPU analog of the reference's step-scope-recording while_grad.
+
+Name plumbing: lowerings receive values keyed by slot; the *names* needed
+to seed the sub-block environment ride in attrs (``carry_names``,
+``cond_name``, ``param_names``, ``out_names``), recorded by the layer
+builders in layers/control_flow.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _runner(ctx, op_name):
+    runner = getattr(ctx, "block_runner", None)
+    if runner is None:
+        raise RuntimeError(
+            f"{op_name} requires the static-graph executor (sub-block "
+            "tracing); it cannot run as a standalone eager op")
+    return runner
+
+
+def _scalar_bool(x):
+    return jnp.reshape(jnp.asarray(x), ()).astype(bool)
+
+
+@register("while", no_grad_slots=("Condition",))
+def _while(ctx, ins, attrs):
+    """Loop-carried vars in slot X (final values -> Out, same order);
+    read-only closure vars in slot Params; Condition is the pre-loop
+    condition value, recomputed by the sub-block each iteration."""
+    runner = _runner(ctx, "while")
+    sub = int(attrs["sub_block"])
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    param_names = list(attrs.get("param_names", []))
+    params = dict(zip(param_names, ins.get("Params", [])))
+    cond0 = ins["Condition"][0]
+    xs0 = tuple(ins["X"])
+    rng0 = ctx.rng()
+
+    def run_body(cond_val, xs, sub_rng):
+        env = dict(params)
+        env.update(zip(carry_names, xs))
+        env[cond_name] = cond_val
+        env = runner.run_block(sub, env, sub_rng)
+        return env[cond_name], tuple(env[n] for n in carry_names)
+
+    if attrs.get("differentiable"):
+        n = int(attrs.get("max_iters", 0))
+        if n <= 0:
+            raise ValueError(
+                "while with differentiable=True requires max_iters > 0 "
+                "(bounded trip count is what makes the backward storable)")
+
+        def step(carry, _):
+            cond_val, xs, rng = carry
+            rng, sub_rng = jax.random.split(rng)
+            new_cond, new_xs = run_body(cond_val, xs, sub_rng)
+            live = _scalar_bool(cond_val)
+            sel = lambda a, b: jnp.where(live, a, b)  # noqa: E731
+            xs = tuple(sel(a, b) for a, b in zip(new_xs, xs))
+            cond_val = sel(new_cond, cond_val)
+            return (cond_val, xs, rng), None
+
+        (cond_f, xs, _), _ = jax.lax.scan(
+            step, (cond0, xs0, rng0), None, length=n)
+        return {"Out": list(xs)}
+
+    def cond_fn(carry):
+        return _scalar_bool(carry[0])
+
+    def body_fn(carry):
+        cond_val, xs, rng = carry
+        rng, sub_rng = jax.random.split(rng)
+        new_cond, new_xs = run_body(cond_val, xs, sub_rng)
+        return new_cond, new_xs, rng
+
+    _, xs, _ = jax.lax.while_loop(cond_fn, body_fn, (cond0, xs0, rng0))
+    return {"Out": list(xs)}
+
+
+@register("cond", no_grad_slots=("Cond",))
+def _cond(ctx, ins, attrs):
+    """Two-branch conditional: both sub-blocks read Params (names in
+    param_names) and must define every name in out_names with matching
+    shapes/dtypes (the lax.cond contract)."""
+    runner = _runner(ctx, "cond")
+    param_names = list(attrs.get("param_names", []))
+    out_names = list(attrs["out_names"])
+    pred = _scalar_bool(ins["Cond"][0])
+    vals = tuple(ins.get("Params", []))
+    rng = ctx.rng()
+    rng_t, rng_f = jax.random.split(rng)
+
+    def make_branch(blk_idx, sub_rng):
+        def branch(operands):
+            env = dict(zip(param_names, operands))
+            env = runner.run_block(blk_idx, env, sub_rng)
+            missing = [n for n in out_names if n not in env]
+            if missing:
+                raise KeyError(
+                    f"cond branch (block {blk_idx}) did not produce "
+                    f"outputs {missing}")
+            return tuple(env[n] for n in out_names)
+        return branch
+
+    try:
+        outs = jax.lax.cond(pred,
+                            make_branch(int(attrs["sub_block_t"]), rng_t),
+                            make_branch(int(attrs["sub_block_f"]), rng_f),
+                            vals)
+    except TypeError as e:
+        raise TypeError(
+            "cond branches must return matching shapes/dtypes for every "
+            f"output ({e}) — XLA compiles both branches to one signature"
+        ) from e
+    return {"Out": list(outs)}
+
+
+@register("switch_case", no_grad_slots=("Index",))
+def _switch_case(ctx, ins, attrs):
+    """N-way branch over sub_blocks (last block = default): lax.switch."""
+    runner = _runner(ctx, "switch_case")
+    param_names = list(attrs.get("param_names", []))
+    out_names = list(attrs["out_names"])
+    blocks = [int(b) for b in attrs["sub_blocks"]]
+    idx = jnp.reshape(jnp.asarray(ins["Index"][0]), ()).astype(jnp.int32)
+    # any out-of-range index (negative or too large) runs the default,
+    # which the layer builder places last — paddle switch_case contract
+    idx = jnp.where((idx < 0) | (idx >= len(blocks)),
+                    jnp.int32(len(blocks) - 1), idx)
+    vals = tuple(ins.get("Params", []))
+    rng = ctx.rng()
+
+    def make_branch(i, blk_idx):
+        def branch(operands):
+            env = dict(zip(param_names, operands))
+            env = runner.run_block(blk_idx, env, jax.random.fold_in(rng, i))
+            return tuple(env[n] for n in out_names)
+        return branch
+
+    outs = jax.lax.switch(idx, [make_branch(i, b)
+                                for i, b in enumerate(blocks)], vals)
+    return {"Out": list(outs)}

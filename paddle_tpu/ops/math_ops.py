@@ -313,7 +313,11 @@ def _isinf_v2(ctx, ins, attrs):
 
 @register("increment", not_differentiable=True)
 def _increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    x = ins["X"][0]
+    # dtype-preserving: an int64 loop counter must stay int64 (the
+    # reference kernel adds in the var's own dtype; a float step on an
+    # int counter would also break lax.while_loop carry typing)
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
 
 
 @register("p_norm")

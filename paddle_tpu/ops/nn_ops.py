@@ -338,6 +338,16 @@ def _layer_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
+    if (len(axes) == 1 and ins.get("Scale") and ins.get("Bias")
+            and x.shape[-1] % 128 == 0):
+        from .. import flags
+        if flags.get_flag("use_pallas_layer_norm"):
+            from .pallas.layer_norm import fused_layer_norm_with_stats
+            y, m, v = fused_layer_norm_with_stats(
+                x, ins["Scale"][0], ins["Bias"][0], eps)
+            stat_shape = x.shape[:begin]
+            return {"Y": [y], "Mean": [m.reshape(stat_shape)],
+                    "Variance": [v.reshape(stat_shape)]}
     m = jnp.mean(x, axis=axes, keepdims=True)
     v = jnp.var(x, axis=axes, keepdims=True)
     inv = jax.lax.rsqrt(v + eps)

@@ -1,0 +1,10 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+Capability analog of the reference's ``operators/fused/`` CUDA kernels
+(e.g. multihead_matmul_op.cc) — but TPU-first: block-tiled VMEM kernels
+with online softmax / fused normalization, compiled by Mosaic, and
+numerically validated against the XLA-composed lowerings in tests.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .layer_norm import fused_layer_norm  # noqa: F401

@@ -1,0 +1,24 @@
+"""Shared policy helpers for the Pallas kernels (single source of truth
+for backend detection and block-size selection, so the kernels cannot
+drift apart)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Run kernels in the Pallas interpreter on CPU backends (tests,
+    virtual meshes); compile via Mosaic on TPU."""
+    return jax.default_backend() == "cpu"
+
+
+def pick_block(n: int, preferred: int, minimum: int = 8) -> int:
+    """Largest power-of-two divisor of ``n`` in [minimum, preferred]
+    (Mosaic sublane alignment); 0 when none exists."""
+    b = preferred
+    while b >= minimum:
+        if n % b == 0:
+            return b
+        b //= 2
+    return 0

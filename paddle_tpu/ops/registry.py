@@ -189,19 +189,31 @@ def _generic_grad_lowering(ctx: LoweringContext, fw_type: str,
         gs = iter(out_grads.get(slot, []))
         out_grads[slot] = [next(gs) if m else None for m in mask]
 
-    # Split differentiable vs pass-through inputs. Only inexact (float)
-    # arrays can carry cotangents.
-    diff_ins, aux_ins = {}, {}
+    # Split differentiable vs pass-through inputs PER VALUE. Only inexact
+    # (float) arrays can carry cotangents; slots may mix (e.g. a while
+    # loop's carry holding an int counter next to float state).
+    diff_ins: Dict[str, Dict[str, Any]] = {}
+    aux_ins: Dict[str, Dict[int, Any]] = {}
     for slot, vals in fw_ins.items():
-        if slot in fw_def.no_grad_slots or not all(
-                jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact) for v in vals):
-            aux_ins[slot] = vals
-        else:
-            diff_ins[slot] = vals
+        dmap, amap = {}, {}
+        no_grad = slot in fw_def.no_grad_slots
+        for i, v in enumerate(vals):
+            if not no_grad and jnp.issubdtype(jnp.asarray(v).dtype,
+                                              jnp.inexact):
+                dmap[str(i)] = v
+            else:
+                amap[i] = v
+        if dmap:
+            diff_ins[slot] = dmap
+        aux_ins[slot] = amap
 
     def fwd(d_ins):
-        all_ins = dict(aux_ins)
-        all_ins.update(d_ins)
+        all_ins = {}
+        for slot, vals in fw_ins.items():
+            dmap = d_ins.get(slot, {})
+            amap = aux_ins[slot]
+            all_ins[slot] = [dmap[str(i)] if str(i) in dmap else amap[i]
+                             for i in range(len(vals))]
         return fw_def.lowering(ctx, all_ins, attrs)
 
     primal_out, vjp_fn = jax.vjp(fwd, diff_ins)
@@ -226,15 +238,29 @@ def _generic_grad_lowering(ctx: LoweringContext, fw_type: str,
             cot[slot].append(g)
 
     (d_grads,) = vjp_fn(cot)
-    # Filter each slot's grads down to the wanted positions so the block
-    # runner's zip(names, vals) stays aligned with the grad op's outputs.
+    # Re-assemble per-slot grad lists (zeros for non-differentiable
+    # positions whose grad is still wanted), then filter to the wanted
+    # positions so the block runner's zip(names, vals) stays aligned
+    # with the grad op's outputs.
     wanted_masks = attrs.get("__in_grad_wanted__", {})
     out = {}
-    for slot, vals in d_grads.items():
+    for slot, vals in fw_ins.items():
+        if slot in fw_def.no_grad_slots:
+            continue
+        gmap = d_grads.get(slot, {})
+        grads = []
+        for i, v in enumerate(vals):
+            if str(i) in gmap:
+                grads.append(gmap[str(i)])
+            else:
+                va = jnp.asarray(v)
+                grads.append(jnp.zeros(va.shape, jnp.float32)
+                             if not jnp.issubdtype(va.dtype, jnp.inexact)
+                             else jnp.zeros_like(va))
         mask = wanted_masks.get(slot)
         if mask is not None:
-            vals = [v for v, m in zip(vals, mask) if m]
-        out[f"{slot}{GRAD_SLOT_SUFFIX}"] = vals
+            grads = [g for g, m in zip(grads, mask) if m]
+        out[f"{slot}{GRAD_SLOT_SUFFIX}"] = grads
     return out
 
 

@@ -1,0 +1,244 @@
+"""Control-flow ops + layer builders: while/cond/case/switch_case over
+the nested-block IR, lowered to lax.while_loop / lax.scan / lax.cond /
+lax.switch, including gradients through cond and bounded while.
+
+Capability parity targets: operators/controlflow/while_op.cc,
+conditional_block_op.cc; python/paddle/fluid/layers/control_flow.py
+(While:1043, while_loop:1238).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.layers as layers
+from paddle_tpu.framework import Executor, Program, Scope, append_backward
+from paddle_tpu.framework.program import program_guard
+
+
+def _run(prog, fetch, feed=None, scope=None):
+    return Executor().run(prog, feed=feed or {}, fetch_list=fetch,
+                          scope=scope or Scope())
+
+
+def test_while_loop_counter():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        ten = layers.fill_constant([1], "int64", 10)
+        acc = layers.fill_constant([1], "float32", 0.0)
+
+        def cond_fn(i, acc):
+            return layers.less_than(i, ten)
+
+        def body_fn(i, acc):
+            new_acc = layers.elementwise_add(
+                acc, layers.cast(i, "float32"))
+            new_i = layers.increment(i, 1.0)
+            return new_i, new_acc
+
+        i_out, acc_out = layers.while_loop(cond_fn, body_fn, [i, acc])
+    iv, accv = _run(prog, [i_out.name, acc_out.name])
+    assert iv[0] == 10
+    assert accv[0] == sum(range(10))  # 45
+
+
+def test_while_class_block_style():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 5)
+        x = layers.fill_constant([1], "float32", 1.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            doubled = layers.scale(x, scale=2.0)
+            layers.assign(doubled, x)
+            layers.increment(i, 1.0)
+            layers.assign(layers.less_than(i, n), cond)
+    (xv,) = _run(prog, [x.name])
+    assert xv[0] == 32.0  # 2^5
+
+
+def test_cond_selects_branch():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        pred_in = layers.data("p", shape=[1], dtype="bool")
+        out = layers.cond(pred_in,
+                          lambda: layers.scale(x, scale=2.0),
+                          lambda: layers.scale(x, scale=-1.0))
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    (o_true,) = _run(prog, [out.name],
+                     feed={"x": xv, "p": np.array([True])})
+    (o_false,) = _run(prog, [out.name],
+                      feed={"x": xv, "p": np.array([False])})
+    np.testing.assert_allclose(o_true, xv * 2)
+    np.testing.assert_allclose(o_false, -xv)
+
+
+def test_cond_gradient():
+    """Gradients flow through the taken branch (lax.cond VJP)."""
+    for pred_val, want in ((True, 2.0), (False, 3.0)):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            blk = prog.global_block()
+            blk.create_parameter("w", shape=[4])
+            w = blk.var("w")
+            pred_in = layers.data("p", shape=[1], dtype="bool")
+            y = layers.cond(pred_in,
+                            lambda: layers.scale(w, scale=2.0),
+                            lambda: layers.scale(w, scale=3.0))
+            loss = layers.reduce_sum(y)
+        pg = append_backward(loss)
+        grad_name = dict((p.name, g.name) for p, g in pg)["w"]
+        scope = Scope()
+        import jax.numpy as jnp
+        scope.set_var("w", jnp.ones(4, jnp.float32))
+        (gw,) = Executor().run(prog, feed={"p": np.array([pred_val])},
+                               fetch_list=[grad_name], scope=scope)
+        np.testing.assert_allclose(gw, np.full(4, want))
+
+
+def test_while_differentiable_scan():
+    """max_iters turns the loop into a masked lax.scan with a backward:
+    x doubles 3 times -> dx = 8."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        blk = prog.global_block()
+        blk.create_parameter("w", shape=[2])
+        w = blk.var("w")
+        i = layers.fill_constant([1], "int64", 0)
+        three = layers.fill_constant([1], "int64", 3)
+        x = layers.assign(w)
+
+        def cond_fn(i, x):
+            return layers.less_than(i, three)
+
+        def body_fn(i, x):
+            return layers.increment(i, 1.0), layers.scale(x, scale=2.0)
+
+        _, x_out = layers.while_loop(cond_fn, body_fn, [i, x],
+                                     max_iters=6)
+        loss = layers.reduce_sum(x_out)
+    pg = append_backward(loss)
+    grad_name = dict((p.name, g.name) for p, g in pg)["w"]
+    import jax.numpy as jnp
+    scope = Scope()
+    scope.set_var("w", jnp.asarray([1.0, 2.0], jnp.float32))
+    out, gw = Executor().run(prog, fetch_list=[loss.name, grad_name],
+                             scope=scope)
+    np.testing.assert_allclose(out, (1 + 2) * 8.0)
+    np.testing.assert_allclose(gw, [8.0, 8.0])
+
+
+def test_while_loop_closure_param():
+    """Loop body reading a read-only outer var (Params plumbing)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        step = layers.data("step", shape=[1], dtype="float32")
+        i = layers.fill_constant([1], "int64", 0)
+        four = layers.fill_constant([1], "int64", 4)
+        acc = layers.fill_constant([1], "float32", 0.0)
+
+        def cond_fn(i, acc):
+            return layers.less_than(i, four)
+
+        def body_fn(i, acc):
+            return (layers.increment(i, 1.0),
+                    layers.elementwise_add(acc, step))
+
+        _, acc_out = layers.while_loop(cond_fn, body_fn, [i, acc])
+    (accv,) = _run(prog, [acc_out.name],
+                   feed={"step": np.array([2.5], np.float32)})
+    np.testing.assert_allclose(accv, [10.0])
+
+
+def test_switch_case():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        idx = layers.data("idx", shape=[1], dtype="int32")
+        x = layers.data("x", shape=[2], dtype="float32")
+        out = layers.switch_case(
+            idx,
+            [lambda: layers.scale(x, scale=1.0),
+             lambda: layers.scale(x, scale=10.0),
+             lambda: layers.scale(x, scale=100.0)])
+    xv = np.array([1.0, 2.0], np.float32)
+    for i, mult in ((0, 1), (1, 10), (2, 100), (7, 100)):  # 7 -> default
+        (o,) = _run(prog, [out.name],
+                    feed={"idx": np.array([i], np.int32), "x": xv})
+        np.testing.assert_allclose(o, xv * mult)
+
+
+def test_case_first_match_wins():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = layers.data("x", shape=[1], dtype="float32")
+        one = layers.fill_constant([1], "float32", 1.0)
+        two = layers.fill_constant([1], "float32", 2.0)
+        out = layers.case(
+            [(layers.less_than(x, one), lambda: layers.scale(x, scale=-1.0)),
+             (layers.less_than(x, two), lambda: layers.scale(x, scale=10.0))],
+            default=lambda: layers.scale(x, scale=100.0))
+    for xv, want in ((0.5, -0.5), (1.5, 15.0), (5.0, 500.0)):
+        (o,) = _run(prog, [out.name],
+                    feed={"x": np.array([xv], np.float32)})
+        np.testing.assert_allclose(o, [want], rtol=1e-6)
+
+
+def test_while_loop_swapped_carries():
+    """Body returning a permutation of the loop vars must not clobber
+    (two-phase write-back)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        one = layers.fill_constant([1], "int64", 1)
+        a = layers.fill_constant([1], "float32", 1.0)
+        b = layers.fill_constant([1], "float32", 2.0)
+
+        def cond_fn(i, a, b):
+            return layers.less_than(i, one)
+
+        def body_fn(i, a, b):
+            return layers.increment(i, 1.0), b, a  # swap
+
+        _, a_out, b_out = layers.while_loop(cond_fn, body_fn, [i, a, b])
+    av, bv = _run(prog, [a_out.name, b_out.name])
+    np.testing.assert_allclose(av, [2.0])
+    np.testing.assert_allclose(bv, [1.0])
+
+
+def test_switch_case_negative_index_runs_default():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        idx = layers.data("idx", shape=[1], dtype="int32")
+        x = layers.data("x", shape=[2], dtype="float32")
+        out = layers.switch_case(
+            idx,
+            [lambda: layers.scale(x, scale=1.0),
+             lambda: layers.scale(x, scale=10.0)],
+            default=lambda: layers.scale(x, scale=100.0))
+    xv = np.array([1.0, 2.0], np.float32)
+    (o,) = _run(prog, [out.name],
+                feed={"idx": np.array([-1], np.int32), "x": xv})
+    np.testing.assert_allclose(o, xv * 100)
+
+
+def test_while_shape_change_rejected():
+    """Loop-variant shapes must fail loudly (the XLA contract)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 3)
+        x = layers.fill_constant([1], "float32", 1.0)
+
+        def cond_fn(i, x):
+            return layers.less_than(i, n)
+
+        def body_fn(i, x):
+            grown = layers.concat([x, x], axis=0)  # shape doubles
+            return layers.increment(i, 1.0), grown
+
+        _, x_out = layers.while_loop(cond_fn, body_fn, [i, x])
+    with pytest.raises(Exception):
+        _run(prog, [x_out.name])

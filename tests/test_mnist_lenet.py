@@ -61,10 +61,25 @@ def test_lenet_trains():
     assert all(scope.find_var(p.name) is not None
                for p in main.all_parameters())
 
+    # feed through the real data pipeline: DataLoader with background
+    # workers + DeviceLoader double-buffer prefetch (buffered_reader.cc
+    # analog) — the train loop must never wait on host batch assembly
+    from paddle_tpu.io import DataLoader, Dataset, DeviceLoader
+
     rng = np.random.RandomState(0)
+
+    class Digits(Dataset):
+        def __len__(self):
+            return 120 * 64
+
+        def __getitem__(self, idx):
+            x, y = make_digits(1, np.random.RandomState(idx))
+            return x[0], y[0]
+
+    loader = DeviceLoader(DataLoader(Digits(), batch_size=64,
+                                     num_workers=2), depth=2)
     first_loss, last_loss, last_acc = None, None, None
-    for step in range(120):
-        x, y = make_digits(64, rng)
+    for x, y in loader:
         loss_v, acc_v = exe.run(main, feed={"img": x, "label": y},
                                 fetch_list=[avg_loss, acc], scope=scope)
         if first_loss is None:
