@@ -13,7 +13,7 @@ from . import ops
 from . import framework
 from .framework import (Program, Executor, Scope, global_scope,
                         default_main_program, default_startup_program,
-                        program_guard, append_backward)
+                        device_guard, program_guard, append_backward)
 from . import initializer
 from . import layers
 from . import optimizer_lr
@@ -26,7 +26,7 @@ from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
 from .dygraph.parallel import DataParallel
 from . import amp
 from . import jit
-from .dygraph import no_grad, to_tensor, to_variable
+from .dygraph import grad, no_grad, to_tensor, to_variable
 from .dygraph.layers import seed
 from .dygraph.tensor import Parameter, Tensor
 from .framework_io import (load, load_inference_model, load_persistables,
@@ -36,6 +36,14 @@ from .flags import get_flags, set_flags
 from . import io
 from . import dataset
 from .dataset import InMemoryDataset, QueueDataset
+from . import metric
+from . import vision
+from . import hapi
+from .hapi import Model
+from . import monitor
+from . import profiler
+from . import incubate
+from . import reader
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

@@ -182,10 +182,31 @@ class _ParallelRunner:
             new_state = {n: env.get(n, state.get(n)) for n in written}
             return fetches, new_state
 
-        in_specs = ({n: P() for n in state_in},
+        ndev = mesh.shape[axis]
+
+        def state_spec(n):
+            # ZeRO stage-2 convention (fleet _apply_sharding_stage2):
+            # "@SHARD" state (shard params + their optimizer
+            # accumulators) is partitioned over the data axis — each
+            # device holds 1/ndev of it. Scalar accumulators that merely
+            # inherit the name (beta-pow etc., shape [1]) stay
+            # replicated: their dim0 doesn't divide across the axis.
+            if "@SHARD" in n:
+                v = scope.find_var(n)
+                if v is not None and np.ndim(v) >= 1 and \
+                        np.shape(v)[0] % ndev == 0 and np.shape(v)[0] > 1:
+                    return P(axis)
+            if "@LOCAL" in n:
+                # per-device state (e.g. DGC error residuals): declared
+                # with a leading [ndev] axis, each device owns its slice
+                return P(axis)
+            return P()
+
+        in_specs = ({n: state_spec(n) for n in state_in},
                     {k: P(axis) for k in feed_arrays},
                     P())
-        out_specs = ([P(axis) for _ in fetch_names], {n: P() for n in written})
+        out_specs = ([P(axis) for _ in fetch_names],
+                     {n: state_spec(n) for n in written})
         fn = jax.shard_map(shard_step, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
         return jax.jit(fn), state_in, written

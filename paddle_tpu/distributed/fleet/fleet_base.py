@@ -106,6 +106,14 @@ class Fleet:
     def main_program(self):
         return self._final_program or default_main_program()
 
+    def pipeline_runner(self):
+        """GPipe runner for a strategy.pipeline minimize()."""
+        runner = getattr(self, "_pipeline_runner", None)
+        if runner is None:
+            raise ValueError("no pipeline program; set strategy.pipeline "
+                             "and call minimize() first")
+        return runner
+
     # -- checkpoint passthroughs ------------------------------------------
     def save_persistables(self, executor, dirname, main_program=None):
         from ...framework_io import save_persistables
@@ -164,11 +172,22 @@ class _DistributedOptimizer:
             rewrite_program(program, AutoMixedPrecisionLists(
                 cfg.get("custom_white_list"), cfg.get("custom_black_list")))
 
-        # 3. backward + (optionally merged/compressed) grads + allreduce
+        # 3. backward (with recompute segments when enabled) +
+        #    (optionally compressed) grads + allreduce
+        checkpoints = None
+        if strategy.recompute:
+            checkpoints = (strategy.recompute_configs or {}).get(
+                "checkpoints")
+            if not checkpoints:
+                raise ValueError(
+                    "strategy.recompute=True requires "
+                    "recompute_configs={'checkpoints': [...]}")
         params_grads = opt.backward(loss, startup_program, parameter_list,
-                                    no_grad_set)
+                                    no_grad_set, checkpoints=checkpoints)
         nranks = self._nranks()
-        if nranks > 1:
+        # localsgd trains locally between syncs — no per-grad allreduce;
+        # sharding replaces it with reduce-scatter (step 4a)
+        if nranks > 1 and not strategy.localsgd and not strategy.sharding:
             params_grads = _insert_grad_allreduce(
                 program, params_grads, nranks,
                 dgc=strategy.dgc, dgc_configs=strategy.dgc_configs)
@@ -179,7 +198,55 @@ class _DistributedOptimizer:
             params_grads = _apply_gradient_merge(
                 program, params_grads, cfg["k_steps"], cfg["avg"])
 
+        # 4a. ZeRO stage-2 sharding: reduce-scatter grads, per-shard
+        # optimizer state/update, all-gather params (north-star axis;
+        # absent from the reference's proto:94-130 — new capability)
+        if strategy.sharding and nranks > 1:
+            cfg = strategy.sharding_configs or {}
+            stage = int(cfg.get("stage", 2))
+            # the strategy default sharding_degree=1 means "auto":
+            # shard over the whole data axis
+            degree = int(cfg.get("sharding_degree", 0))
+            degree = nranks if degree <= 1 else degree
+            if stage != 2 or degree != nranks:
+                raise NotImplementedError(
+                    f"static sharding supports stage=2 over the full "
+                    f"data axis (got stage={stage}, sharding_degree="
+                    f"{degree} with nranks={nranks}); stage 3 lives on "
+                    "the dygraph to_static(mesh=..., FULLY_SHARDED_"
+                    "RULES) path")
+            if getattr(opt, "_grad_clip", None) is not None:
+                raise NotImplementedError(
+                    "sharding + grad_clip: clip norms would need a "
+                    "cross-shard reduction; unset grad_clip or use the "
+                    "dygraph to_static(mesh=...) path")
+            opt_ops = _apply_sharding_stage2(
+                program, opt, params_grads, nranks, startup_program)
+            from ...compiler import CompiledProgram
+            self._fleet._final_program = CompiledProgram(
+                program).with_data_parallel(loss_name=loss.name)
+            return opt_ops, params_grads
+
         opt_ops = opt.apply_gradients(params_grads, startup_program)
+
+        # 4b. localsgd periodic parameter averaging (after optimizer ops)
+        if strategy.localsgd and nranks > 1:
+            cfg = strategy.localsgd_configs or {}
+            _apply_localsgd(program, [p for p, _ in params_grads], nranks,
+                            int(cfg.get("k_steps", 1)))
+
+        # 4c. pipeline: split into per-stage phase programs (GPipe);
+        # the user drives them with fleet.pipeline_runner()
+        if strategy.pipeline:
+            from .pipeline import PipelineRunner, split_pipeline_program
+            cfg = strategy.pipeline_configs or {}
+            n_mb = int(cfg.get("accumulate_steps", 1)) or 1
+            stages = split_pipeline_program(program, n_mb)
+            program._pipeline_stages = stages
+            program._pipeline_num_microbatches = n_mb
+            self._fleet._pipeline_runner = PipelineRunner(stages, n_mb)
+            self._fleet._final_program = program
+            return opt_ops, params_grads
 
         # 5. compile for SPMD execution (graph_execution meta-optimizer)
         from ...compiler import CompiledProgram
@@ -200,11 +267,15 @@ def _insert_grad_allreduce(program: Program, params_grads, nranks: int,
                            dgc=False, dgc_configs=None):
     """GradAllReduce transpiler (transpiler/collective.py:36,178): after
     each gradient is produced, scale by 1/nranks and c_allreduce_sum it.
-    With dgc, a dgc_momentum-style top-k sparsification with error feedback
-    runs before the allreduce (operators/optimizers/dgc_momentum_op /
-    details/sparse_all_reduce_op_handle.cc analog; the communication itself
-    stays dense — ICI bandwidth makes sparse transport unnecessary, the
-    *optimizer semantics* of DGC are preserved)."""
+
+    With ``dgc``, deep-gradient-compression semantics run before the
+    allreduce (operators/optimizers/dgc_op.cc /
+    details/sparse_all_reduce_op_handle.cc analog): error feedback
+    accumulates locally, only the top-(1-sparsity) magnitudes are
+    exchanged each step, the residual carries over. The transport stays
+    dense (ICI bandwidth makes sparse wire formats pointless on TPU);
+    what is preserved is the OPTIMIZER semantics — sparsified update +
+    error feedback — which is where DGC's accuracy behavior lives."""
     block = program.global_block()
     # position: before the first optimize-role op, else at end
     insert_at = len(block.ops)
@@ -212,24 +283,310 @@ def _insert_grad_allreduce(program: Program, params_grads, nranks: int,
         if op.attrs.get("op_role") == "optimize":
             insert_at = i
             break
+    cfg = dict(dgc_configs or {})
+    sparsity = float((cfg.get("sparsity") or [0.999])[-1])
     new_ops: List[Operator] = []
     out_pg = []
+
+    def emit(type_, ins, outs, attrs=None):
+        new_ops.append(Operator(block, type_, ins, outs,
+                                dict(attrs or {}, op_role="backward")))
+
+    def tmp(stem):
+        v = block.create_var(unique_name.generate(stem),
+                             stop_gradient=True)
+        return v.name
+
     for p, g in params_grads:
-        scaled = block.create_var(unique_name.generate(g.name + "@DP"),
-                                  stop_gradient=True)
-        new_ops.append(Operator(
-            block, "scale", {"X": [g.name]}, {"Out": [scaled.name]},
-            {"scale": 1.0 / nranks, "op_role": "backward"}))
-        reduced = block.create_var(unique_name.generate(g.name + "@AR"),
-                                   stop_gradient=True)
-        new_ops.append(Operator(
-            block, "c_allreduce_sum", {"X": [scaled.name]},
-            {"Out": [reduced.name]},
-            {"ring_id": 0, "op_role": "backward"}))
-        out_pg.append((p, reduced))
+        send_name = g.name
+        if dgc and p.numel() and p.numel() > 1:
+            numel = int(p.numel())
+            k = max(1, int(round(numel * (1.0 - sparsity))))
+            # residual is PER-DEVICE state (each device sparsifies its
+            # own local grad): leading [nranks] axis + @LOCAL marker ->
+            # the compiler gives it PartitionSpec(dp), so checkpoints
+            # and recompiles keep every device's error feedback
+            err = unique_name.generate(f"{p.name}@DGC_ERR@LOCAL")
+            _persistable_zeros(program, err,
+                               [nranks] + list(p.shape), p.dtype)
+            err_r = tmp(g.name + "@DGC_ER")
+            err_xs = tmp(g.name + "@DGC_EXS")
+            emit("reshape2", {"X": [err]},
+                 {"Out": [err_r], "XShape": [err_xs]},
+                 {"shape": list(p.shape)})
+            corrected = tmp(g.name + "@DGC_C")
+            emit("elementwise_add", {"X": [g.name], "Y": [err_r]},
+                 {"Out": [corrected]}, {"axis": -1})
+            flat = tmp(g.name + "@DGC_F")
+            xshape = tmp(g.name + "@DGC_XS")
+            emit("reshape2", {"X": [corrected]},
+                 {"Out": [flat], "XShape": [xshape]}, {"shape": [-1]})
+            mag = tmp(g.name + "@DGC_A")
+            emit("abs", {"X": [flat]}, {"Out": [mag]})
+            topv, topi = tmp(g.name + "@DGC_TV"), tmp(g.name + "@DGC_TI")
+            emit("top_k", {"X": [mag]}, {"Out": [topv], "Indices": [topi]},
+                 {"k": k})
+            thresh = tmp(g.name + "@DGC_TH")
+            emit("reduce_min", {"X": [topv]}, {"Out": [thresh]},
+                 {"reduce_all": True})
+            keep_b = tmp(g.name + "@DGC_KB")
+            emit("greater_equal", {"X": [mag], "Y": [thresh]},
+                 {"Out": [keep_b]})
+            keep_f = tmp(g.name + "@DGC_KF")
+            emit("cast", {"X": [keep_b]}, {"Out": [keep_f]},
+                 {"in_dtype": "bool", "out_dtype": p.dtype})
+            keep = tmp(g.name + "@DGC_K")
+            kxs = tmp(g.name + "@DGC_KXS")
+            emit("reshape2", {"X": [keep_f]},
+                 {"Out": [keep], "XShape": [kxs]},
+                 {"shape": list(p.shape)})
+            send = tmp(g.name + "@DGC_S")
+            emit("elementwise_mul", {"X": [corrected], "Y": [keep]},
+                 {"Out": [send]}, {"axis": -1})
+            # error feedback: residual = corrected * (1 - keep), written
+            # back in the per-device [1, *shape] layout
+            inv = tmp(g.name + "@DGC_I")
+            emit("scale", {"X": [keep]}, {"Out": [inv]},
+                 {"scale": -1.0, "bias": 1.0})
+            resid = tmp(g.name + "@DGC_R")
+            emit("elementwise_mul", {"X": [corrected], "Y": [inv]},
+                 {"Out": [resid]}, {"axis": -1})
+            rxs = tmp(g.name + "@DGC_RXS")
+            emit("reshape2", {"X": [resid]},
+                 {"Out": [err], "XShape": [rxs]},
+                 {"shape": [1] + list(p.shape)})
+            send_name = send
+        scaled = tmp(g.name + "@DP")
+        emit("scale", {"X": [send_name]}, {"Out": [scaled]},
+             {"scale": 1.0 / nranks})
+        reduced = tmp(g.name + "@AR")
+        emit("c_allreduce_sum", {"X": [scaled]}, {"Out": [reduced]},
+             {"ring_id": 0})
+        out_pg.append((p, block.var(reduced)))
     block.ops[insert_at:insert_at] = new_ops
     program.bump_version()
     return out_pg
+
+
+def _apply_sharding_stage2(program: Program, opt, params_grads,
+                           nranks: int, startup_program=None):
+    """ZeRO stage-2 rewrite for the static shard_map path:
+
+    per (param, grad):
+      grad -> flatten+pad -> c_reducescatter (each device owns one
+      shard, averaged) -> optimizer update on the param SHARD with
+      shard-sized accumulators -> c_allgather -> unpad/reshape -> param.
+
+    Sharded state rides a naming convention: any persistable var whose
+    name contains ``@SHARD`` gets PartitionSpec(dp) instead of
+    replication in the compiled step (compiler.py), so each device's HBM
+    holds 1/nranks of the optimizer state and the shard params — the
+    stage-2 memory win. The forward still sees full (replicated) params.
+    """
+    block = program.global_block()
+    startup = startup_program or getattr(program, "_startup_ref", None)
+    proxies = []
+    for p, g in params_grads:
+        numel = int(p.numel())
+        L = -(-numel // nranks)          # ceil
+        padded = L * nranks
+        g_flat = unique_name.generate(g.name + "@FLAT")
+        g_xs = unique_name.generate(g.name + "@XS")
+        for n in (g_flat, g_xs):
+            block.create_var(n, stop_gradient=True)
+        block.append_op("reshape2", {"X": [g.name]},
+                        {"Out": [g_flat], "XShape": [g_xs]},
+                        {"shape": [-1], "op_role": "backward"})
+        if padded != numel:
+            pad = unique_name.generate(g.name + "@PAD")
+            block.create_var(pad, stop_gradient=True)
+            block.append_op("fill_constant", {}, {"Out": [pad]},
+                            {"shape": [padded - numel], "dtype": p.dtype,
+                             "value": 0.0, "op_role": "backward"})
+            cat = unique_name.generate(g.name + "@CAT")
+            block.create_var(cat, stop_gradient=True)
+            block.append_op("concat", {"X": [g_flat, pad]},
+                            {"Out": [cat]},
+                            {"axis": 0, "op_role": "backward"})
+            g_flat = cat
+        g_rs = unique_name.generate(g.name + "@RS")
+        block.create_var(g_rs, stop_gradient=True)
+        block.append_op("c_reducescatter", {"X": [g_flat]},
+                        {"Out": [g_rs]},
+                        {"ring_id": 0, "op_role": "backward"})
+        g_avg = unique_name.generate(g.name + "@RSA")
+        block.create_var(g_avg, stop_gradient=True)
+        block.append_op("scale", {"X": [g_rs]}, {"Out": [g_avg]},
+                        {"scale": 1.0 / nranks, "op_role": "backward"})
+
+        # shard proxy param: declared global shape [padded]; per-device
+        # view under shard_map is [padded/nranks]
+        shard_name = f"{p.name}@SHARD"
+        proxy = block.create_var(shard_name, shape=[padded],
+                                 dtype=p.dtype, persistable=True,
+                                 stop_gradient=True)
+        proxy.is_parameter = True
+        proxy.trainable = True
+        proxy.regularizer = p.regularizer
+        # startup: shard init = flatten+pad of the initialized param
+        if startup is not None:
+            sb = startup.global_block()
+            sb.create_var(shard_name, shape=[padded], dtype=p.dtype,
+                          persistable=True, stop_gradient=True)
+            sf = unique_name.generate(shard_name + "@F")
+            sxs = unique_name.generate(shard_name + "@FXS")
+            for n in (sf, sxs):
+                sb.create_var(n, stop_gradient=True)
+            sb.append_op("reshape2", {"X": [p.name]},
+                         {"Out": [sf], "XShape": [sxs]}, {"shape": [-1]})
+            if padded != numel:
+                spad = unique_name.generate(shard_name + "@P")
+                sb.create_var(spad, stop_gradient=True)
+                sb.append_op("fill_constant", {}, {"Out": [spad]},
+                             {"shape": [padded - numel],
+                              "dtype": p.dtype, "value": 0.0})
+                sb.append_op("concat", {"X": [sf, spad]},
+                             {"Out": [shard_name]}, {"axis": 0})
+            else:
+                sb.append_op("assign", {"X": [sf]}, {"Out": [shard_name]})
+        proxies.append((proxy, block.var(g_avg), p, numel, padded))
+
+    # optimizer update on the shards (accumulators inherit the @SHARD
+    # name -> sharded placement by the same convention)
+    proxy_pg = [(pr, gv) for pr, gv, _, _, _ in proxies]
+    opt_ops = opt.apply_gradients(proxy_pg, startup_program)
+
+    # all-gather updated shards back into the full params
+    for proxy, _, p, numel, padded in proxies:
+        full = unique_name.generate(p.name + "@AG")
+        block.create_var(full, stop_gradient=True)
+        block.append_op("c_allgather", {"X": [proxy.name]},
+                        {"Out": [full]},
+                        {"ring_id": 0, "op_role": "optimize"})
+        sliced = full
+        if padded != numel:
+            sliced = unique_name.generate(p.name + "@AGS")
+            block.create_var(sliced, stop_gradient=True)
+            block.append_op("slice", {"X": [full]}, {"Out": [sliced]},
+                            {"axes": [0], "starts": [0], "ends": [numel],
+                             "op_role": "optimize"})
+        shaped = unique_name.generate(p.name + "@AGR")
+        sxs2 = unique_name.generate(p.name + "@AGXS")
+        for n in (shaped, sxs2):
+            block.create_var(n, stop_gradient=True)
+        block.append_op("reshape2", {"X": [sliced]},
+                        {"Out": [shaped], "XShape": [sxs2]},
+                        {"shape": list(p.shape), "op_role": "optimize"})
+        block.append_op("assign", {"X": [shaped]}, {"Out": [p.name]},
+                        {"op_role": "optimize"})
+    program.bump_version()
+    return opt_ops
+
+
+def _persistable_zeros(program: Program, name: str, shape, dtype):
+    """Declare a zero-initialized persistable var in main + startup."""
+    from ...framework.program import default_startup_program
+    block = program.global_block()
+    block.create_var(name, shape=shape, dtype=dtype, persistable=True,
+                     stop_gradient=True)
+    startup = getattr(program, "_startup_ref", None) or \
+        default_startup_program()
+    sb = startup.global_block()
+    sv = sb.create_var(name, shape=shape, dtype=dtype, persistable=True,
+                       stop_gradient=True)
+    sb.append_op("fill_constant", {}, {"Out": sv.name},
+                 {"shape": list(shape), "dtype": dtype, "value": 0.0})
+
+
+def _apply_localsgd(program: Program, params, nranks: int, k_steps: int):
+    """LocalSGD rewrite (meta_optimizers/localsgd_optimizer.py analog):
+    workers train independently; every k steps parameters are averaged
+    across the data axis. The sync rides a ``cond`` op (lax.cond), so
+    non-sync steps run ZERO collectives — the entire point of LocalSGD.
+
+    Caveat (single-process SPMD): between syncs each device holds its
+    own locally-updated params inside nominally-replicated buffers;
+    fetching or checkpointing params mid-cycle observes device 0's
+    local model (bounded staleness < k_steps). At sync boundaries all
+    devices are exactly identical again."""
+    block = program.global_block()
+    from ...layers.tensor import create_global_var
+    from ...framework.program import program_guard
+    startup = getattr(program, "_startup_ref", None)
+    ctx = program_guard(program, startup) if startup is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        step = create_global_var([1], 0.0, "float32", persistable=True,
+                                 name=unique_name.generate("lsgd_step"))
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+
+    def ap(type_, ins, outs, attrs=None):
+        block.append_op(type_, ins, outs,
+                        dict(attrs or {}, op_role="optimize"))
+
+    gate_b = _emit_every_k_gate(block, step.name, k_steps, "optimize")
+    # sync / no-sync branches: the true branch allreduce-averages every
+    # param, the false branch passes them through — lax.cond executes
+    # only the taken branch, so no ICI traffic on local steps
+    tblk = program._create_block(parent_idx=0)
+    program._rollback()
+    fblk = program._create_block(parent_idx=0)
+    program._rollback()
+    param_names = [p.name for p in params]
+    out_names = []
+    for p in params:
+        out = unique_name.generate(p.name + "@LSGD_OUT")
+        block.create_var(out, stop_gradient=True)
+        out_names.append(out)
+        avg = unique_name.generate(p.name + "@LSGD_AVG")
+        tblk.create_var(avg, stop_gradient=True)
+        tblk.append_op("c_allreduce_avg", {"X": [p.name]},
+                       {"Out": [avg]},
+                       {"ring_id": 0, "op_role": "optimize"})
+        tblk.append_op("assign", {"X": [avg]}, {"Out": [out]},
+                       {"op_role": "optimize"})
+        fblk.append_op("assign", {"X": [p.name]}, {"Out": [out]},
+                       {"op_role": "optimize"})
+    ap("cond", {"Cond": [gate_b], "Params": param_names},
+       {"Out": out_names},
+       {"sub_block_t": tblk.idx, "sub_block_f": fblk.idx,
+        "param_names": param_names, "out_names": out_names})
+    for p, out in zip(params, out_names):
+        ap("assign", {"X": [out]}, {"Out": [p.name]}, {})
+    program.bump_version()
+
+
+def _emit_every_k_gate(block, step_name: str, k_steps: int,
+                       op_role: str):
+    """Counter += 1; gate_b = (counter %% k == 0). Shared by
+    gradient-merge and LocalSGD so the two stay in lockstep."""
+    def ap(type_, ins, outs, attrs=None):
+        block.append_op(type_, ins, outs,
+                        dict(attrs or {}, op_role=op_role))
+
+    one = unique_name.generate("gate_one")
+    block.create_var(one, stop_gradient=True)
+    ap("fill_constant_like", {"X": step_name}, {"Out": one},
+       {"value": 1.0})
+    ap("sum", {"X": [step_name, one]}, {"Out": step_name}, {})
+    kc = unique_name.generate("gate_k")
+    block.create_var(kc, stop_gradient=True)
+    ap("fill_constant_like", {"X": step_name}, {"Out": kc},
+       {"value": float(k_steps)})
+    modv = unique_name.generate("gate_mod")
+    block.create_var(modv, stop_gradient=True)
+    ap("elementwise_mod", {"X": step_name, "Y": kc}, {"Out": modv}, {})
+    zero = unique_name.generate("gate_zero")
+    block.create_var(zero, stop_gradient=True)
+    ap("fill_constant_like", {"X": step_name}, {"Out": zero},
+       {"value": 0.0})
+    gate_b = unique_name.generate("gate_b")
+    block.create_var(gate_b, stop_gradient=True)
+    ap("equal", {"X": modv, "Y": zero}, {"Out": gate_b}, {})
+    return gate_b
 
 
 def _apply_gradient_merge(program: Program, params_grads, k_steps: int,
@@ -245,26 +602,7 @@ def _apply_gradient_merge(program: Program, params_grads, k_steps: int,
     block = program.global_block()
     step = create_global_var([1], 0.0, "float32", persistable=True,
                              name=unique_name.generate("gm_step"))
-    one = block.create_var(unique_name.generate("gm_one"), stop_gradient=True)
-    block.append_op("fill_constant_like", {"X": step}, {"Out": one},
-                    {"value": 1.0, "op_role": "backward"})
-    block.append_op("sum", {"X": [step.name, one.name]}, {"Out": step},
-                    {"op_role": "backward"})
-    # gate = 1.0 when step % k == 0
-    modv = block.create_var(unique_name.generate("gm_mod"), stop_gradient=True)
-    kconst = block.create_var(unique_name.generate("gm_k"), stop_gradient=True)
-    block.append_op("fill_constant_like", {"X": step}, {"Out": kconst},
-                    {"value": float(k_steps), "op_role": "backward"})
-    block.append_op("elementwise_mod", {"X": step, "Y": kconst},
-                    {"Out": modv}, {"op_role": "backward"})
-    zero = block.create_var(unique_name.generate("gm_zero"),
-                            stop_gradient=True)
-    block.append_op("fill_constant_like", {"X": step}, {"Out": zero},
-                    {"value": 0.0, "op_role": "backward"})
-    gate_b = block.create_var(unique_name.generate("gm_gate_b"),
-                              stop_gradient=True)
-    block.append_op("equal", {"X": modv, "Y": zero}, {"Out": gate_b},
-                    {"op_role": "backward"})
+    gate_b = _emit_every_k_gate(block, step.name, k_steps, "backward")
     gate = block.create_var(unique_name.generate("gm_gate"),
                             stop_gradient=True)
     block.append_op("cast", {"X": gate_b}, {"Out": gate},

@@ -1,0 +1,255 @@
+"""Pipeline parallelism: device_guard-annotated program split + GPipe
+microbatch schedule.
+
+Capability analog of the reference's pipeline stack: fluid
+PipelineOptimizer (optimizer.py:3666, `_split_program`:3790, enqueue/
+dequeue insertion :4135) executed by PipelineTrainer/SectionWorker
+(pipeline_trainer.cc:24, section_worker.cc:82 — "forward over N
+microbatch scopes -> backward over N -> optimize").
+
+TPU-first translation: no per-section C++ threads or blocking queues —
+each stage becomes THREE phase programs (forward / backward / optimize)
+holding that stage's ops; cross-stage and cross-phase values flow through
+the Scope (the queue analog: on multi-chip deployments these boundary
+tensors are exactly what rides the ICI between stage chips; the phase
+programs are what each stage's chip compiles). The schedule is GPipe:
+all microbatch forwards, then all backwards with gradient accumulation
+into persistable buffers, then one optimize apply.
+
+Gradient accumulation is inserted at split time: each backward phase sums
+its parameter grads into ``<p>@GRAD@PACC``; the optimize phase reads the
+accumulator (scaled by 1/num_microbatches) and zeroes it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ...framework import unique_name
+from ...framework.program import Operator, Program, default_startup_program
+
+GRAD_ACC_SUFFIX = "@GRAD@PACC"
+
+
+class PipelineStage:
+    def __init__(self, device: str):
+        self.device = device
+        self.forward = Program()
+        self.backward = Program()
+        self.optimize = Program()
+
+    def phases(self):
+        return (("forward", self.forward), ("backward", self.backward),
+                ("optimize", self.optimize))
+
+
+def _op_phase(op: Operator) -> str:
+    role = op.attrs.get("op_role", "forward")
+    if role == "optimize":
+        return "optimize"
+    if role == "backward":
+        return "backward"
+    return "forward"
+
+
+def split_pipeline_program(program: Program,
+                           num_microbatches: int) -> List[PipelineStage]:
+    """Partition the global block by (op_device, phase); insert gradient
+    accumulation; mark cross-program boundary vars persistable so they
+    hand off through the Scope. Ops with no device annotation inherit
+    the previous op's stage (the reference's implicit-device rule)."""
+    block = program.global_block()
+    devices: List[str] = []
+    for op in block.ops:
+        d = op.attrs.get("op_device")
+        if d and d not in devices:
+            devices.append(d)
+    if not devices:
+        raise ValueError(
+            "pipeline requires device_guard annotations (no op_device "
+            "attrs found)")
+    stages = {d: PipelineStage(d) for d in devices}
+
+    # ---- partition ops -----------------------------------------------------
+    param_names = {p.name for p in block.all_parameters()}
+    # params belong to the stage of the first forward op reading them, so
+    # their optimizer-update ops co-locate with the forward/backward use
+    # (the reference's per-section optimize blocks, optimizer.py:4272)
+    param_stage: Dict[str, str] = {}
+    cur_dev = devices[0]
+    for op in block.ops:
+        cur_dev = op.attrs.get("op_device") or cur_dev
+        if op.attrs.get("op_role") not in ("backward", "optimize"):
+            for n in op.input_names():
+                if n in param_names and n not in param_stage:
+                    param_stage[n] = cur_dev
+    cur_dev = devices[0]
+    for op in block.ops:
+        cur_dev = op.attrs.get("op_device") or cur_dev
+        dev = cur_dev
+        if _op_phase(op) == "optimize":
+            p_in = op.inputs.get("Param", [])
+            if p_in and p_in[0] in param_stage:
+                dev = param_stage[p_in[0]]
+        stage = stages[dev]
+        phase = _op_phase(op)
+        target = dict(stage.phases())[phase]
+        tb = target.global_block()
+        new_op = Operator(tb, op.type, {k: list(v) for k, v in
+                                        op.inputs.items()},
+                          {k: list(v) for k, v in op.outputs.items()},
+                          dict(op.attrs))
+        tb.ops.append(new_op)
+
+    # ---- copy var metadata into every phase program ------------------------
+    for stage in stages.values():
+        for _, prog in stage.phases():
+            tb = prog.global_block()
+            for op in tb.ops:
+                for n in op.input_names() + op.output_names():
+                    if n in block.vars and n not in tb.vars:
+                        src = block.vars[n]
+                        tb.vars[n] = type(src)(
+                            tb, n, shape=src.shape, dtype=src.dtype,
+                            persistable=src.persistable,
+                            stop_gradient=src.stop_gradient,
+                            is_data=src.is_data, trainable=src.trainable,
+                            is_parameter=src.is_parameter)
+
+    # ---- gradient accumulation over microbatches ---------------------------
+    startup = getattr(program, "_startup_ref", None) or \
+        default_startup_program()
+    for stage in stages.values():
+        bb = stage.backward.global_block()
+        ob = stage.optimize.global_block()
+        # param grads produced by this stage's backward
+        stage_pgrads = []
+        for op in bb.ops:
+            for n in op.output_names():
+                if n.endswith("@GRAD") and n[:-5] in param_names:
+                    if n not in stage_pgrads:
+                        stage_pgrads.append(n)
+        for g in stage_pgrads:
+            acc = f"{g}@PACC"
+            # declare accumulator persistable in backward+optimize+startup
+            for blk in (bb, ob):
+                blk.create_var(acc, persistable=True, stop_gradient=True)
+            sb = startup.global_block()
+            sb.create_var(acc, persistable=True, stop_gradient=True)
+            # shape comes from the parameter at run time
+            sb.append_op("fill_constant_like", {"X": g[:-5]}, {"Out": acc},
+                         {"value": 0.0})
+            bb.append_op("sum", {"X": [acc, g]}, {"Out": acc},
+                         {"op_role": "backward"})
+            # optimize phase: read averaged accumulator under the grad's
+            # name, then reset the accumulator
+            ob.prepend_op("scale", {"X": acc}, {"Out": g},
+                          {"scale": 1.0 / num_microbatches,
+                           "op_role": "optimize"})
+            ob.append_op("scale", {"X": acc}, {"Out": acc},
+                         {"scale": 0.0, "op_role": "optimize"})
+
+    # ---- mark cross-program values persistable -----------------------------
+    produced_by: Dict[str, Tuple] = {}
+    order = []
+    for d in devices:
+        for phase, prog in stages[d].phases():
+            order.append((d, phase, prog))
+    for d, phase, prog in order:
+        for op in prog.global_block().ops:
+            for n in op.output_names():
+                produced_by.setdefault(n, (d, phase))
+    for d, phase, prog in order:
+        tb = prog.global_block()
+        for op in tb.ops:
+            for n in op.input_names():
+                src = produced_by.get(n)
+                if src is not None and src != (d, phase):
+                    # crosses a program boundary -> persist through scope
+                    if n in tb.vars:
+                        tb.vars[n].persistable = True
+                    sd, sp = src
+                    sblk = dict(stages[sd].phases())[sp].global_block()
+                    if n in sblk.vars:
+                        sblk.vars[n].persistable = True
+                    else:
+                        sblk.create_var(n, persistable=True,
+                                        stop_gradient=True)
+    result = [stages[d] for d in devices]
+    for st in result:
+        for _, prog in st.phases():
+            prog.bump_version()
+    return result
+
+
+class PipelineRunner:
+    """GPipe schedule over the split stages (PipelineTrainer analog).
+
+    ``run(exe, scope, microbatch_feeds, fetch_list)``:
+      1. forward: for each microbatch, stages 0..S-1 in order;
+      2. backward: for each microbatch (reverse order), stages S-1..0;
+      3. optimize: each stage once (accumulated, averaged grads).
+    Per-microbatch boundary tensors are renamed through the scope so
+    activations from microbatch i survive until its backward (the
+    reference's per-microbatch scopes, pipeline_trainer.cc:24).
+    """
+
+    def __init__(self, stages: Sequence[PipelineStage],
+                 num_microbatches: int):
+        self.stages = list(stages)
+        self.num_microbatches = num_microbatches
+
+    def run(self, exe, scope, microbatch_feeds: Sequence[dict],
+            fetch_list: Optional[Sequence[str]] = None):
+        if len(microbatch_feeds) != self.num_microbatches:
+            raise ValueError(
+                f"expected {self.num_microbatches} microbatch feeds, got "
+                f"{len(microbatch_feeds)}")
+        fetch_list = list(fetch_list or [])
+        fetched = []
+
+        def stash(prog, mb):
+            """After running a phase for microbatch mb, rename its
+            persistable non-param outputs to @MB<i> names in the scope."""
+            blk = prog.global_block()
+            for v in blk.vars.values():
+                if v.persistable and not v.is_parameter:
+                    arr = scope.find_var(v.name)
+                    if arr is not None:
+                        scope.set_var(f"{v.name}@MB{mb}", arr)
+
+        def unstash(prog, mb):
+            blk = prog.global_block()
+            for v in blk.vars.values():
+                if v.persistable and not v.is_parameter:
+                    arr = scope.find_var(f"{v.name}@MB{mb}")
+                    if arr is not None:
+                        scope.set_var(v.name, arr)
+
+        # 1. forwards
+        for mb, feed in enumerate(microbatch_feeds):
+            for stage in self.stages:
+                fl = [f for f in fetch_list
+                      if f in stage.forward.global_block().vars] \
+                    if mb == 0 else []
+                vals = exe.run(stage.forward, feed=feed, fetch_list=fl,
+                               scope=scope)
+                if fl:
+                    fetched.extend(vals)
+            for stage in self.stages:
+                stash(stage.forward, mb)
+
+        # 2. backwards (reverse microbatch order, reverse stage order);
+        # within one microbatch the boundary grads flow through the live
+        # scope names, so only forward activations need unstashing
+        for mb in range(self.num_microbatches - 1, -1, -1):
+            for stage in self.stages:
+                unstash(stage.forward, mb)
+            for stage in reversed(self.stages):
+                exe.run(stage.backward, feed=microbatch_feeds[mb],
+                        fetch_list=[], scope=scope)
+
+        # 3. optimize
+        for stage in self.stages:
+            exe.run(stage.optimize, feed={}, fetch_list=[], scope=scope)
+        return fetched

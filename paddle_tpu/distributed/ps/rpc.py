@@ -1,0 +1,358 @@
+"""Parameter-server RPC wire: TCP servers hosting sparse-table shards,
+clients scatter-gathering pulls/pushes across them.
+
+Capability analog of the reference's PS transport stack:
+operators/distributed/grpc/grpc_server.cc + grpc_client.cc (AsyncSendVar
+:66 / AsyncGetVar :152), listen_and_serv_op.cc:127 (RunSyncLoop) and the
+row sharding of large_scale_kv.h. Transport is a compact length-prefixed
+binary protocol over TCP (struct header + raw numpy buffers — no
+pickle): the reference serializes LoDTensors into protobuf
+(sendrecvop_utils.cc); here a pull is one request/response round trip
+carrying int64 ids out and float32 rows back.
+
+Row placement: feasign id -> server ``id % num_servers`` (the
+DistributeTranspiler's hash placement); each server owns a full
+SparseTable for its residue class.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .sparse_table import SparseTable
+
+# ops
+OP_CREATE = 1
+OP_PULL = 2
+OP_PUSH = 3
+OP_SIZE = 4
+OP_STATE = 5
+OP_LOAD = 6
+OP_BARRIER = 7
+OP_SHUTDOWN = 8
+OP_OK = 100
+OP_ERR = 101
+
+_HDR = struct.Struct("<BI")          # op, payload length
+
+
+def _send_msg(sock: socket.socket, op: int, payload: bytes = b""):
+    sock.sendall(_HDR.pack(op, len(payload)) + payload)
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("PS peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[int, bytes]:
+    op, ln = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return op, _recv_exact(sock, ln) if ln else b""
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(buf: bytes, off: int) -> Tuple[str, int]:
+    (ln,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    return buf[off:off + ln].decode(), off + ln
+
+
+def _pack_array(a: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(a)
+    dt = _pack_str(str(a.dtype))
+    shape = struct.pack("<B", a.ndim) + struct.pack(
+        f"<{a.ndim}q", *a.shape)
+    return dt + shape + a.tobytes()
+
+
+def _unpack_array(buf: bytes, off: int) -> Tuple[np.ndarray, int]:
+    dts, off = _unpack_str(buf, off)
+    (nd,) = struct.unpack_from("<B", buf, off)
+    off += 1
+    shape = struct.unpack_from(f"<{nd}q", buf, off)
+    off += 8 * nd
+    dt = np.dtype(dts)
+    n = int(np.prod(shape)) * dt.itemsize
+    a = np.frombuffer(buf[off:off + n], dtype=dt).reshape(shape)
+    return a, off + n
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: "PSServer" = self.server.ps_server  # type: ignore
+        sock = self.request
+        try:
+            while True:
+                op, payload = _recv_msg(sock)
+                try:
+                    resp = server.dispatch(op, payload)
+                except Exception as e:  # report, keep serving
+                    _send_msg(sock, OP_ERR, str(e).encode())
+                    continue
+                if resp is None:        # shutdown
+                    _send_msg(sock, OP_OK)
+                    self.server._BaseServer__shutdown_request = True
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                    return
+                _send_msg(sock, OP_OK, resp)
+        except (ConnectionError, OSError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """One parameter server: hosts SparseTables for its residue class of
+    the id space (listen_and_serv analog)."""
+
+    def __init__(self, endpoint: str, server_index: int = 0,
+                 num_servers: int = 1):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self.server_index = server_index
+        self.num_servers = num_servers
+        self.tables: Dict[str, SparseTable] = {}
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._tcp = _TCPServer((host, int(port)), _Handler)
+        self._tcp.ps_server = self
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Serve in a background thread (tests / same-process mode)."""
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Blocking serve loop (fleet.run_server: listen_and_serv
+        RunImpl)."""
+        self._tcp.serve_forever()
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(self, op: int, payload: bytes) -> Optional[bytes]:
+        if op == OP_CREATE:
+            off = 0
+            name, off = _unpack_str(payload, off)
+            value_dim, lr = struct.unpack_from("<qd", payload, off)
+            off += 16
+            optimizer, off = _unpack_str(payload, off)
+            if name not in self.tables:
+                self.tables[name] = SparseTable(
+                    name, int(value_dim), optimizer=optimizer, lr=lr)
+            return b""
+        if op == OP_PULL:
+            name, off = _unpack_str(payload, 0)
+            ids, _ = _unpack_array(payload, off)
+            rows = self._table(name).pull(ids)
+            return _pack_array(rows)
+        if op == OP_PUSH:
+            name, off = _unpack_str(payload, 0)
+            ids, off = _unpack_array(payload, off)
+            grads, _ = _unpack_array(payload, off)
+            self._table(name).push(ids, grads)
+            return b""
+        if op == OP_SIZE:
+            name, _ = _unpack_str(payload, 0)
+            return struct.pack("<q", self._table(name).size())
+        if op == OP_STATE:
+            name, _ = _unpack_str(payload, 0)
+            state = self._table(name).state()
+            out = [struct.pack("<q", len(state))]
+            for k, v in state.items():
+                out.append(_pack_str(k))
+                out.append(_pack_array(v))
+            return b"".join(out)
+        if op == OP_LOAD:
+            name, off = _unpack_str(payload, 0)
+            (n,) = struct.unpack_from("<q", payload, off)
+            off += 8
+            rows = {}
+            for _ in range(n):
+                k, off = _unpack_str(payload, off)
+                v, off = _unpack_array(payload, off)
+                rows[k] = v
+            self._table(name).load_state(rows)
+            return b""
+        if op == OP_BARRIER:
+            # blocking rendezvous: the handler thread parks on a condition
+            # variable until `expected` participants arrive (the gloo-
+            # barrier analog, framework/fleet/gloo_wrapper.h:167)
+            (expected,) = struct.unpack_from("<q", payload, 0)
+            with self._barrier_cv:
+                self._barrier_count += 1
+                if self._barrier_count >= expected:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                    return struct.pack("<B", 1)
+                gen = self._barrier_gen
+                while gen == self._barrier_gen:
+                    if not self._barrier_cv.wait(timeout=60):
+                        return struct.pack("<B", 0)
+            return struct.pack("<B", 1)
+        if op == OP_SHUTDOWN:
+            return None
+        raise ValueError(f"unknown PS op {op}")
+
+    def _table(self, name: str) -> SparseTable:
+        if name not in self.tables:
+            # auto-vivify with dim from first pull is impossible server-
+            # side; surface a clear error instead
+            raise KeyError(f"table {name!r} not created on server "
+                           f"{self.server_index} (call create first)")
+        return self.tables[name]
+
+
+class PSClient:
+    """Scatter-gather client over all servers (grpc_client.cc analog).
+    One persistent connection per server, guarded per-connection."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks: List[Optional[socket.socket]] = \
+            [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, i: int, op: int, payload: bytes) -> bytes:
+        with self._locks[i]:
+            sock = self._sock(i)
+            _send_msg(sock, op, payload)
+            rop, resp = _recv_msg(sock)
+        if rop == OP_ERR:
+            raise RuntimeError(
+                f"PS server {self.endpoints[i]}: {resp.decode()}")
+        return resp
+
+    def close(self):
+        for i, s in enumerate(self._socks):
+            if s is not None:
+                try:
+                    s.close()
+                finally:
+                    self._socks[i] = None
+
+    # -- table ops ---------------------------------------------------------
+    def create_table(self, name: str, value_dim: int,
+                     optimizer: str = "sgd", lr: float = 0.01):
+        payload = (_pack_str(name) + struct.pack("<qd", value_dim, lr)
+                   + _pack_str(optimizer))
+        for i in range(len(self.endpoints)):
+            self._call(i, OP_CREATE, payload)
+
+    def _route(self, ids: np.ndarray):
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        srv = flat % len(self.endpoints)
+        return flat, srv
+
+    def pull(self, name: str, ids,
+             value_dim: Optional[int] = None) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        flat, srv = self._route(ids)
+        if flat.size == 0:
+            if value_dim is None:
+                raise ValueError(
+                    "PSClient.pull with zero ids needs value_dim to "
+                    "shape the empty result")
+            return np.zeros(tuple(ids.shape) + (value_dim,), np.float32)
+        out: Optional[np.ndarray] = None
+        for i in range(len(self.endpoints)):
+            mask = srv == i
+            if not mask.any():
+                continue
+            rows, _ = _unpack_array(
+                self._call(i, OP_PULL,
+                           _pack_str(name) + _pack_array(flat[mask])), 0)
+            if out is None:
+                out = np.empty((flat.size, rows.shape[-1]), np.float32)
+            out[mask] = rows
+        return out.reshape(tuple(ids.shape) + (out.shape[-1],))
+
+    def push(self, name: str, ids, grads):
+        ids = np.asarray(ids, np.int64)
+        flat, srv = self._route(ids)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        for i in range(len(self.endpoints)):
+            mask = srv == i
+            if not mask.any():
+                continue
+            self._call(i, OP_PUSH, _pack_str(name)
+                       + _pack_array(flat[mask]) + _pack_array(g[mask]))
+
+    def size(self, name: str) -> int:
+        total = 0
+        for i in range(len(self.endpoints)):
+            (n,) = struct.unpack("<q",
+                                 self._call(i, OP_SIZE, _pack_str(name)))
+            total += n
+        return total
+
+    def barrier(self, expected: int, server: int = 0) -> bool:
+        (done,) = struct.unpack(
+            "<B", self._call(server, OP_BARRIER,
+                             struct.pack("<q", expected)))
+        return bool(done)
+
+    def shutdown_servers(self):
+        for i in range(len(self.endpoints)):
+            try:
+                self._call(i, OP_SHUTDOWN, b"")
+            except (ConnectionError, RuntimeError, OSError):
+                pass
+        self.close()
+
+
+class RemoteSparseTable:
+    """SparseTable-compatible facade routing over a PSClient, so the
+    executor's distributed_lookup_table lowering and the Communicator
+    work unchanged in multi-node mode (parameter_prefetch.cc analog)."""
+
+    def __init__(self, name: str, value_dim: int, client: PSClient,
+                 optimizer: str = "sgd", lr: float = 0.01, **_):
+        self.name = name
+        self.value_dim = value_dim
+        self._client = client
+        client.create_table(name, value_dim, optimizer=optimizer, lr=lr)
+
+    def pull(self, ids):
+        return self._client.pull(self.name, ids,
+                                 value_dim=self.value_dim)
+
+    def push(self, ids, grads):
+        self._client.push(self.name, ids, grads)
+
+    def size(self) -> int:
+        return self._client.size(self.name)

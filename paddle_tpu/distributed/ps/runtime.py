@@ -105,6 +105,9 @@ def get_communicator() -> Optional[Communicator]:
 def init_worker(fleet):
     global _communicator
     strategy = fleet._strategy
+    eps = fleet._role_maker.get_pserver_endpoints()
+    if eps:
+        connect_workers_to_servers(eps)
     if strategy is not None and strategy.a_sync:
         k = strategy.a_sync_configs.get("k_steps", -1)
         mode = "geo" if k > 0 else "async"
@@ -117,14 +120,52 @@ def init_worker(fleet):
     _communicator.start()
 
 
+_server = None
+
+
 def init_server(fleet, *args, **kwargs):
-    # tables are created lazily by distributed_lookup_table; nothing to
-    # bind in the single-process backend
-    pass
+    """Bind this role's PS endpoint and host its table shards (analog of
+    listen_and_serv_op setup; fleet_base.py init_server:424). In
+    single-process mode (no server endpoints) tables stay in-process."""
+    global _server
+    eps = fleet._role_maker.get_pserver_endpoints()
+    if not eps:
+        return  # single-process backend: REGISTRY tables are local
+    from .rpc import PSServer
+    idx = getattr(fleet._role_maker, "_server_id", 0)
+    _server = PSServer(eps[idx], idx, len(eps))
 
 
 def run_server(fleet):
-    pass
+    """Blocking serve loop (fleet.run_server; listen_and_serv
+    RunImpl:352)."""
+    if _server is None:
+        raise RuntimeError("init_server() first (or no "
+                           "PADDLE_PSERVERS_IP_PORT_LIST configured)")
+    _server.run()
+
+
+def stop_server():
+    global _server
+    if _server is not None:
+        _server.stop()
+        _server = None
+
+
+_remote_client = None
+
+
+def connect_workers_to_servers(endpoints):
+    """Point the table registry at remote PS servers: every
+    get_or_create becomes a RemoteSparseTable over the RPC client
+    (parameter_prefetch.cc analog). Returns the client."""
+    global _remote_client
+    from .rpc import PSClient, RemoteSparseTable
+    client = PSClient(endpoints)
+    _remote_client = client
+    REGISTRY.set_remote_factory(
+        lambda name, dim, **kw: RemoteSparseTable(name, dim, client, **kw))
+    return client
 
 
 def stop_worker(fleet):
@@ -134,3 +175,14 @@ def stop_worker(fleet):
             _communicator.flush_geo()
         _communicator.stop()
         _communicator = None
+    REGISTRY.set_remote_factory(None)
+    # drop cached remote tables — they hold connections to servers that
+    # may be gone; a later run must get fresh (local or remote) tables
+    from .rpc import RemoteSparseTable
+    for name, t in list(REGISTRY.tables().items()):
+        if isinstance(t, RemoteSparseTable):
+            REGISTRY._tables.pop(name, None)
+    global _remote_client
+    if _remote_client is not None:
+        _remote_client.close()
+        _remote_client = None

@@ -101,10 +101,20 @@ class TableRegistry:
 
     def __init__(self):
         self._tables: Dict[str, SparseTable] = {}
+        self._remote_factory = None
+
+    def set_remote_factory(self, factory):
+        """Multi-node mode: route new tables through the PS RPC client
+        (runtime.connect_workers_to_servers)."""
+        self._remote_factory = factory
 
     def get_or_create(self, name: str, value_dim: int, **kw) -> SparseTable:
         if name not in self._tables:
-            self._tables[name] = SparseTable(name, value_dim, **kw)
+            if self._remote_factory is not None:
+                self._tables[name] = self._remote_factory(
+                    name, value_dim, **kw)
+            else:
+                self._tables[name] = SparseTable(name, value_dim, **kw)
         return self._tables[name]
 
     def get(self, name: str) -> Optional[SparseTable]:

@@ -1,0 +1,106 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Beyond-reference capability (SURVEY §5: the reference's long-sequence
+story is only LoD ops + recompute; no ring/Ulysses/context parallelism
+exists there). This is the TPU-native design the north star asks for:
+shard the SEQUENCE dimension over a mesh axis ("sp"); each device holds
+its Q/K/V chunk; K/V chunks rotate around the ring via lax.ppermute
+(ICI neighbor exchange) while each device accumulates online-softmax
+partials for its Q chunk. Peak memory per device is O(s_local^2 / P)
+logits — context length scales linearly with the ring size.
+
+Differentiable by construction: ppermute has a transpose rule, so jax
+AD derives the reverse ring (grads rotate the opposite way) — no custom
+VJP needed.
+
+Use inside shard_map/pjit with the sequence axis bound:
+
+    mesh = Mesh(devices, ("sp",))
+    out = shard_map(lambda q,k,v: ring_attention(q,k,v,"sp",causal=True),
+                    mesh=mesh, in_specs=P(None,None,"sp",None), ...)
+
+Also exposed through the ``fused_attention_qkv`` op: attr
+``seq_axis="sp"`` routes here (models opt in per-op).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def _chunk_scores(q, k, scale, causal, q_off, k_off):
+    """q [b,h,sq,d] x k [b,h,sk,d] -> masked logits [b,h,sq,sk] with
+    GLOBAL positions q_off+i vs k_off+j for the causal test."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        row = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(row >= col, s, NEG_INF)
+    return s
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Attention over a sequence-sharded axis.
+
+    q/k/v: [b, h, s_local, d] (this device's sequence chunk). Returns
+    [b, h, s_local, d] — exact (online-softmax) attention over the full
+    global sequence.
+    """
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    d = q.shape[3]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # keep accumulation fp32: a float64 scale (np.float64 under x64)
+    # would silently promote the whole online-softmax chain
+    scale = jnp.float32(scale)
+    qf = q.astype(jnp.float32)
+    q_off = idx * s_local
+
+    def step(carry, j):
+        kc, vc, m, l, acc = carry
+        # the chunk currently held arrived from device (idx - j) mod p
+        k_off = ((idx - j) % p) * s_local
+        s = _chunk_scores(qf, kc.astype(jnp.float32), scale, causal,
+                          q_off, k_off)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        # fully-masked chunks (future positions under causal) contribute
+        # nothing; guard the -inf - -inf NaN path
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        probs = jnp.exp(s - m_safe)
+        probs = jnp.where(jnp.isfinite(s), probs, 0.0)
+        l_new = alpha * l + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", probs, vc.astype(jnp.float32))
+        # rotate K/V to the next device (ICI neighbor exchange); the
+        # final rotation restores the original chunk
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m_new, l_new, acc_new), None
+
+    m0 = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    acc0 = jnp.zeros(qf.shape, jnp.float32)
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(p))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_specs(mesh_axis: str = "sp"):
+    """PartitionSpecs for [b, h, s, d] q/k/v sharded on the seq axis."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, None, mesh_axis, None)

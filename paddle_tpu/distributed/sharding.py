@@ -127,6 +127,56 @@ def state_shardings(spec, mesh: Mesh, rules: ShardingRules):
     }
 
 
+def param_partition_specs(spec, mesh: Mesh,
+                          rules: ShardingRules) -> List[PartitionSpec]:
+    """PartitionSpec per spec.params entry (rule lookup by dotted name)."""
+    names = {}
+    for layer in spec.layers:
+        for name, p in layer.named_parameters():
+            names.setdefault(id(p), name)
+    return [rules.spec_for(names.get(id(p), p.name), p.value.shape, mesh)
+            for p in spec.params]
+
+
+def constrain_snapshot(spec, snapshot, mesh: Mesh, rules: ShardingRules):
+    """Pin a post-step state snapshot's layouts INSIDE the traced
+    computation via with_sharding_constraint: params/grads per the rules,
+    optimizer accumulators like their parameter (moments) or replicated
+    (scalars), buffers replicated.
+
+    This — rather than jit's out_shardings — is how the fed-back state
+    stays layout-stable across compiles: optimizer accumulators are
+    created lazily during the first step, so the output pytree structure
+    isn't known before tracing.
+    """
+    import jax
+
+    p_specs = param_partition_specs(spec, mesh, rules)
+    spec_by_id = {id(p): s for p, s in zip(spec.params, p_specs)}
+    shape_by_id = {id(p): tuple(p.value.shape) for p in spec.params}
+
+    def c(v, s):
+        if v is None:
+            return None
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, s))
+
+    def opt_entry(key, v):
+        pid = key[0] if isinstance(key, tuple) else None
+        if pid in spec_by_id and tuple(v.shape) == shape_by_id[pid]:
+            return c(v, spec_by_id[pid])
+        return c(v, P())
+
+    out = dict(snapshot)
+    out["params"] = [c(v, s) for v, s in zip(snapshot["params"], p_specs)]
+    if "grads" in snapshot:
+        out["grads"] = [c(v, s)
+                        for v, s in zip(snapshot["grads"], p_specs)]
+    out["buffers"] = [c(v, P()) for v in snapshot["buffers"]]
+    out["opt"] = [{k: opt_entry(k, v) for k, v in od.items()}
+                  for od in snapshot["opt"]]
+    return out
+
+
 def data_parallel_shardings(mesh: Mesh, n_args: int,
                             axis: str = "dp") -> tuple:
     """Shard the leading (batch) dim of every step argument over `axis`."""

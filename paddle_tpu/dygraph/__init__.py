@@ -1,5 +1,5 @@
 """Dygraph (eager) engine — analog of paddle/fluid/imperative/ + dygraph/."""
 
 from .tensor import Parameter, Tensor, to_tensor, to_variable
-from .tape import Tracer, default_tracer, no_grad, run_op
+from .tape import Tracer, default_tracer, grad, no_grad, run_op
 from .layers import (Layer, LayerList, ParameterList, Sequential, seed)

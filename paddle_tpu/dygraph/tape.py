@@ -30,6 +30,60 @@ from .tensor import Parameter, Tensor
 
 _node_counter = itertools.count()
 
+# Program-recording hook (ProgramDescTracer analog,
+# imperative/jit/program_desc_tracer.cc): while active, every traced op
+# is ALSO appended to the target Program block, so jit.save can export a
+# runnable Program from a dygraph forward.
+_recording = None
+
+
+class record_program:
+    """``with record_program(prog): out = layer(x)`` — ops append to
+    ``prog`` as they execute."""
+
+    def __init__(self, program):
+        self.program = program
+
+    def __enter__(self):
+        global _recording
+        self._prev = _recording
+        _recording = self.program
+        return self
+
+    def __exit__(self, *a):
+        global _recording
+        _recording = self._prev
+        return False
+
+
+def _record_op(op_type, ins, out_tensors, attrs):
+    block = _recording.global_block()
+    for s, ts in ins.items():
+        for t in ts:
+            if t.name not in block.vars:
+                if isinstance(t, Parameter):
+                    v = block.create_parameter(
+                        t.name, shape=list(t.value.shape),
+                        dtype=str(t.value.dtype))
+                else:
+                    block.create_var(t.name,
+                                     shape=list(t.value.shape),
+                                     dtype=str(t.value.dtype),
+                                     stop_gradient=t.stop_gradient)
+    for s, ts in out_tensors.items():
+        for t in ts:
+            if t.name not in block.vars:
+                block.create_var(t.name, shape=list(t.value.shape),
+                                 dtype=str(t.value.dtype))
+    rec_attrs = {k: v for k, v in attrs.items()
+                 if isinstance(v, (int, float, bool, str, list, tuple,
+                                   dict, type(None)))}
+    block.append_op(op_type,
+                    {s: [t.name for t in ts] for s, ts in ins.items()},
+                    {s: [t.name for t in ts]
+                     for s, ts in out_tensors.items()},
+                    rec_attrs)
+
 
 class _OpStub:
     """Shaped like framework.Operator for make_grad_ops (name-based)."""
@@ -79,6 +133,9 @@ class Tracer:
         out_tensors = {s: [Tensor(a, stop_gradient=True) for a in vals]
                        for s, vals in arr_outs.items()}
 
+        if _recording is not None:
+            _record_op(op_type, ins, out_tensors, attrs)
+
         needs_grad = self.enabled and any(
             not t.stop_gradient for ts in ins.values() for t in ts)
         differentiable = d is None or not d.not_differentiable
@@ -112,9 +169,22 @@ class Tracer:
         root = getattr(loss, "_grad_node", None)
         if root is None:
             return
+        seed = (grad_tensor.value if grad_tensor is not None
+                else jnp.ones_like(loss.value))
+        self._run_backward([root], {loss.name: seed}, retain_graph,
+                           accumulate_into_grad=True)
+        if not retain_graph:
+            loss._grad_node = None
+
+    def _run_backward(self, roots, seeds: Dict[str, object],
+                      retain_graph: bool,
+                      accumulate_into_grad: bool = True):
+        """Reverse walk shared by .backward() and partial grad()
+        (BasicEngine / PartialGradEngine, basic_engine.cc:161 /
+        partial_grad_engine.cc). Returns the full name->grad map."""
         # collect reachable nodes; node.id gives execution order
         nodes: Dict[int, GradNode] = {}
-        stack = [root]
+        stack = list(roots)
         while stack:
             n = stack.pop()
             if n.id in nodes:
@@ -123,9 +193,7 @@ class Tracer:
             stack.extend(n.parents)
         ordered = sorted(nodes.values(), key=lambda n: n.id, reverse=True)
 
-        grads: Dict[str, object] = {}
-        grads[loss.name] = (grad_tensor.value if grad_tensor is not None
-                            else jnp.ones_like(loss.value))
+        grads: Dict[str, object] = dict(seeds)
         ctx = _reg.LoweringContext(eager=True)
         leaf_grads: Dict[str, tuple] = {}
         for node in ordered:
@@ -181,17 +249,18 @@ class Tracer:
                     t = node.in_tensors[n]
                     if t.is_leaf:
                         leaf_grads[n] = (t, grads[n])
-        for n, (t, g) in leaf_grads.items():
-            if t.grad is None:
-                t.grad = Tensor(g, stop_gradient=True)
-            else:
-                t.grad = Tensor(t.grad.value + g, stop_gradient=True)
+        if accumulate_into_grad:
+            for n, (t, g) in leaf_grads.items():
+                if t.grad is None:
+                    t.grad = Tensor(g, stop_gradient=True)
+                else:
+                    t.grad = Tensor(t.grad.value + g, stop_gradient=True)
         if not retain_graph:
-            # drop the graph rooted at loss so activations free promptly
+            # drop the walked graph so activations free promptly
             for node in ordered:
                 node.parents = []
                 node.env = {}
-            loss._grad_node = None
+        return grads
 
 
 _tracer = Tracer()
@@ -199,6 +268,48 @@ _tracer = Tracer()
 
 def default_tracer() -> Tracer:
     return _tracer
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=True,
+         create_graph=False, allow_unused=False):
+    """paddle.grad parity — grads of ``outputs`` w.r.t. ``inputs``
+    WITHOUT touching ``.grad`` (the PartialGradEngine capability,
+    imperative/partial_grad_engine.cc). Returns a list aligned with
+    ``inputs`` (None where unused, if allow_unused)."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order grad) is not supported; "
+            "compose jax.grad via jit.to_static for nested derivatives")
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    seeds: Dict[str, object] = {}
+    roots = []
+    gouts = (grad_outputs if isinstance(grad_outputs, (list, tuple))
+             else [grad_outputs] * len(outs))
+    for o, g in zip(outs, gouts):
+        node = getattr(o, "_grad_node", None)
+        if node is None:
+            continue
+        roots.append(node)
+        seed = g.value if g is not None else jnp.ones_like(o.value)
+        seeds[o.name] = (seeds[o.name] + seed if o.name in seeds
+                         else seed)
+    if not roots:
+        raise ValueError("none of the outputs is connected to the graph")
+    grads = _tracer._run_backward(roots, seeds, retain_graph,
+                                  accumulate_into_grad=False)
+    result = []
+    for t in ins:
+        g = grads.get(t.name)
+        if g is None:
+            if not allow_unused:
+                raise ValueError(
+                    f"input {t.name!r} received no gradient (set "
+                    "allow_unused=True to get None)")
+            result.append(None)
+        else:
+            result.append(Tensor(g, stop_gradient=True))
+    return result
 
 
 def run_op(op_type: str, ins: Dict[str, List[Tensor]], attrs: Dict

@@ -2,7 +2,7 @@
 
 from .program import (Block, Operator, Program, Variable, convert_dtype,
                       default_main_program, default_startup_program,
-                      grad_var_name, program_guard)
+                      device_guard, grad_var_name, program_guard)
 from .scope import Scope, global_scope
 from .executor import Executor
 from .backward import append_backward, gradients

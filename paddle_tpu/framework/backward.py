@@ -56,9 +56,40 @@ def append_backward(
     loss: Variable,
     parameter_list: Optional[Sequence] = None,
     no_grad_set: Optional[Set[str]] = None,
+    checkpoints: Optional[Sequence] = None,
 ) -> List[Tuple[Variable, Variable]]:
-    result, _ = _append_backward_impl(loss, parameter_list, no_grad_set)
+    """With ``checkpoints`` (var names/Variables), builds a RECOMPUTING
+    backward: forward ops between consecutive checkpoints are cloned into
+    the backward pass (outputs renamed ``@RCP<seg>``) and the grad ops
+    consume the recomputed values — so only checkpoint activations stay
+    live across forward->backward. Analog of the reference's
+    RecomputeOptimizer / _append_backward_ops_with_checkpoints_
+    (fluid/backward.py:629); the TPU payoff is XLA liveness: non-
+    checkpoint activations die at the end of the forward."""
+    result, _ = _append_backward_impl(loss, parameter_list, no_grad_set,
+                                      checkpoints=checkpoints)
     return result
+
+
+def _segment_plan(fwd_ops, checkpoint_names: Set[str]):
+    """Assign each forward op a segment id; a segment CLOSES after an op
+    that produces a checkpoint. Returns (seg_of_op list, per-segment
+    rename maps name->name@RCP<seg> for names produced in the segment)."""
+    seg_of_op: List[int] = []
+    produced_in_seg: List[Set[str]] = [set()]
+    seg = 0
+    for op in fwd_ops:
+        seg_of_op.append(seg)
+        outs = set(op.output_names())
+        produced_in_seg[seg] |= outs
+        if outs & checkpoint_names:
+            seg += 1
+            produced_in_seg.append(set())
+    renames: List[Dict[str, str]] = []
+    for s, names in enumerate(produced_in_seg):
+        renames.append({n: f"{n}@RCP{s}" for n in names
+                        if n not in checkpoint_names})
+    return seg_of_op, renames
 
 
 def _append_backward_impl(
@@ -66,6 +97,7 @@ def _append_backward_impl(
     parameter_list: Optional[Sequence] = None,
     no_grad_set: Optional[Set[str]] = None,
     extra_vars: Sequence[str] = (),
+    checkpoints: Optional[Sequence] = None,
 ):
     """Append grad ops computing d(loss)/d(param); returns [(param, grad)].
 
@@ -83,6 +115,94 @@ def _append_backward_impl(
             f"loss {loss.name!r} does not depend on any trainable parameter")
 
     fwd_ops = list(block.ops)
+
+    ckpt_names: Set[str] = set()
+    seg_of_op: List[int] = []
+    seg_renames: List[Dict[str, str]] = []
+    seg_emitted: Set[int] = set()
+    if checkpoints:
+        ckpt_names = {c.name if isinstance(c, Variable) else str(c)
+                      for c in checkpoints}
+        produced = {n for op in fwd_ops for n in op.output_names()}
+        unmatched = sorted(ckpt_names - produced)
+        if unmatched:
+            raise ValueError(
+                f"recompute checkpoints {unmatched} are not produced by "
+                "any forward op — the rewrite would silently be a no-op")
+        seg_of_op, seg_renames = _segment_plan(fwd_ops, ckpt_names)
+        # the tail segment (after the last checkpoint, incl. the loss)
+        # is NOT recomputed: its activations are live anyway at the
+        # moment the backward starts, so cloning it would double its
+        # FLOPs for zero memory benefit
+        tail = max(seg_of_op) if seg_of_op else 0
+        seg_renames[tail] = {}
+        seg_emitted.add(tail)
+
+    def _emit_recompute(seg: int):
+        """Clone segment ``seg``'s forward ops into the backward stream
+        with renamed outputs; inputs defined inside the segment use the
+        renamed values, everything else reads the still-live original
+        (checkpoints, feeds, params)."""
+        if seg in seg_emitted:
+            return
+        seg_emitted.add(seg)
+        ren = seg_renames[seg]
+        # barrier the segment's external inputs (checkpoints, feeds,
+        # params): without an optimization_barrier XLA CSE would merge
+        # the clones back into the original ops and keep the original
+        # activations alive — the exact thing recompute exists to avoid
+        # (same mechanism as jax.checkpoint)
+        ext = []
+        for idx, op in enumerate(fwd_ops):
+            if seg_of_op[idx] != seg:
+                continue
+            for n in op.input_names():
+                if n not in ren and n not in ext:
+                    ext.append(n)
+        barrier = {}
+        if ext:
+            b_names = [f"{n}@RCPB{seg}" for n in ext]
+            for bn in b_names:
+                block.create_var(bn, stop_gradient=True)
+            block.append_op("optimization_barrier", inputs={"X": ext},
+                            outputs={"Out": b_names},
+                            attrs={"op_role": "backward"})
+            barrier = dict(zip(ext, b_names))
+        ren = {**barrier, **ren}
+        seg_renames[seg] = ren
+        for idx, op in enumerate(fwd_ops):
+            if seg_of_op[idx] != seg:
+                continue
+            new_in = {s: [ren.get(n, n) for n in names]
+                      for s, names in op.inputs.items()}
+            new_out = {s: [ren.get(n, n) for n in names]
+                       for s, names in op.outputs.items()}
+            attrs = dict(op.attrs)
+            attrs["op_role"] = "backward"
+            # pin functional randomness to the ORIGINAL op position so a
+            # recomputed dropout regenerates the identical mask
+            attrs.setdefault("__rng_tag__", idx)
+            for names in new_out.values():
+                for n in names:
+                    if n not in block.vars:
+                        block.create_var(n, stop_gradient=True)
+            block.append_op(op.type, inputs=new_in, outputs=new_out,
+                            attrs=attrs)
+
+    def _remap_grad_inputs(op_idx: int,
+                           g_in: Dict[str, List[str]]
+                           ) -> Dict[str, List[str]]:
+        """Point a grad op's forward-value inputs at the recomputed
+        names for values produced inside the op's segment."""
+        seg = seg_of_op[op_idx]
+        ren = seg_renames[seg]
+        out = {}
+        for slot, names in g_in.items():
+            if slot.endswith(_reg.GRAD_SLOT_SUFFIX):
+                out[slot] = names
+            else:
+                out[slot] = [ren.get(n, n) for n in names]
+        return out
 
     # d(loss)/d(loss) = 1
     loss_grad = grad_var_name(loss.name)
@@ -120,7 +240,8 @@ def _append_backward_impl(
         finalized[v] = acc
         return acc
 
-    for op in reversed(fwd_ops):
+    for op_idx in range(len(fwd_ops) - 1, -1, -1):
+        op = fwd_ops[op_idx]
         d = _op_def(op.type)
         if d is None or d.not_differentiable:
             continue
@@ -155,9 +276,14 @@ def _append_backward_impl(
         grad_op_descs = _reg.make_grad_ops(op, out_grad_names, wanted)
         if not grad_op_descs:
             continue
+        if checkpoints:
+            _emit_recompute(seg_of_op[op_idx])
         for (g_type, g_in, g_out, g_attrs) in grad_op_descs:
             g_attrs = dict(g_attrs)
             g_attrs["op_role"] = "backward"
+            if checkpoints:
+                g_in = _remap_grad_inputs(op_idx, g_in)
+                g_attrs.setdefault("__rng_tag__", op_idx)
             block.append_op(g_type, inputs=g_in, outputs=g_out, attrs=g_attrs)
         # register contributions actually emitted
         emitted_targets = set()

@@ -71,7 +71,11 @@ class _BlockRunner:
     def run_block(self, block_idx: int, env: Dict[str, Any], rng) -> Dict[str, Any]:
         block = self.program.blocks[block_idx]
         for i, op in enumerate(block.ops):
-            op_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            # __rng_tag__ pins an op's PRNG stream to another position —
+            # recompute clones must regenerate identical dropout masks
+            rng_key = op.attrs.get("__rng_tag__", i)
+            op_rng = (jax.random.fold_in(rng, rng_key)
+                      if rng is not None else None)
             ctx = _reg.LoweringContext(
                 rng=op_rng, eager=False, mesh=self.mesh, axis_env=self.axis_env)
             ctx.block_runner = self  # control-flow hook
@@ -87,7 +91,11 @@ class _BlockRunner:
                             f"in scope (analog of PADDLE_ENFORCE NotFound)")
                     vals.append(env[n])
                 ins[slot] = vals
-            outs = _reg.execute(ctx, op.type, ins, op.attrs)
+            # named_scope -> op names land in XLA HLO metadata, so the
+            # xplane/TensorBoard timeline attributes device time to ops
+            # (the RecordEvent("compute") analog, operator.cc:1013)
+            with jax.named_scope(op.type):
+                outs = _reg.execute(ctx, op.type, ins, op.attrs)
             check = _flags.get_flag("check_nan_inf")
             for slot, names in op.outputs.items():
                 vals = outs.get(slot, [])

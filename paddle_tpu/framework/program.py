@@ -295,6 +295,8 @@ class Block:
 
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:  # noqa: A002
         op = Operator(self, type, inputs, outputs, attrs)
+        if _current_device_guard is not None and "op_device" not in op.attrs:
+            op.attrs["op_device"] = _current_device_guard
         self.ops.append(op)
         self.program.bump_version()
         return op
@@ -422,6 +424,27 @@ class Program:
     def __repr__(self):
         nops = sum(len(b.ops) for b in self.blocks)
         return f"Program(blocks={len(self.blocks)}, ops={nops})"
+
+
+# -- device guard (analog of framework.py device_guard / op_device attr) ----
+
+_current_device_guard: Optional[str] = None
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Stamp ops built inside the context with an ``op_device`` attr
+    (e.g. "tpu:0") — the pipeline-stage annotation consumed by
+    PipelineOptimizer's program split, mirroring the reference's
+    fluid.device_guard -> PipelineOptimizer._split_program flow
+    (fluid/framework.py device_guard, optimizer.py:3790)."""
+    global _current_device_guard
+    prev = _current_device_guard
+    _current_device_guard = device
+    try:
+        yield
+    finally:
+        _current_device_guard = prev
 
 
 # -- global default programs (analog of framework.py:5398-5486) -------------

@@ -1,0 +1,9 @@
+"""hapi — the high-level Model.fit training loop.
+
+Analog of python/paddle/hapi/ (model.py:788 Model, fit:1243, callbacks).
+"""
+
+from .model import Model
+from .callbacks import Callback, ProgBarLogger
+
+__all__ = ["Model", "Callback", "ProgBarLogger"]

@@ -1,0 +1,207 @@
+"""hapi Model — prepare/fit/evaluate/predict/save/load.
+
+Analog of python/paddle/hapi/model.py (Model:788, prepare:1187,
+fit:1243, DynamicGraphAdapter:588). TPU-first: the train/eval steps are
+compiled once with jit.to_static (forward + program-level backward +
+optimizer update in ONE XLA computation) instead of the reference's
+per-op dygraph dispatch; metrics stream host-side between steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import jit
+from ..dygraph.layers import Layer
+from ..dygraph.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import CallbackList, ProgBarLogger
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """``Model(network).prepare(opt, loss, metrics); model.fit(data)``."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_step = None
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle_tpu.metric."
+                                "Metric")
+        self._build_steps()
+        return self
+
+    def _build_steps(self):
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+
+        if opt is not None and loss_fn is not None:
+            def train_step(*args):
+                inputs, label = args[:-1], args[-1]
+                preds = net(*inputs)
+                loss = loss_fn(preds, label)
+                net.clear_gradients()
+                loss.backward()
+                opt.step()
+                return loss, preds
+
+            self._train_step = jit.to_static(
+                train_step, layers=[net], optimizers=[opt])
+
+        if loss_fn is not None:
+            def eval_step(*args):
+                inputs, label = args[:-1], args[-1]
+                preds = net(*inputs)
+                return loss_fn(preds, label), preds
+
+            self._eval_step = jit.to_static(eval_step, layers=[net])
+
+        def predict_step(*inputs):
+            return net(*inputs)
+
+        self._predict_step = jit.to_static(predict_step, layers=[net])
+
+    # -- loops -------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+    def train_batch(self, inputs, labels=None):
+        args = _as_list(inputs) + _as_list(labels)
+        loss, preds = self._train_step(*args)
+        logs = {"loss": float(np.asarray(loss.value))}
+        label = args[-1]
+        for m in self._metrics:
+            out = m.compute(preds, label)
+            m.update(out if isinstance(out, np.ndarray) else out)
+            logs[str(m.name())] = m.accumulate()
+        return logs
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        try:
+            args = _as_list(inputs) + _as_list(labels)
+            loss, preds = self._eval_step(*args)
+            logs = {"loss": float(np.asarray(loss.value))}
+            for m in self._metrics:
+                out = m.compute(preds, args[-1])
+                m.update(out)
+                logs[str(m.name())] = m.accumulate()
+            return logs
+        finally:
+            self.network.train()
+
+    def fit(self, train_data, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            callbacks=None, shuffle: bool = True, verbose: int = 1):
+        if self._train_step is None:
+            raise RuntimeError("call prepare(optimizer, loss) before fit")
+        loader = self._loader(train_data, batch_size, shuffle)
+        cbs = CallbackList(
+            _as_list(callbacks) or [ProgBarLogger(log_freq, verbose)],
+            self)
+        cbs.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbs.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                batch = list(batch) if isinstance(batch, (tuple, list)) \
+                    else [batch]
+                cbs.on_train_batch_begin(step)
+                logs = self.train_batch(batch[:-1], batch[-1])
+                cbs.on_train_batch_end(step, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                logs.update({f"eval_{k}": v for k, v in
+                             self.evaluate(eval_data, batch_size,
+                                           verbose=0).items()})
+            cbs.on_epoch_end(epoch, logs)
+            history.append(logs)
+        cbs.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 1):
+        loader = self._loader(eval_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (tuple, list)) \
+                else [batch]
+            logs = self.eval_batch(batch[:-1], batch[-1])
+            losses.append(logs["loss"])
+        logs["loss"] = float(np.mean(losses)) if losses else 0.0
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        outs = []
+        self.network.eval()
+        try:
+            for batch in loader:
+                batch = list(batch) if isinstance(batch, (tuple, list)) \
+                    else [batch]
+                preds = self._predict_step(*batch)
+                outs.append(np.asarray(preds.value))
+        finally:
+            self.network.train()
+        return outs
+
+    # -- persistence (hapi Model.save/load parity) -------------------------
+    def save(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        state = {k: np.asarray(v.value)
+                 for k, v in self.network.state_dict().items()}
+        np.savez(path + ".pdparams", **state)
+        if self._optimizer is not None and hasattr(self._optimizer,
+                                                   "_eager_state"):
+            opt_state = {f"{i}": np.asarray(v) for i, (k, v) in
+                         enumerate(self._optimizer._eager_state.items())}
+            np.savez(path + ".pdopt", **opt_state)
+
+    def load(self, path: str):
+        data = np.load(path + ".pdparams.npz")
+        state = {k: Tensor(np.asarray(v)) for k, v in data.items()}
+        self.network.set_state_dict(state)
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self):
+        lines = []
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.value.shape)) if p.value.shape else 1
+            total += n
+            lines.append(f"  {name:50s} {str(p.value.shape):20s} {n}")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}

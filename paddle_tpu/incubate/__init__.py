@@ -1,0 +1,3 @@
+"""Incubating subsystems (analog of python/paddle/fluid/incubate/)."""
+
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,155 @@
+"""Checkpoint tiers for preemption resume.
+
+Capability analog of the reference's three tiers (SURVEY §5):
+(1) ``save/load_persistables`` lives in framework_io; this module adds
+(2) ``CheckpointSaver`` — numbered checkpoint dirs with retention
+    (incubate/checkpoint/checkpoint_saver.py:53, used by
+    Collective.save_checkpoint, incubate/fleet/collective/__init__.py:
+    140-196), and
+(3) ``auto_checkpoint`` / ``train_epoch_range`` — env-configured epoch
+    hooks that snapshot training state each epoch and, after a job
+    restart (the TPU preemption case), SKIP already-completed epochs and
+    restore state (incubate/checkpoint/auto_checkpoint.py:71,458).
+
+Storage is a local/NFS/GCS-fuse directory (``PADDLE_TPU_CHECKPOINT_DIR``
+env — the analog of the reference's PADDLE_EDL_HDFS_* plane).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class CheckpointSaver:
+    """Numbered checkpoint dirs with retention (checkpoint_saver.py:53).
+
+    Layout: ``<root>/<name>/<step>/{meta.json, state.npz}``.
+    """
+
+    def __init__(self, root: str, name: str = "checkpoint",
+                 max_num: int = 3):
+        self.dir = os.path.join(root, name)
+        self.max_num = max_num
+
+    def _numbers(self) -> List[int]:
+        if not os.path.isdir(self.dir):
+            return []
+        out = []
+        for d in os.listdir(self.dir):
+            try:
+                out.append(int(d))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def save(self, state: Dict[str, np.ndarray], number: int,
+             meta: Optional[dict] = None) -> str:
+        path = os.path.join(self.dir, str(number))
+        tmp = path + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(os.path.join(tmp, "state"), **{
+            k: np.asarray(v) for k, v in state.items()})
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"number": number, "time": time.time(),
+                       **(meta or {})}, f)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)  # atomic publish: partial writes invisible
+        self._cleanup()
+        return path
+
+    def _cleanup(self):
+        nums = self._numbers()
+        for n in nums[:-self.max_num] if self.max_num > 0 else []:
+            shutil.rmtree(os.path.join(self.dir, str(n)),
+                          ignore_errors=True)
+
+    def latest(self) -> Optional[int]:
+        nums = self._numbers()
+        return nums[-1] if nums else None
+
+    def load(self, number: Optional[int] = None):
+        """-> (state dict, meta dict) of `number` (default latest)."""
+        number = self.latest() if number is None else number
+        if number is None:
+            return None, None
+        path = os.path.join(self.dir, str(number))
+        data = np.load(os.path.join(path, "state.npz"))
+        state = {k: data[k] for k in data.files}
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        return state, meta
+
+
+def _scope_state(scope) -> Dict[str, np.ndarray]:
+    return {n: np.asarray(scope.find_var(n))
+            for n in scope.all_var_names()}
+
+
+def save_checkpoint(executor, scope, root: str, number: int,
+                    name: str = "fleet_checkpoint", max_num: int = 3,
+                    meta: Optional[dict] = None) -> str:
+    """Fleet-tier checkpoint of a training Scope (Collective.
+    save_checkpoint analog): every scope var (params + optimizer
+    accumulators + LR) in one atomic numbered dir."""
+    return CheckpointSaver(root, name, max_num).save(
+        _scope_state(scope), number, meta)
+
+
+def load_checkpoint(executor, scope, root: str,
+                    name: str = "fleet_checkpoint",
+                    number: Optional[int] = None) -> Optional[dict]:
+    import jax.numpy as jnp
+    state, meta = CheckpointSaver(root, name).load(number)
+    if state is None:
+        return None
+    for k, v in state.items():
+        scope.set_var(k, jnp.asarray(v))
+    return meta
+
+
+class _EpochRange:
+    """auto_checkpoint.py train_epoch_range analog."""
+
+    def __init__(self, max_epoch: int, scope, root: str, name: str,
+                 save_every: int = 1, max_num: int = 3):
+        self.max_epoch = max_epoch
+        self.scope = scope
+        self.saver = CheckpointSaver(root, name, max_num)
+        self.save_every = save_every
+        latest = self.saver.latest()
+        self.start_epoch = 0
+        if latest is not None:
+            state, meta = self.saver.load(latest)
+            import jax.numpy as jnp
+            for k, v in state.items():
+                scope.set_var(k, jnp.asarray(v))
+            self.start_epoch = int(meta.get("epoch", latest)) + 1
+        self.restored = self.start_epoch > 0
+
+    def __iter__(self):
+        for epoch in range(self.start_epoch, self.max_epoch):
+            yield epoch
+            if (epoch + 1) % self.save_every == 0 or \
+                    epoch == self.max_epoch - 1:
+                self.saver.save(_scope_state(self.scope), epoch,
+                                {"epoch": epoch})
+
+
+def train_epoch_range(max_epoch: int, scope, name: str = "auto_ckpt",
+                      root: Optional[str] = None, save_every: int = 1,
+                      max_num: int = 3) -> _EpochRange:
+    """``for epoch in train_epoch_range(10, scope): ...`` — each epoch
+    snapshots the scope; on restart after preemption, completed epochs
+    are skipped and the scope restored (auto_checkpoint.py:458). Root
+    dir from ``root`` or ``PADDLE_TPU_CHECKPOINT_DIR``."""
+    root = root or os.environ.get("PADDLE_TPU_CHECKPOINT_DIR")
+    if not root:
+        raise ValueError("set PADDLE_TPU_CHECKPOINT_DIR or pass root=")
+    return _EpochRange(max_epoch, scope, root, name, save_every, max_num)

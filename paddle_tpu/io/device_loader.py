@@ -44,22 +44,44 @@ class DeviceLoader:
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         err: list = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer walked away —
+            # otherwise an early `break` in the train loop would pin the
+            # producer thread (and depth device batches of HBM) forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 for batch in self.loader:
-                    q.put(self._put(batch))
+                    if not put(self._put(batch)):
+                        return
             except BaseException as e:
                 err.append(e)
             finally:
-                q.put(_STOP)
+                put(_STOP)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _STOP:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _STOP:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            while not q.empty():  # release buffered device arrays
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

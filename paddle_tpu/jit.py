@@ -120,6 +120,14 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
                     lambda t: t.value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
                 new_state = spec.snapshot()
+                if mesh is not None:
+                    # pin fed-back state layouts in-graph (lazy opt
+                    # accumulators make out_shardings unusable)
+                    from .distributed.sharding import (ShardingRules,
+                                                       constrain_snapshot)
+                    new_state = constrain_snapshot(
+                        spec, new_state, mesh,
+                        param_rules or ShardingRules([]))
                 return out_arrays, new_state
             donate = (0,) if donate_state else ()
             if mesh is None:
@@ -238,13 +246,125 @@ def to_static_multi_step(fn, *, layers, optimizers=None,
     return wrapper
 
 
+class InputSpec:
+    """Shape/dtype spec for jit.save tracing (paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class TranslatedLayer:
+    """A loaded jit.save artifact: Program + params, callable like the
+    original Layer (hapi/jit TranslatedLayer parity). Runs through the
+    trace-once Executor, so the first call compiles and the rest are
+    cached."""
+
+    def __init__(self, program, feed_names, fetch_names, state):
+        import jax.numpy as _jnp
+        from .framework import Executor, Scope
+        self.program = program
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._scope = Scope()
+        for k, v in state.items():
+            self._scope.set_var(k, _jnp.asarray(v))
+        self._exe = Executor()
+
+    def __call__(self, *args):
+        import numpy as _np
+        feed = {n: (a.value if isinstance(a, Tensor) else a)
+                for n, a in zip(self._feed_names, args)}
+        outs = self._exe.run(self.program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+        outs = [Tensor(jnp.asarray(o), stop_gradient=True) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def state_dict(self):
+        return {n: self._scope.find_var(n)
+                for n in self._scope.all_var_names()}
+
+
 def save(layer, path: str, input_spec=None):
-    """jit.save analog: persist a Layer's state dict + a traced Program is
-    future work; state dict + config restores via jit.load."""
-    from .framework_io import save_state_dict
-    save_state_dict(layer.state_dict(), path + ".pdparams")
+    """jit.save: trace the layer's forward into a Program (the
+    ProgramDescTracer analog — imperative/jit/program_desc_tracer.cc /
+    dygraph/jit.py TracedLayer) and persist Program JSON (.pdmodel) +
+    parameters (.pdparams). Inference semantics: the layer is traced in
+    eval() mode."""
+    import os
+
+    import numpy as np
+
+    from .dygraph.tape import record_program
+    from .framework.program import Program
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes/dtypes or "
+                         "example Tensors) to trace the forward")
+    inputs = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            inputs.append(s)
+        elif isinstance(s, InputSpec):
+            shape = tuple(1 if d in (-1, None) else d for d in s.shape)
+            inputs.append(Tensor(jnp.zeros(shape, s.dtype),
+                                 stop_gradient=True))
+        else:
+            inputs.append(Tensor(jnp.asarray(s), stop_gradient=True))
+
+    was_training = getattr(layer, "training", False)
+    layer.eval()
+    try:
+        prog = Program()
+        with record_program(prog):
+            out = layer(*inputs)
+    finally:
+        if was_training:
+            layer.train()
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    blk = prog.global_block()
+    feed_names = []
+    for t in inputs:
+        if t.name in blk.vars:
+            blk.vars[t.name].is_data = True
+        feed_names.append(t.name)
+    meta = {
+        "program": prog.to_dict(),
+        "feed_names": feed_names,
+        "fetch_names": [t.name for t in outs],
+    }
+    import json
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "w") as f:
+        json.dump(meta, f)
+    # persist under the traced VAR names (the program references t.name;
+    # state_dict's structured names are a different namespace). Params
+    # and buffers (batch-norm running stats) both appear in the recorded
+    # program as non-feed inputs.
+    var_state = {}
+    for v in layer.state_dict().values():
+        if hasattr(v, "name") and v.name in blk.vars:
+            var_state[v.name] = np.asarray(v.value)
+    np.savez(path + ".pdiparams", **var_state)
+    return prog
 
 
-def load(path: str):
-    from .framework_io import load_state_dict
-    return load_state_dict(path + ".pdparams")
+def load(path: str) -> TranslatedLayer:
+    """jit.load: restore the traced Program + params as a callable."""
+    import json
+
+    import numpy as np
+
+    from .framework.program import Program
+
+    with open(path + ".pdmodel") as f:
+        meta = json.load(f)
+    prog = Program.from_dict(meta["program"])
+    data = np.load(path + ".pdiparams.npz")
+    state = {k: data[k] for k in data.files}
+    return TranslatedLayer(prog, meta["feed_names"], meta["fetch_names"],
+                           state)

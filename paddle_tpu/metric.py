@@ -1,0 +1,166 @@
+"""paddle.metric parity: streaming metrics with update/accumulate/reset.
+
+Analog of python/paddle/metric/metrics.py (Metric, Accuracy, Precision,
+Recall, Auc) and fluid/metrics.py. States accumulate host-side in numpy
+(metrics are consumed between steps, outside the compiled computation);
+inputs may be Tensors, jax arrays or numpy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def _to_np(x) -> np.ndarray:
+    if hasattr(x, "value"):
+        x = x.value
+    return np.asarray(x)
+
+
+class Metric:
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or type(self).__name__.lower()
+
+    def name(self) -> str:
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        """Optional pre-processing hook run on step outputs before
+        update(); default passthrough (hapi calls it when present)."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name: Optional[str] = None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = _to_np(pred)
+        label = _to_np(label)
+        if label.ndim == pred.ndim and label.shape[-1] == 1:
+            label = label[..., 0]
+        maxk = max(self.topk)
+        order = np.argsort(-pred, axis=-1)[..., :maxk]
+        correct = order == label[..., None]
+        return correct
+
+    def update(self, correct):
+        correct = _to_np(correct)
+        n = int(np.prod(correct.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].any(axis=-1).sum()
+            self.count[i] += n
+        res = self.total / np.maximum(self.count, 1)
+        return res[0] if len(self.topk) == 1 else res
+
+    def accumulate(self):
+        res = self.total / np.maximum(self.count, 1)
+        return float(res[0]) if len(self.topk) == 1 else res.tolist()
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over probability predictions (metrics.py
+    Precision)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (_to_np(preds).ravel() > 0.5).astype(np.int64)
+        labels = _to_np(labels).ravel().astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (_to_np(preds).ravel() > 0.5).astype(np.int64)
+        labels = _to_np(labels).ravel().astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Streaming ROC-AUC by threshold bucketing (metrics.py Auc /
+    fluid/layers auc op semantics)."""
+
+    def __init__(self, num_thresholds: int = 4095,
+                 name: Optional[str] = None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.ravel()
+        labels = _to_np(labels).ravel().astype(np.int64)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx[labels == 1], 1)
+        np.add.at(self._neg, idx[labels == 0], 1)
+
+    def accumulate(self):
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        # integrate trapezoid over thresholds, descending
+        pos_c = np.cumsum(self._pos[::-1])
+        neg_c = np.cumsum(self._neg[::-1])
+        tpr = pos_c / tot_pos
+        fpr = neg_c / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]

@@ -5,3 +5,5 @@ python/paddle/vision/models/)."""
 
 from .gpt import (GPT_CONFIGS, GPTForCausalLM, GPTModel, gpt2_medium,
                   gpt2_small, gpt2_tiny)
+from . import generation
+from .generation import beam_search, greedy_search, sample

@@ -1,0 +1,150 @@
+"""Decoding utilities: greedy / sampling / beam search for causal LMs.
+
+Capability analog of the reference's beam-search machinery
+(operators/beam_search_op.cc, beam_search_decode_op.cc and fluid
+layers/rnn.py BeamSearchDecoder) — redesigned without LoD: the beam is a
+dense [batch*beam] axis, KV caches ride along it, and每 step is ordinary
+top-k over [batch, beam*vocab] scores. Decoding loops on the host (the
+per-step compiled model is the hot path, as in any autoregressive
+serving stack).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..dygraph.tape import no_grad
+from ..dygraph.tensor import Tensor
+
+
+def _t(x, dtype=jnp.int32):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x, dtype),
+                                                  stop_gradient=True)
+
+
+@no_grad()
+def greedy_search(model, input_ids, max_new_tokens: int = 16,
+                  eos_token_id: Optional[int] = None):
+    """Greedy decode with KV cache; returns [b, s+new] ids (numpy)."""
+    ids = np.asarray(input_ids)
+    b = ids.shape[0]
+    cache = model.gpt.gen_cache(b)
+    logits, cache = model(_t(ids), cache=cache)
+    out = [ids]
+    done = np.zeros(b, bool)
+    cur = np.asarray(jnp.argmax(logits.value[:, -1], -1)).reshape(b, 1)
+    for step in range(max_new_tokens):
+        if eos_token_id is not None:
+            cur = np.where(done[:, None], eos_token_id, cur)
+            done |= (cur[:, 0] == eos_token_id)
+        out.append(cur)
+        if eos_token_id is not None and done.all():
+            break
+        if step == max_new_tokens - 1:
+            break
+        logits, cache = model(_t(cur), cache=cache,
+                              position_offset=ids.shape[1] + step)
+        cur = np.asarray(jnp.argmax(logits.value[:, -1], -1)).reshape(b, 1)
+    return np.concatenate(out, axis=1)
+
+
+@no_grad()
+def sample(model, input_ids, max_new_tokens: int = 16,
+           temperature: float = 1.0, top_k: int = 0, seed: int = 0):
+    """Temperature / top-k sampling decode."""
+    import jax
+
+    ids = np.asarray(input_ids)
+    b = ids.shape[0]
+    cache = model.gpt.gen_cache(b)
+    logits, cache = model(_t(ids), cache=cache)
+    rng = jax.random.PRNGKey(seed)
+    out = [ids]
+    for step in range(max_new_tokens):
+        lg = logits.value[:, -1] / max(temperature, 1e-6)
+        if top_k > 0:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, jnp.finfo(lg.dtype).min, lg)
+        rng, sub = jax.random.split(rng)
+        cur = np.asarray(jax.random.categorical(sub, lg)).reshape(b, 1)
+        out.append(cur)
+        if step == max_new_tokens - 1:
+            break
+        logits, cache = model(_t(cur), cache=cache,
+                              position_offset=ids.shape[1] + step)
+    return np.concatenate(out, axis=1)
+
+
+@no_grad()
+def beam_search(model, input_ids, beam_size: int = 4,
+                max_new_tokens: int = 16,
+                length_penalty: float = 1.0,
+                eos_token_id: Optional[int] = None):
+    """Beam search decode; returns (ids [b, s+new], scores [b]).
+
+    The beam lives on a dense batch*beam axis (no LoD): caches expand
+    once after the prompt,每 step is log-softmax + top-k over
+    [b, beam*vocab], then a gather re-orders the beam axis of every
+    cache tensor (the beam_search_op "select parents" step).
+    """
+    ids = np.asarray(input_ids)
+    b, s0 = ids.shape
+    k = beam_size
+    import jax
+
+    cache = model.gpt.gen_cache(b)
+    logits, cache = model(_t(ids), cache=cache)
+    lp = np.asarray(jax.nn.log_softmax(logits.value[:, -1], axis=-1))
+    vocab = lp.shape[-1]
+    # seed beams with the top-k first tokens
+    top = np.argsort(-lp, axis=-1)[:, :k]                   # [b, k]
+    scores = np.take_along_axis(lp, top, -1)                # [b, k]
+    tokens = top.reshape(b * k, 1)
+    # expand caches along the beam axis
+    cache = [(Tensor(jnp.repeat(kv[0].value, k, axis=0),
+                     stop_gradient=True),
+              Tensor(jnp.repeat(kv[1].value, k, axis=0),
+                     stop_gradient=True)) for kv in cache]
+    seqs = np.concatenate([np.repeat(ids, k, axis=0), tokens], axis=1)
+    done = np.zeros((b, k), bool)
+
+    for step in range(1, max_new_tokens):
+        logits, cache = model(_t(tokens), cache=cache,
+                              position_offset=s0 + step - 1)
+        lg = np.asarray(logits.value[:, -1])                # [b*k, V]
+        lg = lg - lg.max(-1, keepdims=True)
+        lp = lg - np.log(np.exp(lg).sum(-1, keepdims=True))
+        lp = lp.reshape(b, k, vocab)
+        if eos_token_id is not None:
+            # finished beams only extend with EOS at no cost
+            frozen = np.full((vocab,), -1e9, lp.dtype)
+            frozen[eos_token_id] = 0.0
+            lp = np.where(done[..., None], frozen, lp)
+        total = scores[..., None] + lp                      # [b, k, V]
+        flat = total.reshape(b, k * vocab)
+        best = np.argsort(-flat, axis=-1)[:, :k]            # [b, k]
+        scores = np.take_along_axis(flat, best, -1)
+        parent = best // vocab                              # [b, k]
+        tok = (best % vocab).astype(ids.dtype)
+        # reorder beam-major state by parent
+        gidx = (np.arange(b)[:, None] * k + parent).reshape(-1)
+        seqs = np.concatenate([seqs[gidx], tok.reshape(b * k, 1)], 1)
+        cache = [(Tensor(kv[0].value[gidx], stop_gradient=True),
+                  Tensor(kv[1].value[gidx], stop_gradient=True))
+                 for kv in cache]
+        if eos_token_id is not None:
+            done = np.take_along_axis(done, parent, 1) | \
+                (tok == eos_token_id)
+            if done.all():
+                break
+        tokens = tok.reshape(b * k, 1)
+
+    lengths = seqs.shape[1] - s0
+    final = scores / (lengths ** length_penalty)
+    best_beam = final.argmax(-1)                            # [b]
+    pick = np.arange(b) * k + best_beam
+    return seqs[pick], final[np.arange(b), best_beam]

@@ -1,0 +1,43 @@
+"""Runtime counters — StatRegistry analog (platform/monitor.h:76,129).
+
+``STAT_ADD("STAT_total_feasign_num_in_mem", n)`` style counters used by
+the dataset/PS tiers for observability; thread-safe, exported as a dict.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1):
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+
+
+def stat_set(name: str, value: int):
+    with _lock:
+        _stats[name] = int(value)
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)
+
+
+def reset():
+    with _lock:
+        _stats.clear()
+
+
+# C++-style aliases
+STAT_ADD = stat_add
+STAT_RESET = reset

@@ -13,3 +13,4 @@ from .layers_common import (
 from .transformer import (MultiHeadAttention, Transformer,
                           TransformerDecoder, TransformerDecoderLayer,
                           TransformerEncoder, TransformerEncoderLayer)
+from .rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN

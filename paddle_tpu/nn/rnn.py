@@ -1,0 +1,139 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ single-step cells).
+
+Analog of paddle.nn.layer.rnn (python/paddle/nn/layer/rnn.py, 3.4 kLoC
+over the cudnn_lstm/rnn ops and fluid layers/rnn.py dynamic_rnn). All
+multi-step recurrence routes through the single fused ``rnn`` op
+(ops/rnn_ops.py) — one lax.scan per layer-direction, BPTT via the scan
+VJP. batch_first layout ([b, s, d]), paddle's time_major=False default.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..dygraph.layers import Layer
+from ..dygraph.tape import run_op
+from ..dygraph.tensor import Tensor
+from ..initializer import UniformInitializer
+from ..param_attr import ParamAttr
+
+
+class _RNNBase(Layer):
+    MODE = "LSTM"
+    GATES = 4
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1, direction: str = "forward",
+                 dropout: float = 0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"bad direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        ndir = 2 if self.bidirectional else 1
+        k = 1.0 / math.sqrt(hidden_size)
+        init = UniformInitializer(-k, k)
+        self._weights = []
+        g = self.GATES
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * ndir
+            for d in range(ndir):
+                sfx = f"_l{layer}" + ("_rev" if d else "")
+                w_ih = self.create_parameter(
+                    [g * hidden_size, in_sz],
+                    attr=weight_ih_attr or ParamAttr(initializer=init))
+                w_hh = self.create_parameter(
+                    [g * hidden_size, hidden_size],
+                    attr=weight_hh_attr or ParamAttr(initializer=init))
+                b_ih = self.create_parameter(
+                    [g * hidden_size],
+                    attr=bias_ih_attr or ParamAttr(initializer=init),
+                    is_bias=True)
+                b_hh = self.create_parameter(
+                    [g * hidden_size],
+                    attr=bias_hh_attr or ParamAttr(initializer=init),
+                    is_bias=True)
+                names = (f"weight_ih{sfx}", f"weight_hh{sfx}",
+                         f"bias_ih{sfx}", f"bias_hh{sfx}")
+                for n, p in zip(names, (w_ih, w_hh, b_ih, b_hh)):
+                    setattr(self, n, p)
+                self._weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        ins = {"Input": [inputs if isinstance(inputs, Tensor)
+                         else Tensor(inputs)],
+               "WeightList": self._weights}
+        if initial_states is not None:
+            states = initial_states if isinstance(
+                initial_states, (tuple, list)) else (initial_states,)
+            ins["PreState"] = [s if isinstance(s, Tensor) else Tensor(s)
+                               for s in states]
+        if sequence_length is not None:
+            ins["SequenceLength"] = [
+                sequence_length if isinstance(sequence_length, Tensor)
+                else Tensor(sequence_length)]
+        outs = run_op("rnn", ins,
+                      {"mode": self.MODE, "num_layers": self.num_layers,
+                       "is_bidirec": self.bidirectional,
+                       "hidden_size": self.hidden_size})
+        out = outs["Out"][0]
+        state = outs["State"]
+        if self.MODE == "LSTM":
+            return out, (state[0], state[1])
+        return out, state[0]
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, *args, activation: str = "tanh", **kw):
+        self.MODE = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(*args, **kw)
+
+
+class LSTMCell(Layer):
+    """Single-step LSTM cell (paddle.nn.LSTMCell) — for hand-rolled
+    decoding loops; the multi-step path should use LSTM (fused scan)."""
+
+    def __init__(self, input_size: int, hidden_size: int, **kw):
+        super().__init__()
+        self._rnn = LSTM(input_size, hidden_size, 1, **kw)
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, states=None):
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        seq = x.reshape([x.shape[0], 1, x.shape[1]])
+        out, (h, c) = self._rnn(seq, states)
+        return out.reshape([x.shape[0], self.hidden_size]), (h, c)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size: int, hidden_size: int, **kw):
+        super().__init__()
+        self._rnn = GRU(input_size, hidden_size, 1, **kw)
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, states=None):
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        seq = x.reshape([x.shape[0], 1, x.shape[1]])
+        out, h = self._rnn(seq, states)
+        return out.reshape([x.shape[0], self.hidden_size]), h

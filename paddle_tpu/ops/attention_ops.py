@@ -48,6 +48,27 @@ def _fused_attention_qkv(ctx, ins, attrs):
     causal = bool(attrs.get("causal", False))
     scale = attrs.get("scale") or (1.0 / math.sqrt(q.shape[-1]))
 
+    # sequence/context parallelism: with attr seq_axis set and the axis
+    # bound (shard_map over a seq-sharded mesh), q/k/v arrive as local
+    # sequence chunks and attention runs as a ppermute ring
+    seq_axis = attrs.get("seq_axis")
+    if seq_axis:
+        try:
+            jax.lax.axis_index(seq_axis)
+            bound = True
+        except NameError:
+            bound = False
+        if bound and mask is not None:
+            # silently attending only within local chunks would be wrong
+            raise NotImplementedError(
+                "fused_attention_qkv: explicit Mask + seq_axis (ring "
+                "attention) is not supported; use causal=True or drop "
+                "sequence parallelism for masked attention")
+        if bound:
+            from ..distributed.ring_attention import ring_attention
+            return {"Out": [ring_attention(q, k, v, seq_axis,
+                                           causal=causal, scale=scale)]}
+
     use_pallas = (attrs.get("use_pallas", "auto") != "never"
                   and flags.get_flag("use_pallas_attention")
                   and q.shape[-2] >= flags.get_flag("pallas_min_seq")

@@ -76,9 +76,11 @@ def _c_broadcast(ctx, ins, attrs):
     if ax is None:
         return {"Out": [x]}
     root = attrs.get("root", 0)
-    # broadcast = select root's shard on every device
-    src = jax.lax.all_gather(x, ax)
-    return {"Out": [src[root]]}
+    # broadcast = zero every non-root shard, then psum: O(1) memory per
+    # device (an all_gather would materialize nranks copies)
+    idx = jax.lax.axis_index(ax)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [jax.lax.psum(masked, ax)]}
 
 
 @register("c_allgather")

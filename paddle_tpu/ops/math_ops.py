@@ -311,6 +311,16 @@ def _isinf_v2(ctx, ins, attrs):
     return {"Out": [jnp.isinf(ins["X"][0])]}
 
 
+@register("optimization_barrier", not_differentiable=True)
+def _optimization_barrier(ctx, ins, attrs):
+    """Identity that XLA cannot optimize across — the recompute
+    rewrite's CSE fence (same mechanism jax.checkpoint uses)."""
+    outs = jax.lax.optimization_barrier(tuple(ins["X"]))
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    return {"Out": list(outs)}
+
+
 @register("increment", not_differentiable=True)
 def _increment(ctx, ins, attrs):
     x = ins["X"][0]

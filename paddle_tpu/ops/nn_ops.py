@@ -69,8 +69,14 @@ def _conv2d_transpose(ctx, ins, attrs):
     groups = int(attrs.get("groups", 1))
     if groups != 1:
         raise NotImplementedError("grouped conv2d_transpose")
+    # paddle filter layout [in, out, kh, kw] -> [kh, kw, out, in]:
+    # with transpose_kernel=True jax flips the spatial dims and swaps
+    # I<->O internally, so the HWIO slots must carry (O=out, I=in)
+    # pre-swap -> effective input channels match lhs (caught by the
+    # numerical-grad sweep; the old (2,3,0,1) transpose put in/out
+    # backwards and failed for in_ch != out_ch)
     out = jax.lax.conv_transpose(
-        x, jnp.transpose(w, (2, 3, 0, 1)),  # -> HWIO with I=in
+        x, jnp.transpose(w, (2, 3, 1, 0)),
         strides=strides, padding=pad, rhs_dilation=dil,
         dimension_numbers=("NCHW", "HWIO", "NCHW"),
         transpose_kernel=True)

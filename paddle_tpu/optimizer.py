@@ -311,10 +311,11 @@ class Optimizer:
 
     # -- public ------------------------------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, checkpoints=None):
         plist = parameter_list or self._parameter_list
         return append_backward(loss, parameter_list=plist,
-                               no_grad_set=no_grad_set)
+                               no_grad_set=no_grad_set,
+                               checkpoints=checkpoints)
 
     def apply_gradients(self, params_grads, startup_program=None):
         # Operate on the program that owns the parameters — minimize() may
@@ -691,6 +692,93 @@ class FtrlOptimizer(Optimizer):
              "LinearAccumOut": self._get_accumulator("linear", p)},
             {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power,
              "op_role": "optimize"})
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation-recompute wrapper (fluid/optimizer.py:4518 parity):
+
+        opt = RecomputeOptimizer(SGDOptimizer(0.1))
+        opt._set_checkpoints([h1, h2])
+        opt.minimize(loss)
+
+    backward() runs the checkpointed rewrite (backward.py
+    ``checkpoints=``): forward segments are re-emitted behind
+    optimization_barriers inside the backward, so only checkpoint
+    activations survive the forward pass — FLOPs traded for HBM, the
+    canonical TPU memory lever.
+    """
+
+    def __init__(self, inner_optimizer: Optimizer):
+        self._inner = inner_optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, checkpoints=None):
+        return self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set,
+            checkpoints=checkpoints or self._checkpoints)
+
+    def apply_gradients(self, params_grads, startup_program=None):
+        return self._inner.apply_gradients(params_grads, startup_program)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if not self._checkpoints:
+            raise ValueError(
+                "RecomputeOptimizer: call _set_checkpoints() first")
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads, startup_program)
+        return opt_ops, params_grads
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel wrapper (fluid/optimizer.py:3666 parity):
+
+        with device_guard("tpu:0"): ...first half...
+        with device_guard("tpu:1"): ...second half + loss...
+        opt = PipelineOptimizer(SGDOptimizer(0.1), num_microbatches=4)
+        opt.minimize(loss)
+        runner = opt.runner()           # GPipe schedule
+        runner.run(exe, scope, microbatch_feeds, fetch_list=[loss.name])
+
+    minimize() builds the ordinary joint program (backward + optimizer
+    ops inherit their forward op's op_device), then splits it into
+    per-stage forward/backward/optimize phase programs with microbatch
+    gradient accumulation (distributed/fleet/pipeline.py).
+    """
+
+    def __init__(self, optimizer, num_microbatches: int = 1):
+        self._inner = optimizer
+        self._num_microbatches = int(num_microbatches)
+        self._stages = None
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt_ops, params_grads = self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        from .distributed.fleet.pipeline import split_pipeline_program
+        program = loss.block.program
+        self._stages = split_pipeline_program(program,
+                                              self._num_microbatches)
+        program._pipeline_stages = self._stages
+        program._pipeline_num_microbatches = self._num_microbatches
+        return opt_ops, params_grads
+
+    def runner(self):
+        from .distributed.fleet.pipeline import PipelineRunner
+        if self._stages is None:
+            raise ValueError("call minimize() before runner()")
+        return PipelineRunner(self._stages, self._num_microbatches)
 
 
 # fluid-style aliases

@@ -1,0 +1,141 @@
+"""Profiler: scoped host events + chrome-trace output + XLA (xplane)
+device tracing.
+
+Capability analog of the reference's profiler plane: RAII RecordEvent
+markers (platform/profiler.h:126), EnableProfiler/DisableProfiler
+(:208-211), CUPTI DeviceTracer (device_tracer.h:41), the
+fluid/profiler.py python surface (:131-255) and tools/timeline.py's
+chrome://tracing converter. TPU translation: host events are recorded
+in-process AND forwarded to jax.profiler.TraceAnnotation so they appear
+inside the XLA xplane timeline; device-side tracing is jax.profiler
+start/stop_trace (TensorBoard-loadable), replacing CUPTI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[dict] = []
+_trace_dir: Optional[str] = None
+
+
+class RecordEvent:
+    """Scoped annotation (platform/profiler.h:126 RAII analog); usable
+    as a context manager or decorator. No-op unless the profiler is on,
+    except the jax TraceAnnotation which is cheap and always useful."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        try:
+            import jax.profiler
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        if _enabled:
+            with _lock:
+                _events.append({
+                    "name": self.name,
+                    "ts": self._t0 / 1e3,     # chrome trace uses us
+                    "dur": (t1 - self._t0) / 1e3,
+                    "tid": threading.get_ident() % 100000,
+                })
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            with RecordEvent(self.name):
+                return fn(*a, **kw)
+        return wrapper
+
+
+def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
+    """fluid/profiler.py start_profiler parity. With ``trace_dir`` a
+    jax/XLA device trace (xplane, TensorBoard-loadable) records too."""
+    global _enabled, _trace_dir
+    with _lock:
+        _events.clear()
+    _enabled = True
+    if trace_dir:
+        import jax.profiler
+        jax.profiler.start_trace(trace_dir)
+        _trace_dir = trace_dir
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    """Stop, write a chrome://tracing JSON to ``profile_path`` and print
+    the summary table (fluid/profiler.py stop_profiler +
+    tools/timeline.py collapsed into one step)."""
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir is not None:
+        import jax.profiler
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    with _lock:
+        events = list(_events)
+        _events.clear()
+    trace = {"traceEvents": [
+        {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
+         "pid": 0, "tid": e["tid"], "cat": "host"} for e in events]}
+    d = os.path.dirname(profile_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(profile_path, "w") as f:
+        json.dump(trace, f)
+    summary = summarize(events, sorted_key)
+    if summary:
+        name_w = max(len(s["name"]) for s in summary)
+        print(f"{'Event':{name_w}s}  {'Calls':>6s}  {'Total(ms)':>10s}  "
+              f"{'Avg(ms)':>10s}")
+        for s in summary:
+            print(f"{s['name']:{name_w}s}  {s['calls']:6d}  "
+                  f"{s['total_ms']:10.3f}  {s['avg_ms']:10.3f}")
+    return summary
+
+
+def summarize(events: List[dict], sorted_key: Optional[str] = None):
+    agg: Dict[str, dict] = {}
+    for e in events:
+        a = agg.setdefault(e["name"], {"name": e["name"], "calls": 0,
+                                       "total_ms": 0.0})
+        a["calls"] += 1
+        a["total_ms"] += e["dur"] / 1e3
+    out = list(agg.values())
+    for a in out:
+        a["avg_ms"] = a["total_ms"] / a["calls"]
+    key = {"total": "total_ms", "ave": "avg_ms", "calls": "calls",
+           None: "total_ms"}.get(sorted_key, "total_ms")
+    out.sort(key=lambda a: -a[key])
+    return out
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: str = "/tmp/profile",
+             trace_dir: Optional[str] = None):
+    """``with profiler.profiler(): ...`` context (fluid/profiler.py:255)."""
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

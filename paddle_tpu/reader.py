@@ -1,0 +1,34 @@
+"""fluid.reader parity shim — the DataLoader surface under its fluid
+import path (python/paddle/fluid/reader.py:414). The implementation
+lives in paddle_tpu.io; this module keeps `paddle_tpu.reader` importable
+for reference-style code."""
+
+from .io import DataLoader, DeviceLoader  # noqa: F401
+from .io.dataloader import BatchSampler, default_collate_fn  # noqa: F401
+
+
+def from_generator(feed_list=None, capacity=2, iterable=True):
+    """DataLoader.from_generator-style factory: returns an object with
+    set_batch_generator(fn) / __iter__ like the fluid GeneratorLoader."""
+
+    class _GenLoader:
+        def __init__(self):
+            self._gen = None
+
+        def set_batch_generator(self, generator, places=None):
+            self._gen = generator
+            return self
+
+        set_sample_list_generator = set_batch_generator
+
+        def __iter__(self):
+            if self._gen is None:
+                raise ValueError("call set_batch_generator first")
+            return iter(self._gen())
+
+    return _GenLoader()
+
+
+DataLoader.from_generator = staticmethod(
+    lambda feed_list=None, capacity=2, iterable=True, **kw:
+    from_generator(feed_list, capacity, iterable))

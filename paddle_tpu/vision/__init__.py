@@ -1,0 +1,13 @@
+"""paddle.vision parity: model zoo (+ transforms stub surface).
+
+Analog of python/paddle/vision/ — models power the ResNet-50 Fleet DP
+baseline config (BASELINE.json configs[1], mirroring
+fluid/tests dist_se_resnext.py-style workloads).
+"""
+
+from . import models
+from .models import (LeNet, ResNet, resnet18, resnet34, resnet50,
+                     resnet101, vgg11, vgg16, VGG)
+
+__all__ = ["models", "LeNet", "ResNet", "resnet18", "resnet34",
+           "resnet50", "resnet101", "VGG", "vgg11", "vgg16"]

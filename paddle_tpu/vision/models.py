@@ -1,0 +1,175 @@
+"""Vision model zoo: LeNet, ResNet family, VGG.
+
+Analog of python/paddle/vision/models/{lenet,resnet,vgg}.py. Dygraph
+Layers over the nn surface; NCHW layout (XLA lowers conv to the MXU
+either way; batch-leading keeps the data-parallel batch axis first for
+GSPMD sharding).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Type, Union
+
+from ..dygraph.layers import Layer, LayerList, Sequential
+from ..nn import functional as F
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
+                                Dropout, Flatten, Linear, MaxPool2D, ReLU)
+
+
+class LeNet(Layer):
+    """vision/models/lenet.py parity (the MNIST correctness baseline)."""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2))
+        self.fc = Sequential(
+            Linear(400, 120), Linear(120, 84), Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape([0, -1])  # 0 = copy batch dim (trace-portable)
+        return self.fc(x)
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = BatchNorm2D(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(planes)
+        self.conv3 = Conv2D(planes, planes * 4, 1, bias_attr=False)
+        self.bn3 = BatchNorm2D(planes * 4)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return F.relu(out + identity)
+
+
+class ResNet(Layer):
+    """vision/models/resnet.py parity (ResNet-50 = the Fleet DP baseline
+    workload, BASELINE.json configs[1])."""
+
+    def __init__(self, block: Type, depth_cfg: List[int],
+                 num_classes: int = 1000, in_channels: int = 3):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(64)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0])
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = Sequential(
+                Conv2D(self.inplanes, planes * block.expansion, 1,
+                       stride=stride, bias_attr=False),
+                BatchNorm2D(planes * block.expansion))
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        x = self.avgpool(x)
+        x = x.reshape([0, -1])  # 0 = copy batch dim (trace-portable)
+        return self.fc(x)
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)
+
+
+_VGG_CFG = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    def __init__(self, depth: int = 16, num_classes: int = 1000,
+                 in_channels: int = 3):
+        super().__init__()
+        layers = []
+        c = in_channels
+        for v in _VGG_CFG[depth]:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers += [Conv2D(c, v, 3, padding=1), ReLU()]
+                c = v
+        self.features = Sequential(*layers)
+        self.classifier = Sequential(
+            Linear(512 * 7 * 7, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape([0, -1])  # 0 = copy batch dim (trace-portable)
+        return self.classifier(x)
+
+
+def vgg11(num_classes=1000, **kw):
+    return VGG(11, num_classes, **kw)
+
+
+def vgg16(num_classes=1000, **kw):
+    return VGG(16, num_classes, **kw)

@@ -1,0 +1,150 @@
+"""GSPMD tensor-parallel training path — the north-star axis, as unit
+tests (so the driver's dryrun_multichip can never silently rot again).
+
+Criterion mirrors the reference's TestDistBase (test_dist_base.py:594):
+per-step loss parity between the unsharded step and the mesh-sharded
+step from identical initial parameters.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit
+from paddle_tpu.distributed.sharding import (FULLY_SHARDED_RULES,
+                                             GPT_TENSOR_PARALLEL_RULES)
+from paddle_tpu.models import gpt2_tiny
+from paddle_tpu.optimizer import AdamW
+
+
+def _mesh(shape, names):
+    import jax
+    from jax.sharding import Mesh
+    n = int(np.prod(shape))
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(shape), names)
+
+
+def _train_fns(model, opt):
+    def train_step(ids, labels):
+        loss = model(ids, labels=labels)
+        model.clear_gradients()
+        loss.backward()
+        opt.step()
+        return loss
+    return train_step
+
+
+def _data(steps=3, batch=8, seq=32, vocab=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+        out.append((ids, np.roll(ids, -1, axis=1).astype(np.int32)))
+    return out
+
+
+@pytest.mark.parametrize("mesh_shape,rules", [
+    ((2, 2), GPT_TENSOR_PARALLEL_RULES),   # dp x Megatron mp
+    ((4, 1), FULLY_SHARDED_RULES),         # ZeRO-ish dp sharding
+])
+def test_tp_loss_parity_vs_unsharded(mesh_shape, rules):
+    from jax.sharding import PartitionSpec as P
+
+    pt.seed(0)
+    ref_model = gpt2_tiny()
+    ref_opt = AdamW(learning_rate=1e-3, parameters=ref_model.parameters())
+    ref_step = jit.to_static(_train_fns(ref_model, ref_opt),
+                             layers=[ref_model], optimizers=[ref_opt])
+
+    pt.seed(0)
+    tp_model = gpt2_tiny()
+    tp_opt = AdamW(learning_rate=1e-3, parameters=tp_model.parameters())
+    mesh = _mesh(mesh_shape, ("dp", "mp"))
+    tp_step = jit.to_static(_train_fns(tp_model, tp_opt),
+                            layers=[tp_model], optimizers=[tp_opt],
+                            mesh=mesh, param_rules=rules,
+                            arg_specs=(P("dp", None), P("dp", None)))
+
+    for step, (ids, labels) in enumerate(_data()):
+        ref_loss = float(np.asarray(ref_step(ids, labels).value))
+        tp_loss = float(np.asarray(tp_step(ids, labels).value))
+        assert np.isfinite(tp_loss)
+        np.testing.assert_allclose(
+            tp_loss, ref_loss, rtol=2e-3,
+            err_msg=f"sharded/unsharded loss diverged at step {step}")
+
+
+def test_tp_params_actually_sharded():
+    """The TP rules must place real shards, not replicate everything."""
+    from jax.sharding import PartitionSpec as P
+
+    pt.seed(0)
+    model = gpt2_tiny()
+    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+    mesh = _mesh((2, 2), ("dp", "mp"))
+    step = jit.to_static(_train_fns(model, opt), layers=[model],
+                         optimizers=[opt], mesh=mesh,
+                         param_rules=GPT_TENSOR_PARALLEL_RULES,
+                         arg_specs=(P("dp", None), P("dp", None)))
+    (ids, labels) = _data(steps=1)[0]
+    step(ids, labels)
+    sharded = 0
+    for name, p in model.named_parameters():
+        sh = p.value.sharding
+        spec = getattr(sh, "spec", None)
+        if spec is not None and any(ax is not None for ax in spec):
+            sharded += 1
+    assert sharded >= 10, f"only {sharded} params sharded"
+
+
+def test_dygraph_dp_allreduce_inside_mesh():
+    """DataParallel.apply_collective_grads does a REAL psum-mean when the
+    data axis is bound (round-1/2 weak spot: only the identity fallback
+    was ever tested)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import env as dist_env
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+    dist_env.register_ring(0, "dp")
+    try:
+        def worker(x, w):
+            m = nn.Linear(3, 1, bias_attr=False)
+            m.weight.value = w
+            dp = pt.DataParallel(m)
+            out = dp(pt.Tensor(x))
+            loss = out.sum()
+            loss.backward()
+            dp.apply_collective_grads()
+            return m.weight.grad.value
+
+        x = np.arange(12, dtype=np.float32).reshape(4, 1, 3)
+        w = np.ones((3, 1), np.float32)
+        g = jax.jit(jax.shard_map(
+            worker, mesh=mesh, in_specs=(P("dp"), P()), out_specs=P(),
+            check_vma=False))(x, w)
+        # psum-mean of per-shard grads == grad of the mean over shards
+        expected = x.reshape(4, 3).mean(axis=0, keepdims=True).T
+        np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-6)
+    finally:
+        dist_env._ring_to_axis.pop(0, None)
+
+
+def test_c_broadcast_selects_root_shard():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.ops import registry as reg
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+
+    def f(x):
+        ctx = reg.LoweringContext(axis_env={0: "dp"})
+        return reg.execute(ctx, "c_broadcast", {"X": [x]},
+                           {"ring_id": 0, "root": 2})["Out"][0]
+
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                                out_specs=P("dp"), check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(out), np.tile(x[2], (4, 1)))

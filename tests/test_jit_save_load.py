@@ -1,0 +1,84 @@
+"""jit.save / jit.load: dygraph forward traced into a Program
+(ProgramDescTracer analog), persisted and reloaded as a callable
+TranslatedLayer — was a docstring-only stub in rounds 1-2.
+
+Parity targets: imperative/jit/program_desc_tracer.cc, fluid
+dygraph/jit.py TracedLayer + paddle.jit.save/load.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.models import LeNet
+
+
+def test_save_load_roundtrip_batch_polymorphic(tmp_path):
+    pt.seed(0)
+    m = LeNet()
+    x = np.random.RandomState(0).rand(2, 1, 28, 28).astype(np.float32)
+    ref = m(pt.to_tensor(x))
+    path = str(tmp_path / "lenet")
+    prog = pt.jit.save(m, path,
+                       input_spec=[pt.jit.InputSpec([-1, 1, 28, 28])])
+    assert len(prog.global_block().ops) > 5
+    tl = pt.jit.load(path)
+    out = tl(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out.value),
+                               np.asarray(ref.value), rtol=1e-5,
+                               atol=1e-6)
+    # batch-size change respecializes via the executor cache
+    x8 = np.random.RandomState(1).rand(8, 1, 28, 28).astype(np.float32)
+    assert tl(pt.to_tensor(x8)).value.shape == (8, 10)
+
+
+def test_save_captures_buffers_eval_mode(tmp_path):
+    """BatchNorm running stats ride along and the trace is eval-mode
+    (uses running stats, not batch stats)."""
+    pt.seed(1)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm2D(3)
+            self.fc = nn.Linear(12, 2)
+
+        def forward(self, x):
+            return self.fc(self.bn(x).reshape([0, -1]))
+
+    m = Net()
+    # train a step so running stats move off init
+    x = np.random.RandomState(2).rand(4, 3, 2, 2).astype(np.float32)
+    m.train()
+    m(pt.to_tensor(x))
+    m.eval()
+    ref = m(pt.to_tensor(x))
+    path = str(tmp_path / "bn")
+    pt.jit.save(m, path, input_spec=[pt.jit.InputSpec([-1, 3, 2, 2])])
+    out = pt.jit.load(path)(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out.value),
+                               np.asarray(ref.value), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_multi_output_and_example_tensor_spec(tmp_path):
+    class TwoHead(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 3)
+            self.b = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    pt.seed(3)
+    m = TwoHead()
+    x = np.random.RandomState(3).rand(5, 4).astype(np.float32)
+    ra, rb = m(pt.to_tensor(x))
+    path = str(tmp_path / "two")
+    pt.jit.save(m, path, input_spec=[pt.to_tensor(x)])
+    oa, ob = pt.jit.load(path)(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(oa.value),
+                               np.asarray(ra.value), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ob.value),
+                               np.asarray(rb.value), rtol=1e-5)

@@ -1,0 +1,331 @@
+"""OpTest sweep: numerical-gradient coverage for the differentiable
+lowerings flagged uncovered in review (conv2d_transpose, group_norm,
+instance_norm, interpolate, c_embedding, strided_slice, scatter) plus a
+breadth pass over common tensor/math ops whose grads come from the
+generic vjp derivation — exactly where silent wrongness would hide.
+
+Harness: tests/op_test.py (central differences in fp64 vs the
+program-level analytic grads), mirroring the reference's
+tests/unittests/op_test.py:170. Inputs stay tiny: a numerical grad costs
+O(numel) forward executions.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(7)
+
+
+class TestConv2DTranspose(OpTest):
+    op_type = "conv2d_transpose"
+
+    def setup(self):
+        import torch
+        x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+        w = RNG.randn(3, 2, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2,
+            padding=1).numpy()
+        self.inputs = {"Input": [("x", x)], "Filter": [("w", w)]}
+        self.outputs = {"Output": [("out", ref)]}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1]}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["x", "w"], "out", max_relative_error=0.01)
+
+
+class TestGroupNorm(OpTest):
+    op_type = "group_norm"
+
+    def setup(self):
+        x = RNG.randn(2, 4, 3, 3).astype(np.float32)
+        scale = RNG.rand(4).astype(np.float32) + 0.5
+        bias = RNG.randn(4).astype(np.float32)
+        g = x.reshape(2, 2, 2, 3, 3)
+        m = g.mean(axis=(2, 3, 4), keepdims=True)
+        v = g.var(axis=(2, 3, 4), keepdims=True)
+        y = ((g - m) / np.sqrt(v + 1e-5)).reshape(2, 4, 3, 3)
+        y = y * scale.reshape(1, 4, 1, 1) + bias.reshape(1, 4, 1, 1)
+        self.inputs = {"X": [("x", x)], "Scale": [("scale", scale)],
+                       "Bias": [("bias", bias)]}
+        self.outputs = {"Y": [("y", y)]}
+        self.attrs = {"groups": 2, "epsilon": 1e-5}
+
+    def test(self):
+        self.setup()
+        self.check_output(no_check_set=("Mean", "Variance"))
+        self.check_grad(["x", "scale", "bias"], "y",
+                        max_relative_error=0.01)
+
+
+class TestInstanceNorm(OpTest):
+    op_type = "instance_norm"
+
+    def setup(self):
+        x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+        m = x.mean(axis=(2, 3), keepdims=True)
+        v = x.var(axis=(2, 3), keepdims=True)
+        y = (x - m) / np.sqrt(v + 1e-5)
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Y": [("y", y)]}
+        self.attrs = {"epsilon": 1e-5}
+
+    def test(self):
+        self.setup()
+        self.check_output(no_check_set=("SavedMean", "SavedVariance"))
+        self.check_grad(["x"], "y", max_relative_error=0.01)
+
+
+class TestBilinearInterp(OpTest):
+    op_type = "bilinear_interp_v2"
+
+    def setup(self):
+        import torch
+        x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+        import jax
+        ref = np.asarray(jax.image.resize(
+            x, (1, 2, 8, 8), method="linear"))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", ref)]}
+        self.attrs = {"out_h": 8, "out_w": 8}
+
+    def test(self):
+        self.setup()
+        # output vs torch (align_corners=False halves-aligned resize)
+        import torch
+        tref = torch.nn.functional.interpolate(
+            torch.from_numpy(self.inputs["X"][0][1]), size=(8, 8),
+            mode="bilinear", align_corners=False).numpy()
+        np.testing.assert_allclose(self.outputs["Out"][0][1], tref,
+                                   rtol=1e-4, atol=1e-4)
+        self.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+class TestNearestInterpGrad(OpTest):
+    op_type = "nearest_interp_v2"
+
+    def test(self):
+        import jax
+        x = RNG.randn(1, 2, 3, 3).astype(np.float32)
+        ref = np.asarray(jax.image.resize(x, (1, 2, 6, 6),
+                                          method="nearest"))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", ref)]}
+        self.attrs = {"out_h": 6, "out_w": 6}
+        self.check_output()
+        self.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+class TestCEmbedding(OpTest):
+    op_type = "c_embedding"
+
+    def test(self):
+        w = RNG.randn(6, 4).astype(np.float32)
+        ids = np.array([[2, 0], [5, 3]], np.int64)
+        self.inputs = {"W": [("w", w)], "Ids": [("ids", ids)]}
+        self.outputs = {"Out": [("out", w[ids])]}
+        self.attrs = {"start_index": 0}
+        self.check_output()
+        self.check_grad(["w"], "out", max_relative_error=0.01)
+
+
+class TestStridedSlice(OpTest):
+    op_type = "strided_slice"
+
+    def test(self):
+        x = RNG.randn(4, 6).astype(np.float32)
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", x[1:4:2, 0:6:3])]}
+        self.attrs = {"axes": [0, 1], "starts": [1, 0], "ends": [4, 6],
+                      "strides": [2, 3]}
+        self.check_output()
+        self.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+class TestScatterOverwrite(OpTest):
+    op_type = "scatter"
+
+    def test(self):
+        x = RNG.randn(5, 3).astype(np.float32)
+        ids = np.array([1, 3], np.int64)
+        upd = RNG.randn(2, 3).astype(np.float32)
+        ref = x.copy()
+        ref[ids] = upd
+        self.inputs = {"X": [("x", x)], "Ids": [("ids", ids)],
+                       "Updates": [("upd", upd)]}
+        self.outputs = {"Out": [("out", ref)]}
+        self.attrs = {"overwrite": True}
+        self.check_output()
+        self.check_grad(["x", "upd"], "out", max_relative_error=0.01)
+
+
+class TestScatterAdd(OpTest):
+    op_type = "scatter"
+
+    def test(self):
+        x = RNG.randn(5, 3).astype(np.float32)
+        ids = np.array([1, 1], np.int64)  # duplicate: adds combine
+        upd = RNG.randn(2, 3).astype(np.float32)
+        ref = x.copy()
+        np.add.at(ref, ids, upd)
+        self.inputs = {"X": [("x", x)], "Ids": [("ids", ids)],
+                       "Updates": [("upd", upd)]}
+        self.outputs = {"Out": [("out", ref)]}
+        self.attrs = {"overwrite": False}
+        self.check_output()
+        self.check_grad(["x", "upd"], "out", max_relative_error=0.01)
+
+
+def _simple(op_type_, ins, outs, attrs=None, grads=(), out_name="out",
+            **kw):
+    class T(OpTest):
+        op_type = op_type_
+    t = T()
+    t.inputs = ins
+    t.outputs = outs
+    t.attrs = attrs or {}
+    t.check_output(**kw)
+    if grads:
+        t.check_grad(list(grads), out_name, max_relative_error=0.01)
+
+
+def test_gather():
+    x = RNG.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 3, 3], np.int64)
+    _simple("gather", {"X": [("x", x)], "Index": [("idx", idx)]},
+            {"Out": [("out", x[idx])]}, grads=["x"])
+
+
+def test_gather_nd():
+    x = RNG.randn(3, 4).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    _simple("gather_nd", {"X": [("x", x)], "Index": [("idx", idx)]},
+            {"Out": [("out", x[idx[:, 0], idx[:, 1]])]}, grads=["x"])
+
+
+def test_index_select():
+    x = RNG.randn(4, 3).astype(np.float32)
+    idx = np.array([2, 0], np.int64)
+    _simple("index_select", {"X": [("x", x)], "Index": [("idx", idx)]},
+            {"Out": [("out", x[idx])]}, {"dim": 0}, grads=["x"])
+
+
+def test_roll_flip():
+    x = RNG.randn(3, 4).astype(np.float32)
+    _simple("roll", {"X": [("x", x)]},
+            {"Out": [("out", np.roll(x, 2, axis=1))]},
+            {"shifts": [2], "axis": [1]}, grads=["x"])
+    _simple("flip", {"X": [("x", x)]},
+            {"Out": [("out", x[:, ::-1])]}, {"axis": [1]}, grads=["x"])
+
+
+def test_tile_expand():
+    x = RNG.randn(2, 3).astype(np.float32)
+    _simple("tile", {"X": [("x", x)]},
+            {"Out": [("out", np.tile(x, (2, 1)))]},
+            {"repeat_times": [2, 1]}, grads=["x"])
+    _simple("expand_v2", {"X": [("x", x[:1])]},
+            {"Out": [("out", np.broadcast_to(x[:1], (4, 3)))]},
+            {"shape": [4, 3]}, grads=["x"])
+
+
+def test_stack_unstack_unbind():
+    a = RNG.randn(2, 3).astype(np.float32)
+    b = RNG.randn(2, 3).astype(np.float32)
+    _simple("stack", {"X": [("a", a), ("b", b)]},
+            {"Y": [("y", np.stack([a, b]))]}, {"axis": 0},
+            grads=["a", "b"], out_name="y")
+
+
+def test_squeeze_unsqueeze():
+    x = RNG.randn(2, 1, 3).astype(np.float32)
+    _simple("squeeze2", {"X": [("x", x)]},
+            {"Out": [("out", x[:, 0, :])],
+             "XShape": [("xs", np.zeros((0,) + x.shape, x.dtype))]},
+            {"axes": [1]}, grads=["x"], no_check_set=("XShape",))
+    y = RNG.randn(2, 3).astype(np.float32)
+    _simple("unsqueeze2", {"X": [("x", y)]},
+            {"Out": [("out", y[:, None, :])],
+             "XShape": [("xs", np.zeros((0,) + y.shape, y.dtype))]},
+            {"axes": [1]}, grads=["x"], no_check_set=("XShape",))
+
+
+def test_where_clip_cumsum():
+    x = RNG.randn(3, 3).astype(np.float32)
+    y = RNG.randn(3, 3).astype(np.float32)
+    c = x > 0
+    _simple("where", {"Condition": [("c", c)], "X": [("x", x)],
+                      "Y": [("y", y)]},
+            {"Out": [("out", np.where(c, x, y))]}, grads=["x", "y"])
+    _simple("clip", {"X": [("x", x)]},
+            {"Out": [("out", np.clip(x, -0.5, 0.5))]},
+            {"min": -0.5, "max": 0.5}, grads=["x"])
+    _simple("cumsum", {"X": [("x", x)]},
+            {"Out": [("out", np.cumsum(x, 1))]}, {"axis": 1},
+            grads=["x"])
+
+
+def test_pad3d_prelu_elu():
+    x5 = RNG.randn(1, 2, 2, 3, 3).astype(np.float32)
+    padded = np.pad(x5, ((0, 0), (0, 0), (0, 1), (1, 1), (2, 0)))
+    _simple("pad3d", {"X": [("x", x5)]},
+            {"Out": [("out", padded)]},
+            {"paddings": [2, 0, 1, 1, 0, 1], "mode": "constant",
+             "value": 0.0, "data_format": "NCDHW"}, grads=["x"])
+    x = RNG.randn(1, 2, 3, 3).astype(np.float32)
+    alpha = np.array([0.2], np.float32)
+    _simple("prelu", {"X": [("x", x)], "Alpha": [("alpha", alpha)]},
+            {"Out": [("out", np.where(x > 0, x, 0.2 * x))]},
+            {"mode": "all"}, grads=["x"])
+    _simple("elu", {"X": [("x", x)]},
+            {"Out": [("out", np.where(x > 0, x, np.expm1(x)))]},
+            {"alpha": 1.0}, grads=["x"])
+
+
+def test_logsumexp_dot_addmm():
+    x = RNG.randn(2, 5).astype(np.float32)
+    _simple("logsumexp", {"X": [("x", x)]},
+            {"Out": [("out", np.log(np.exp(x).sum(1)))]},
+            {"axis": [1], "keepdim": False}, grads=["x"])
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(3, 4).astype(np.float32)
+    _simple("dot", {"X": [("x", a)], "Y": [("y", b)]},
+            {"Out": [("out", (a * b).sum(-1))]}, grads=["x", "y"])
+    inp = RNG.randn(2, 4).astype(np.float32)
+    ma = RNG.randn(2, 3).astype(np.float32)
+    mb = RNG.randn(3, 4).astype(np.float32)
+    _simple("addmm", {"Input": [("i", inp)], "X": [("x", ma)],
+                      "Y": [("y", mb)]},
+            {"Out": [("out", 0.5 * inp + 2.0 * (ma @ mb))]},
+            {"Alpha": 2.0, "Beta": 0.5}, grads=["i", "x", "y"])
+
+
+def test_tril_norm():
+    x = RNG.randn(4, 4).astype(np.float32)
+    _simple("tril_triu", {"X": [("x", x)]},
+            {"Out": [("out", np.tril(x))]},
+            {"diagonal": 0, "lower": True}, grads=["x"])
+    _simple("p_norm", {"X": [("x", x)]},
+            {"Out": [("out", np.linalg.norm(x, axis=1))]},
+            {"porder": 2.0, "axis": 1, "keepdim": False}, grads=["x"])
+
+
+def test_huber_kldiv_label_smooth():
+    x = RNG.randn(4, 2).astype(np.float32)
+    y = RNG.randn(4, 2).astype(np.float32)
+    d = 1.0
+    r = x - y
+    huber = np.where(np.abs(r) <= d, 0.5 * r * r,
+                     d * (np.abs(r) - 0.5 * d))
+    _simple("huber_loss", {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": [("out", huber)]}, {"delta": d}, grads=["x"],
+            no_check_set=("Residual",))
+    lbl = np.array([[0.0, 1.0], [1.0, 0.0]], np.float32)
+    eps = 0.1
+    _simple("label_smooth", {"X": [("x", lbl)]},
+            {"Out": [("out", lbl * (1 - eps) + eps / 2)]},
+            {"epsilon": eps}, grads=["x"])

@@ -1,0 +1,57 @@
+"""paddle.grad — partial gradients without .grad side effects
+(PartialGradEngine analog, imperative/partial_grad_engine.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_grad_basic_no_side_effects():
+    x = pt.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = (x * x).sum()
+    (g,) = pt.grad(y, [x])
+    np.testing.assert_allclose(np.asarray(g.value), [2.0, 4.0, 6.0])
+    assert x.grad is None  # .grad untouched, unlike backward()
+
+
+def test_grad_multiple_inputs_and_unused():
+    a = pt.to_tensor(np.array([2.0], np.float32))
+    b = pt.to_tensor(np.array([3.0], np.float32))
+    c = pt.to_tensor(np.array([4.0], np.float32))
+    for t in (a, b, c):
+        t.stop_gradient = False
+    y = a * b  # c unused
+    ga, gb, gc = pt.grad(y, [a, b, c], allow_unused=True)
+    np.testing.assert_allclose(np.asarray(ga.value), [3.0])
+    np.testing.assert_allclose(np.asarray(gb.value), [2.0])
+    assert gc is None
+    with pytest.raises(ValueError):
+        pt.grad(a * b, [c])
+
+
+def test_grad_with_grad_outputs_seed():
+    x = pt.to_tensor(np.array([1.0, 1.0], np.float32))
+    x.stop_gradient = False
+    y = x * 3.0
+    seed = pt.to_tensor(np.array([10.0, 100.0], np.float32))
+    (g,) = pt.grad(y, [x], grad_outputs=[seed])
+    np.testing.assert_allclose(np.asarray(g.value), [30.0, 300.0])
+
+
+def test_grad_retains_graph_for_second_call():
+    x = pt.to_tensor(np.array([5.0], np.float32))
+    x.stop_gradient = False
+    y = x * x
+    (g1,) = pt.grad(y, [x], retain_graph=True)
+    (g2,) = pt.grad(y, [x], retain_graph=True)
+    np.testing.assert_allclose(np.asarray(g1.value),
+                               np.asarray(g2.value))
+
+
+def test_create_graph_raises():
+    x = pt.to_tensor(np.array([1.0], np.float32))
+    x.stop_gradient = False
+    with pytest.raises(NotImplementedError):
+        pt.grad(x * x, [x], create_graph=True)

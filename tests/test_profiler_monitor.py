@@ -1,0 +1,65 @@
+"""Profiler (RecordEvent, chrome trace, summary) + StatRegistry.
+
+Parity targets: platform/profiler.h:126,208, fluid/profiler.py:131-255,
+tools/timeline.py, platform/monitor.h:76.
+"""
+
+import json
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import monitor, profiler
+
+
+def test_record_event_and_chrome_trace(tmp_path):
+    path = str(tmp_path / "trace.json")
+    profiler.start_profiler()
+    with profiler.RecordEvent("matmul_phase"):
+        np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    with profiler.RecordEvent("matmul_phase"):
+        np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    with profiler.RecordEvent("io_phase"):
+        pass
+    summary = profiler.stop_profiler(sorted_key="total",
+                                     profile_path=path)
+    by_name = {s["name"]: s for s in summary}
+    assert by_name["matmul_phase"]["calls"] == 2
+    assert by_name["io_phase"]["calls"] == 1
+    trace = json.load(open(path))
+    assert len(trace["traceEvents"]) == 3
+    assert {e["name"] for e in trace["traceEvents"]} == \
+        {"matmul_phase", "io_phase"}
+
+
+def test_profiler_context_and_decorator(tmp_path):
+    calls = []
+
+    @profiler.RecordEvent("decorated")
+    def work():
+        calls.append(1)
+        return 7
+
+    with profiler.profiler(profile_path=str(tmp_path / "t.json")):
+        assert work() == 7
+    assert calls == [1]
+
+
+def test_events_off_when_disabled(tmp_path):
+    with profiler.RecordEvent("ghost"):
+        pass
+    profiler.start_profiler()
+    summary = profiler.stop_profiler(
+        profile_path=str(tmp_path / "e.json"))
+    assert all(s["name"] != "ghost" for s in summary)
+
+
+def test_stat_registry():
+    monitor.reset()
+    monitor.STAT_ADD("feasigns", 10)
+    monitor.stat_add("feasigns", 5)
+    monitor.stat_set("epoch", 3)
+    assert monitor.stat_get("feasigns") == 15
+    assert monitor.stats() == {"feasigns": 15, "epoch": 3}
+    monitor.reset()
+    assert monitor.stats() == {}

@@ -1,0 +1,212 @@
+"""RNN stack (fused scan op, LSTM/GRU/SimpleRNN layers, BPTT grads) and
+the masked sequence ops.
+
+Parity targets: operators/rnn_op / lstm_op.cc / gru_op.cc,
+python/paddle/nn/layer/rnn.py, operators/sequence_ops/. LSTM/GRU
+numerics are validated against torch.nn.LSTM/GRU (same gate math and
+weight layout), gradients by numerical check through the scan.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.dygraph.tape import run_op
+from paddle_tpu.dygraph.tensor import Tensor
+
+
+def _np(t):
+    return np.asarray(t.value)
+
+
+def _copy_weights_to_torch(m, tm, num_layers=1, ndir=1):
+    import torch
+    for layer in range(num_layers):
+        for d in range(ndir):
+            sfx = f"_l{layer}" + ("_rev" if d else "")
+            tsfx = f"_l{layer}" + ("_reverse" if d else "")
+            for ours, theirs in (
+                    (f"weight_ih{sfx}", f"weight_ih{tsfx}"),
+                    (f"weight_hh{sfx}", f"weight_hh{tsfx}"),
+                    (f"bias_ih{sfx}", f"bias_ih{tsfx}"),
+                    (f"bias_hh{sfx}", f"bias_hh{tsfx}")):
+                getattr(tm, theirs).data = torch.from_numpy(
+                    _np(getattr(m, ours)).copy())
+
+
+@pytest.mark.parametrize("cls,tcls", [("LSTM", "LSTM"), ("GRU", "GRU")])
+def test_rnn_matches_torch(cls, tcls):
+    import torch
+
+    pt.seed(0)
+    b, s, din, h = 3, 7, 5, 4
+    m = getattr(nn, cls)(din, h)
+    tm = getattr(torch.nn, tcls)(din, h, batch_first=True)
+    _copy_weights_to_torch(m, tm)
+
+    x = np.random.RandomState(0).randn(b, s, din).astype(np.float32)
+    out, state = m(pt.to_tensor(x))
+    with torch.no_grad():
+        tout, tstate = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    th = tstate[0] if cls == "LSTM" else tstate
+    hs = state[0] if cls == "LSTM" else state
+    np.testing.assert_allclose(_np(hs), th.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_multilayer_lstm_matches_torch():
+    import torch
+
+    pt.seed(1)
+    b, s, din, h = 2, 5, 3, 4
+    m = nn.LSTM(din, h, num_layers=2, direction="bidirect")
+    tm = torch.nn.LSTM(din, h, num_layers=2, bidirectional=True,
+                       batch_first=True)
+    _copy_weights_to_torch(m, tm, num_layers=2, ndir=2)
+    x = np.random.RandomState(1).randn(b, s, din).astype(np.float32)
+    out, (hn, cn) = m(pt.to_tensor(x))
+    with torch.no_grad():
+        tout, (thn, tcn) = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(_np(hn), thn.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(_np(cn), tcn.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_lstm_gradients_match_torch():
+    import torch
+
+    pt.seed(2)
+    b, s, din, h = 2, 4, 3, 3
+    m = nn.LSTM(din, h)
+    tm = torch.nn.LSTM(din, h, batch_first=True)
+    _copy_weights_to_torch(m, tm)
+    x = np.random.RandomState(2).randn(b, s, din).astype(np.float32)
+
+    out, _ = m(pt.to_tensor(x))
+    out.sum().backward()
+
+    tx = torch.from_numpy(x)
+    tout, _ = tm(tx)
+    tout.sum().backward()
+    np.testing.assert_allclose(_np(m.weight_ih_l0.grad),
+                               tm.weight_ih_l0.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(_np(m.weight_hh_l0.grad),
+                               tm.weight_hh_l0.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_variable_lengths_freeze_state():
+    pt.seed(3)
+    b, s, din, h = 2, 6, 3, 4
+    m = nn.LSTM(din, h)
+    x = np.random.RandomState(3).randn(b, s, din).astype(np.float32)
+    lengths = np.array([6, 3], np.int64)
+    out, (hn, _) = m(pt.to_tensor(x), sequence_length=lengths)
+    # padded steps output zeros
+    np.testing.assert_allclose(_np(out)[1, 3:], 0.0, atol=1e-7)
+    # final state of row 1 equals state at t=3 (run truncated input)
+    out2, (hn2, _) = m(pt.to_tensor(x[1:2, :3]))
+    np.testing.assert_allclose(_np(hn)[0, 1], _np(hn2)[0, 0], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_cells_single_step():
+    pt.seed(4)
+    cell = nn.LSTMCell(5, 4)
+    x = np.random.RandomState(4).randn(3, 5).astype(np.float32)
+    out, (h, c) = cell(pt.to_tensor(x))
+    assert _np(out).shape == (3, 4)
+    assert _np(h).shape == (1, 3, 4)
+    g = nn.GRUCell(5, 4)
+    out2, h2 = g(pt.to_tensor(x))
+    assert _np(out2).shape == (3, 4)
+
+
+# ------------------------------------------------------- sequence ops
+
+def _seq_op(op, ins, attrs):
+    tin = {k: [Tensor(np.asarray(v)) for v in vs] for k, vs in ins.items()}
+    return {k: [_np(t) for t in ts]
+            for k, ts in run_op(op, tin, attrs).items()}
+
+
+def test_sequence_pool_modes():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    lengths = np.array([3, 2], np.int64)
+    for ptype, expect in (
+            ("SUM", np.stack([x[0].sum(0), x[1, :2].sum(0)])),
+            ("AVERAGE", np.stack([x[0].mean(0), x[1, :2].mean(0)])),
+            ("MAX", np.stack([x[0].max(0), x[1, :2].max(0)])),
+            ("LAST", np.stack([x[0, 2], x[1, 1]])),
+            ("FIRST", x[:, 0])):
+        out = _seq_op("sequence_pool", {"X": [x], "Length": [lengths]},
+                      {"pooltype": ptype})["Out"][0]
+        np.testing.assert_allclose(out, expect, err_msg=ptype)
+
+
+def test_sequence_mask_softmax_reverse():
+    lengths = np.array([2, 4], np.int64)
+    mask = _seq_op("sequence_mask", {"X": [lengths]},
+                   {"maxlen": 5, "out_dtype": "int32"})["Y"][0]
+    np.testing.assert_array_equal(
+        mask, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+
+    x = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+    probs = _seq_op("sequence_softmax",
+                    {"X": [x], "Length": [lengths]}, {})["Out"][0]
+    np.testing.assert_allclose(probs.sum(1), [1.0, 1.0], rtol=1e-6)
+    assert (probs[0, 2:] == 0).all()
+
+    xr = _seq_op("sequence_reverse",
+                 {"X": [x], "Length": [lengths]}, {})["Out"][0]
+    np.testing.assert_allclose(xr[0, :2], x[0, :2][::-1])
+    np.testing.assert_allclose(xr[0, 2:], x[0, 2:])
+    np.testing.assert_allclose(xr[1, :4], x[1, :4][::-1])
+
+
+# ------------------------------------------------------- decoding
+
+def test_greedy_and_beam_search_gpt():
+    from paddle_tpu.models import gpt2_tiny
+    from paddle_tpu.models.generation import (beam_search, greedy_search,
+                                              sample)
+
+    pt.seed(11)
+    model = gpt2_tiny()
+    model.eval()
+    ids = np.random.RandomState(0).randint(0, 1024, (2, 8)).astype(np.int32)
+
+    out = greedy_search(model, ids, max_new_tokens=5)
+    assert out.shape == (2, 13)
+    np.testing.assert_array_equal(out[:, :8], ids)
+
+    out_s = sample(model, ids, max_new_tokens=5, top_k=8, seed=3)
+    assert out_s.shape == (2, 13)
+
+    seqs, scores = beam_search(model, ids, beam_size=3, max_new_tokens=5)
+    assert seqs.shape == (2, 13)
+    assert np.isfinite(scores).all()
+    # beam-1 equals greedy (same argmax path)
+    seqs1, _ = beam_search(model, ids, beam_size=1, max_new_tokens=5)
+    np.testing.assert_array_equal(seqs1, out)
+
+
+def test_beam_search_eos_stops():
+    from paddle_tpu.models import gpt2_tiny
+    from paddle_tpu.models.generation import greedy_search
+
+    pt.seed(12)
+    model = gpt2_tiny()
+    model.eval()
+    ids = np.zeros((1, 4), np.int32)
+    # force eos on the first generated token by picking the argmax as eos
+    out = greedy_search(model, ids, max_new_tokens=8)
+    eos = int(out[0, 4])
+    out2 = greedy_search(model, ids, max_new_tokens=8, eos_token_id=eos)
+    assert out2.shape[1] <= out.shape[1]
