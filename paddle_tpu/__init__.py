@@ -44,6 +44,8 @@ from . import monitor
 from . import profiler
 from . import incubate
 from . import reader
+from . import inference
+from . import enforce
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

@@ -1,5 +1,6 @@
 """Fleet — distributed training facade (python/paddle/distributed/fleet)."""
 
+from . import metrics
 from .distributed_strategy import DistributedStrategy
 from .fleet_base import Fleet, fleet
 from .role_maker import PaddleCloudRoleMaker, Role, UserDefinedRoleMaker

@@ -1,0 +1,57 @@
+"""Fleet distributed metrics — cross-worker metric reduction.
+
+Analog of python/paddle/distributed/fleet/metrics/metric.py (sum/max/
+min/auc allreduced over trainers via gloo). TPU translation: inside a
+single-controller SPMD job every host already sees the global batch, so
+single-process jobs reduce to identity; in multi-host (jax.distributed)
+jobs the reduction rides process_allgather over DCN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _gather(value: np.ndarray) -> np.ndarray:
+    """[num_processes, ...] stack of every host's value."""
+    import jax
+    if jax.process_count() <= 1:
+        return np.asarray(value)[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        np.asarray(value)))
+
+
+def sum(value):  # noqa: A001 - reference API name
+    return _gather(value).sum(axis=0)
+
+
+def max(value):  # noqa: A001
+    return _gather(value).max(axis=0)
+
+
+def min(value):  # noqa: A001
+    return _gather(value).min(axis=0)
+
+
+def acc(correct, total):
+    c = _gather(np.asarray(correct, np.float64)).sum()
+    t = _gather(np.asarray(total, np.float64)).sum()
+    return float(c / t) if t else 0.0
+
+
+def mean(value, count):
+    v = _gather(np.asarray(value, np.float64) *
+                np.asarray(count, np.float64)).sum()
+    c = _gather(np.asarray(count, np.float64)).sum()
+    return float(v / c) if c else 0.0
+
+
+def auc(stat_pos, stat_neg):
+    """Merge per-worker AUC bucket stats (fleet metrics auc): inputs are
+    the threshold-bucket positive/negative counts (paddle_tpu.metric.Auc
+    internals), summed across workers before the trapezoid."""
+    from paddle_tpu.metric import auc_from_buckets
+    pos = _gather(np.asarray(stat_pos)).sum(axis=0)
+    neg = _gather(np.asarray(stat_neg)).sum(axis=0)
+    return auc_from_buckets(pos, neg)

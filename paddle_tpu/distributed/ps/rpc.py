@@ -36,6 +36,8 @@ OP_STATE = 5
 OP_LOAD = 6
 OP_BARRIER = 7
 OP_SHUTDOWN = 8
+OP_HEARTBEAT = 9
+OP_WORKER_STATUS = 10
 OP_OK = 100
 OP_ERR = 101
 
@@ -133,6 +135,11 @@ class PSServer:
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        # worker liveness (heart_beat_monitor.cc analog): worker id ->
+        # last heartbeat monotonic time
+        self._heartbeats: Dict[int, float] = {}
+        self._hb_lock = threading.Lock()
+        self.heartbeat_timeout = 30.0
         self._tcp = _TCPServer((host, int(port)), _Handler)
         self._tcp.ps_server = self
         self._thread: Optional[threading.Thread] = None
@@ -162,9 +169,15 @@ class PSServer:
             value_dim, lr = struct.unpack_from("<qd", payload, off)
             off += 16
             optimizer, off = _unpack_str(payload, off)
+            init = "random"
+            if off < len(payload):
+                init, off = _unpack_str(payload, off)
             if name not in self.tables:
                 self.tables[name] = SparseTable(
-                    name, int(value_dim), optimizer=optimizer, lr=lr)
+                    name, int(value_dim), optimizer=optimizer, lr=lr,
+                    initializer=(
+                        (lambda rng, d: np.zeros(d, np.float32))
+                        if init == "zeros" else None))
             return b""
         if op == OP_PULL:
             name, off = _unpack_str(payload, 0)
@@ -216,6 +229,26 @@ class PSServer:
                     if not self._barrier_cv.wait(timeout=60):
                         return struct.pack("<B", 0)
             return struct.pack("<B", 1)
+        if op == OP_HEARTBEAT:
+            import time as _t
+            (wid,) = struct.unpack_from("<q", payload, 0)
+            with self._hb_lock:
+                self._heartbeats[int(wid)] = _t.monotonic()
+            return b""
+        if op == OP_WORKER_STATUS:
+            import json as _json
+            import time as _t
+            timeout = self.heartbeat_timeout
+            if payload:
+                (req_timeout,) = struct.unpack_from("<d", payload, 0)
+                if req_timeout > 0:
+                    timeout = req_timeout
+            now = _t.monotonic()
+            with self._hb_lock:
+                status = {str(w): {"age_sec": round(now - ts, 3),
+                                   "alive": (now - ts) < timeout}
+                          for w, ts in self._heartbeats.items()}
+            return _json.dumps(status).encode()
         if op == OP_SHUTDOWN:
             return None
         raise ValueError(f"unknown PS op {op}")
@@ -241,17 +274,42 @@ class PSClient:
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
+            import time
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=30)
+            # retry with backoff: workers routinely start before their
+            # servers finish binding (grpc channels do the same)
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=5)
+                    break
+                except ConnectionRefusedError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # longer than the server's worst-case in-handler park (the
+            # 60s barrier wait) so a slow barrier can't strand a reply
+            # that the next request would then read as its own
+            s.settimeout(90)
             self._socks[i] = s
         return self._socks[i]
 
     def _call(self, i: int, op: int, payload: bytes) -> bytes:
         with self._locks[i]:
             sock = self._sock(i)
-            _send_msg(sock, op, payload)
-            rop, resp = _recv_msg(sock)
+            try:
+                _send_msg(sock, op, payload)
+                rop, resp = _recv_msg(sock)
+            except (OSError, EOFError):
+                # drop the connection: a timed-out request may still get
+                # its reply later, which would desync the next call
+                try:
+                    sock.close()
+                finally:
+                    self._socks[i] = None
+                raise
         if rop == OP_ERR:
             raise RuntimeError(
                 f"PS server {self.endpoints[i]}: {resp.decode()}")
@@ -267,9 +325,10 @@ class PSClient:
 
     # -- table ops ---------------------------------------------------------
     def create_table(self, name: str, value_dim: int,
-                     optimizer: str = "sgd", lr: float = 0.01):
+                     optimizer: str = "sgd", lr: float = 0.01,
+                     init: str = "random"):
         payload = (_pack_str(name) + struct.pack("<qd", value_dim, lr)
-                   + _pack_str(optimizer))
+                   + _pack_str(optimizer) + _pack_str(init))
         for i in range(len(self.endpoints)):
             self._call(i, OP_CREATE, payload)
 
@@ -320,6 +379,20 @@ class PSClient:
             total += n
         return total
 
+    def heartbeat(self, worker_id: int):
+        """Announce liveness to every server (HeartBeatMonitor feed)."""
+        for i in range(len(self.endpoints)):
+            self._call(i, OP_HEARTBEAT, struct.pack("<q", worker_id))
+
+    def worker_status(self, server: int = 0,
+                      timeout: float = 0.0) -> dict:
+        """Server's liveness view: {worker_id: {age_sec, alive}}.
+        ``timeout`` > 0 overrides the server's default liveness window
+        for this query (monitors can probe with their own SLA)."""
+        import json as _json
+        payload = struct.pack("<d", timeout) if timeout > 0 else b""
+        return _json.loads(self._call(server, OP_WORKER_STATUS, payload))
+
     def barrier(self, expected: int, server: int = 0) -> bool:
         (done,) = struct.unpack(
             "<B", self._call(server, OP_BARRIER,
@@ -341,11 +414,13 @@ class RemoteSparseTable:
     work unchanged in multi-node mode (parameter_prefetch.cc analog)."""
 
     def __init__(self, name: str, value_dim: int, client: PSClient,
-                 optimizer: str = "sgd", lr: float = 0.01, **_):
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 init: str = "random", **_):
         self.name = name
         self.value_dim = value_dim
         self._client = client
-        client.create_table(name, value_dim, optimizer=optimizer, lr=lr)
+        client.create_table(name, value_dim, optimizer=optimizer, lr=lr,
+                            init=init)
 
     def pull(self, ids):
         return self._client.pull(self.name, ids,

@@ -23,7 +23,9 @@ class SparseTable:
 
     def __init__(self, name: str, value_dim: int, shard_num: int = 8,
                  initializer=None, optimizer: str = "sgd",
-                 lr: float = 0.01):
+                 lr: float = 0.01, init: str = "random"):
+        if initializer is None and init == "zeros":
+            initializer = lambda rng, dim: np.zeros(dim, np.float32)
         self.name = name
         self.value_dim = value_dim
         self.shard_num = shard_num

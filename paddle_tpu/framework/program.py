@@ -416,6 +416,44 @@ class Program:
                         op.attrs["is_test"] = True
         return p
 
+    def _prune(self, targets, keep_var_names=()) -> "Program":
+        """Backward slice of block 0: keep only the ops needed to compute
+        ``targets`` (analog of fluid Program._prune / prune_backward used
+        by save_inference_model, fluid/io.py:1279). Ops referencing
+        sub-blocks keep those blocks whole, and the sub-blocks' free
+        variables are treated as the op's inputs. Variables not touched
+        by a surviving op (minus ``keep_var_names``, e.g. declared feed
+        vars) are dropped from block 0, and sub-blocks no longer
+        referenced by a surviving op are emptied (indices stay stable
+        because ops address sub-blocks by index)."""
+        p = self.clone()
+        blk = p.blocks[0]
+        needed = {t.name if isinstance(t, Variable) else str(t)
+                  for t in targets}
+
+        kept = []
+        live_blocks = {0}
+        for op in reversed(blk.ops):
+            if not any(n in needed for n in op.output_names()):
+                continue
+            kept.append(op)
+            needed.update(op.input_names())
+            for si in op_sub_block_indices(op):
+                reads, _ = block_reads_writes(p, si)
+                needed.update(reads)
+                live_blocks.add(si)
+                live_blocks.update(transitive_sub_blocks(p, si))
+        blk.ops = list(reversed(kept))
+        referenced = needed | set(keep_var_names)
+        for op in blk.ops:
+            referenced.update(op.output_names())
+        blk.vars = {n: v for n, v in blk.vars.items() if n in referenced}
+        for b in p.blocks:
+            if b.idx not in live_blocks:
+                b.ops = []
+                b.vars = {}
+        return p
+
     def fingerprint(self) -> str:
         """Stable content hash; part of the executor's compile-cache key."""
         h = hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
@@ -424,6 +462,56 @@ class Program:
     def __repr__(self):
         nops = sum(len(b.ops) for b in self.blocks)
         return f"Program(blocks={len(self.blocks)}, ops={nops})"
+
+
+# -- sub-block graph helpers (shared by Program._prune and
+# layers/control_flow; ONE encoding of the sub-block attr convention) -------
+
+SUB_BLOCK_ATTRS = ("sub_block", "sub_block_t", "sub_block_f")
+
+
+def op_sub_block_indices(op: "Operator") -> List[int]:
+    """Block indices an op references (while/cond/switch-style attrs)."""
+    idxs = [int(op.attrs[a]) for a in SUB_BLOCK_ATTRS if a in op.attrs]
+    idxs += [int(b) for b in op.attrs.get("sub_blocks", [])]
+    return idxs
+
+
+def transitive_sub_blocks(program: "Program", idx: int,
+                          _seen=None) -> set:
+    """All block indices reachable from ``idx`` through nested ops."""
+    seen = _seen if _seen is not None else set()
+    if idx in seen:
+        return seen
+    seen.add(idx)
+    for op in program.blocks[idx].ops:
+        for si in op_sub_block_indices(op):
+            transitive_sub_blocks(program, si, seen)
+    return seen
+
+
+def block_reads_writes(program: "Program", blk_idx: int) -> tuple:
+    """(external_reads, writes) of a block, recursing into nested
+    control-flow sub-blocks. External reads = names consumed before any
+    op in this block (or its children) defines them."""
+    blk = program.blocks[blk_idx]
+    defined: set = set()
+    reads: List[str] = []
+    writes: List[str] = []
+    for op in blk.ops:
+        for n in op.input_names():
+            if n not in defined and n not in reads:
+                reads.append(n)
+        for si in op_sub_block_indices(op):
+            sub_reads, _ = block_reads_writes(program, si)
+            for n in sub_reads:
+                if n not in defined and n not in reads:
+                    reads.append(n)
+        for n in op.output_names():
+            defined.add(n)
+            if n not in writes:
+                writes.append(n)
+    return reads, writes
 
 
 # -- device guard (analog of framework.py device_guard / op_device attr) ----

@@ -99,7 +99,8 @@ def save_inference_model(dirname: str, feeded_var_names, target_vars,
     from .framework.program import Variable, default_main_program
     program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
-    inference = program.clone(for_test=True)
+    inference = program.clone(for_test=True)._prune(
+        target_vars, keep_var_names=feeded_var_names)
     meta = {
         "feed": list(feeded_var_names),
         "fetch": [v.name if isinstance(v, Variable) else str(v)
@@ -107,7 +108,7 @@ def save_inference_model(dirname: str, feeded_var_names, target_vars,
     }
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump({"program": inference.to_dict(), "meta": meta}, f)
-    save_persistables(executor, dirname, program, scope)
+    save_persistables(executor, dirname, inference, scope)
 
 
 def load_inference_model(dirname: str, executor, scope=None):

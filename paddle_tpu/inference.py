@@ -1,0 +1,77 @@
+"""Inference stack: Config + Predictor over saved inference models.
+
+Capability analog of the reference's AnalysisPredictor front door
+(paddle/fluid/inference/api/analysis_predictor.cc,
+paddle_analysis_config.h). The reference's 125-pass analysis/fusion
+pipeline and TensorRT subgraph engines collapse by design: the loaded
+Program compiles through the trace-once executor into ONE XLA
+computation (XLA performs the fusions the ir passes hand-coded), cached
+per input-shape signature. The Predictor owns a private Scope (the
+reference's per-predictor scope) so params load once and concurrent
+predictors don't collide.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Config:
+    """paddle.inference.Config parity surface (model dir + knobs; the
+    accelerator-selection knobs are no-ops — XLA owns placement)."""
+
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+
+    def enable_memory_optim(self, flag: bool = True):
+        pass  # XLA owns buffer reuse/donation
+
+    def switch_ir_optim(self, flag: bool = True):
+        pass  # XLA does the graph optimization
+
+    def disable_glog_info(self):
+        pass
+
+
+class Predictor:
+    """paddle.inference.create_predictor parity: load once, run many.
+
+    >>> pred = create_predictor(Config(model_dir))
+    >>> [out] = pred.run([input_batch])
+    """
+
+    def __init__(self, config: Config):
+        from .framework import Executor, Scope
+        from .framework_io import load_inference_model
+        self._scope = Scope()
+        self._exe = Executor()
+        self._program, self._feed_names, self._fetch_names = \
+            load_inference_model(config.model_dir, self._exe,
+                                 scope=self._scope)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                f"expected {len(self._feed_names)} inputs "
+                f"({self._feed_names}), got {len(inputs)}")
+        feed = {n: np.asarray(a) for n, a in zip(self._feed_names, inputs)}
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+
+    def run_dict(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

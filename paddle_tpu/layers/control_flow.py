@@ -24,35 +24,7 @@ from typing import Callable, List, Optional, Sequence
 
 from ..framework import unique_name
 from ..framework.program import Block, Program, Variable, \
-    default_main_program
-
-_SUB_BLOCK_ATTRS = ("sub_block", "sub_block_t", "sub_block_f")
-
-
-def _block_reads_writes(program: Program, blk_idx: int,
-                        _seen=None) -> tuple:
-    """(external_reads, writes) of a block, recursing into nested
-    control-flow sub-blocks."""
-    blk = program.blocks[blk_idx]
-    defined = set()
-    reads: List[str] = []
-    writes: List[str] = []
-    for op in blk.ops:
-        for n in op.input_names():
-            if n not in defined and n not in reads:
-                reads.append(n)
-        sub_idxs = [op.attrs[a] for a in _SUB_BLOCK_ATTRS if a in op.attrs]
-        sub_idxs += list(op.attrs.get("sub_blocks", []))
-        for si in sub_idxs:
-            sub_reads, _ = _block_reads_writes(program, int(si))
-            for n in sub_reads:
-                if n not in defined and n not in reads:
-                    reads.append(n)
-        for n in op.output_names():
-            defined.add(n)
-            if n not in writes:
-                writes.append(n)
-    return reads, writes
+    block_reads_writes as _block_reads_writes, default_main_program
 
 
 def _as_var_list(v) -> List[Variable]:

@@ -151,16 +151,23 @@ class Auc(Metric):
         np.add.at(self._neg, idx[labels == 0], 1)
 
     def accumulate(self):
-        tot_pos = self._pos.sum()
-        tot_neg = self._neg.sum()
-        if not tot_pos or not tot_neg:
-            return 0.0
-        # integrate trapezoid over thresholds, descending
-        pos_c = np.cumsum(self._pos[::-1])
-        neg_c = np.cumsum(self._neg[::-1])
-        tpr = pos_c / tot_pos
-        fpr = neg_c / tot_neg
-        return float(np.trapezoid(tpr, fpr))
+        return auc_from_buckets(self._pos, self._neg)
 
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+def auc_from_buckets(pos, neg) -> float:
+    """ROC-AUC from threshold-bucket counts via trapezoid integration
+    over thresholds descending (shared by Auc and fleet.metrics.auc,
+    which sums buckets across workers first)."""
+    pos = np.asarray(pos)
+    neg = np.asarray(neg)
+    tot_pos = pos.sum()
+    tot_neg = neg.sum()
+    if not tot_pos or not tot_neg:
+        return 0.0
+    tpr = np.cumsum(pos[::-1]) / tot_pos
+    fpr = np.cumsum(neg[::-1]) / tot_neg
+    return float(np.trapezoid(tpr, fpr))
+
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc",
+           "auc_from_buckets"]

@@ -156,3 +156,68 @@ def test_rng_determinism_with_seed():
     (r1,) = exe1.run(prog, fetch_list=["r"], scope=Scope())
     (r2,) = exe2.run(prog, fetch_list=["r"], scope=Scope())
     np.testing.assert_array_equal(r1, r2)
+
+
+def test_prune_backward_slice_and_dead_subblocks():
+    """Program._prune keeps exactly the ops/vars feeding the targets
+    (fluid io.py save_inference_model prune analog), retains declared
+    feed vars, and empties sub-blocks only reachable from pruned ops."""
+    import paddle_tpu.layers as layers
+    from paddle_tpu.framework import unique_name
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1)
+        # training-only branch with a sub-block: pruned away
+        flag = layers.fill_constant([1], "bool", True)
+        extra = layers.cond(
+            flag,
+            lambda: layers.elementwise_add(pred, y),
+            lambda: layers.elementwise_sub(pred, y))
+        loss = layers.reduce_mean(layers.square(extra))
+        append_backward(loss)
+
+    pruned = main._prune([pred], keep_var_names=["x", "y"])
+    types = [op.type for b in pruned.blocks for op in b.ops]
+    assert "cond" not in types and not any("grad" in t for t in types)
+    # feed vars survive even when unused by the slice
+    assert pruned.global_block().var("y") is not None
+    # sub-blocks of the pruned cond are emptied but indices stay stable
+    assert len(pruned.blocks) == len(main.blocks)
+    assert all(not b.ops for b in pruned.blocks[1:])
+    # the slice still runs: only x is needed
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    (out,) = exe.run(pruned, feed={"x": np.ones((2, 4), np.float32)},
+                     fetch_list=[pred.name], scope=scope)
+    assert out.shape == (2, 1)
+
+
+def test_prune_keeps_needed_subblock_and_free_vars():
+    """An op whose sub-block feeds the target survives pruning with its
+    sub-block intact, including free variables read inside it."""
+    import paddle_tpu.layers as layers
+    from paddle_tpu.framework import unique_name
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        w = layers.fc(x, 4)  # free var consumed inside the branch
+        flag = layers.fill_constant([1], "bool", True)
+        out = layers.cond(flag,
+                          lambda: layers.elementwise_add(x, w),
+                          lambda: layers.elementwise_sub(x, w))
+        dead = layers.reduce_sum(out)  # noqa: F841 - pruned fetch-sibling
+
+    pruned = main._prune([out])
+    types = [op.type for op in pruned.global_block().ops]
+    assert "cond" in types and "reduce_sum" not in types
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    (o,) = exe.run(pruned, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[out.name], scope=scope)
+    assert o.shape == (2, 4)

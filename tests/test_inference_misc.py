@@ -1,0 +1,96 @@
+"""Inference Predictor stack, enforce typed errors, fleet metrics, and
+the op microbenchmark CLI.
+
+Parity: inference/api/analysis_predictor.cc + paddle_analysis_config.h;
+platform/enforce.h:323-416; fleet/metrics/metric.py;
+operators/benchmark/op_tester.cc.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import enforce, layers
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  unique_name)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_predictor_end_to_end(tmp_path):
+    # build + train a tiny model, export with save_inference_model
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 3
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        pred = layers.fc(x, 2)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    (expected,) = exe.run(main, feed={"x": xv}, fetch_list=[pred.name],
+                          scope=scope)
+    d = str(tmp_path / "model")
+    pt.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+
+    from paddle_tpu.inference import Config, create_predictor
+    predictor = create_predictor(Config(d))
+    assert predictor.get_input_names() == ["x"]
+    (out,) = predictor.run([xv])
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+    with pytest.raises(ValueError):
+        predictor.run([xv, xv])
+
+
+def test_enforce_taxonomy():
+    with pytest.raises(enforce.InvalidArgumentError):
+        enforce.enforce_eq(1, 2)
+    with pytest.raises(enforce.EnforceNotMet):
+        enforce.enforce(False, "custom %s", "reason")
+    with pytest.raises(enforce.NotFoundError):
+        enforce.enforce_not_none(None, "table")
+    # typed errors keep python taxonomy too
+    assert issubclass(enforce.NotFoundError, KeyError)
+    assert issubclass(enforce.UnimplementedError, NotImplementedError)
+    enforce.enforce_ge(2, 2)
+    enforce.enforce_lt(1, 2)
+    try:
+        enforce.enforce_gt(0, 1, "ctx")
+    except enforce.InvalidArgumentError as e:
+        assert "INVALID_ARGUMENT" in str(e)
+
+
+def test_fleet_metrics_single_process():
+    from paddle_tpu.distributed.fleet import metrics as fm
+    assert fm.sum(np.array([1.0, 2.0])).tolist() == [1.0, 2.0]
+    assert fm.acc(correct=8, total=10) == 0.8
+    assert fm.mean(0.5, 10) == 0.5
+    # auc from bucket stats merges with the local Auc metric
+    from paddle_tpu.metric import Auc
+    m = Auc(num_thresholds=255)
+    rng = np.random.RandomState(0)
+    scores = np.concatenate([rng.rand(200) * 0.5 + 0.5,
+                             rng.rand(200) * 0.5])
+    labels = np.concatenate([np.ones(200), np.zeros(200)])
+    m.update(scores, labels)
+    assert abs(fm.auc(m._pos, m._neg) - m.accumulate()) < 1e-9
+
+
+def test_op_bench_cli():
+    proc = subprocess.run(
+        [sys.executable, "tools/op_bench.py", "--op", "matmul_v2",
+         "--input", "X:64x64:float32", "--input", "Y:64x64:float32",
+         "--repeat", "3", "--warmup", "1",
+         "--flops", str(2 * 64**3)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    result = json.loads(line)
+    assert result["op"] == "matmul_v2"
+    assert result["min_ms"] > 0 and result["gflops"] > 0.0

@@ -173,3 +173,31 @@ def test_error_propagates_not_kills_connection(two_servers):
     client.create_table("ok", 2)
     assert client.pull("ok", np.array([0], np.int64)).shape == (1, 2)
     client.shutdown_servers()
+
+
+def test_heartbeat_monitor(two_servers):
+    """Worker liveness tracking on the server (heart_beat_monitor.cc
+    analog): heartbeats register, silence past the timeout flips alive
+    to False."""
+    import time
+
+    client = PSClient(two_servers)
+    client.heartbeat(worker_id=0)
+    client.heartbeat(worker_id=1)
+    status = client.worker_status(server=0)
+    assert status["0"]["alive"] and status["1"]["alive"]
+    # shrink the timeout server-side is not reachable from here; instead
+    # verify ages grow monotonically while silent
+    a0 = status["0"]["age_sec"]
+    time.sleep(0.3)
+    status2 = client.worker_status(server=0)
+    assert status2["0"]["age_sec"] > a0
+    # probe with a tight liveness window: both workers have been silent
+    # longer than 0.05s, so the dead branch must fire
+    dead = client.worker_status(server=0, timeout=0.05)
+    assert not dead["0"]["alive"] and not dead["1"]["alive"]
+    client.heartbeat(worker_id=0)
+    status3 = client.worker_status(server=0)
+    assert status3["0"]["age_sec"] < status2["0"]["age_sec"]
+    assert client.worker_status(server=0, timeout=5.0)["0"]["alive"]
+    client.shutdown_servers()
