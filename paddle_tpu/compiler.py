@@ -41,9 +41,21 @@ class BuildStrategy:
         self.enable_inplace = None
         self.fuse_all_reduce_ops = True   # XLA combines collectives anyway
         self.fuse_broadcast_ops = True
+        self.fuse_elewise_add_act_ops = False  # ir pass when True
+        self.fuse_bn_act_ops = False           # ir pass when True
         self.num_trainers = 1
         self.trainer_id = 0
         self.sync_batch_norm = False
+
+    def _ir_passes(self):
+        """Pass names this strategy turns on (build_strategy.cc
+        AppendPass analog); applied by CompiledProgram."""
+        names = []
+        if self.fuse_elewise_add_act_ops:
+            names.append("fuse_elewise_add_act_pass")
+        if self.fuse_bn_act_ops:
+            names.append("fuse_bn_act_pass")
+        return names
 
 
 class ExecutionStrategy:
@@ -57,6 +69,7 @@ class CompiledProgram:
     def __init__(self, program: Program, build_strategy: Optional[BuildStrategy] = None):
         self._program = program
         self._build_strategy = build_strategy or BuildStrategy()
+        self._passes_applied = False
         self._exec_strategy = ExecutionStrategy()
         self._data_parallel = False
         self._loss_name = None
@@ -82,6 +95,11 @@ class CompiledProgram:
 
     # executor protocol ----------------------------------------------------
     def _compile_for_executor(self, executor):
+        names = self._build_strategy._ir_passes()
+        if names and not self._passes_applied:
+            from .framework.ir import PassManager
+            self._program = PassManager(names).apply(self._program)
+            self._passes_applied = True
         return _ParallelRunner(self, executor)
 
 

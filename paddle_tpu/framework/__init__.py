@@ -7,3 +7,4 @@ from .scope import Scope, global_scope
 from .executor import Executor
 from .backward import append_backward, gradients
 from . import unique_name
+from . import ir

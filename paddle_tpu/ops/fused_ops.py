@@ -1,0 +1,67 @@
+"""Fused-op lowerings targeted by the ir pass framework.
+
+Analog of paddle/fluid/operators/fused/ (fused_elemwise_activation_op.cc,
+fused_bn_activation). On TPU most fusion is XLA's job — these ops exist
+as the *targets* of program-level fusion passes (framework/ir.py), so a
+fused region is one op in the IR (fewer ops to schedule/trace, same
+semantics) while XLA emits the actual fused kernel. Gradients come from
+the registry's generic vjp derivation over the composed lowering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .math_ops import _bcast_y
+from .registry import register
+
+# unary functors usable inside fused compositions (subset of the
+# reference's functor registry, fused_elemwise_activation_op.h);
+# each takes (x, act_attrs) so attrs of the original activation op
+# (e.g. gelu's approximate flag) survive fusion
+_UNARY = {
+    "relu": lambda x, a: jax.nn.relu(x),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "gelu": lambda x, a: jax.nn.gelu(
+        x, approximate=bool(a.get("approximate", False))),
+    "identity": lambda x, a: x,
+}
+
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+@register("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """unary(binary(X, Y)) in one op (fused_elemwise_activation_op.cc).
+
+    ``functor_list`` is [binary, unary], e.g.
+    ["elementwise_add", "relu"].
+    """
+    binary_name, unary_name = attrs["functor_list"]
+    act_attrs = attrs.get("act_attrs", {})
+    x, y = ins["X"][0], ins["Y"][0]
+    y = _bcast_y(x, y, attrs.get("axis", -1))
+    out = _UNARY[unary_name](_BINARY[binary_name](x, y), act_attrs)
+    outs = {"Out": [out]}
+    if attrs.get("save_intermediate_out"):
+        outs["IntermediateOut"] = [_BINARY[binary_name](x, y)]
+    return outs
+
+
+@register("fused_scale_bias_relu")
+def _fused_scale_bias_relu(ctx, ins, attrs):
+    """relu(x * scale + bias) — inference-time BN folded to per-channel
+    scale/bias then fused with the activation (fused_bn_activation
+    analog after constant folding)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    if attrs.get("data_layout", "NCHW") == "NCHW" and x.ndim == 4:
+        scale = scale.reshape(1, -1, 1, 1)
+        bias = bias.reshape(1, -1, 1, 1)
+    return {"Out": [jax.nn.relu(x * scale + bias)]}

@@ -1,0 +1,265 @@
+"""IrGraph + Pass framework tests.
+
+Parity: framework/ir/ (graph.h, pass.h, REGISTER_PASS),
+fuse_elewise_add_act_pass.cc, delete_dropout_op_pass, fuse_bn_act_pass;
+python IrGraph fluid/framework.py:3538. Every rewrite is checked for
+numerical parity against the un-rewritten program — the SURVEY §4.4
+program-rewrite test pattern.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.layers as layers
+from paddle_tpu.compiler import BuildStrategy, CompiledProgram
+from paddle_tpu.framework import (Executor, Program, Scope, append_backward,
+                                  program_guard, unique_name)
+from paddle_tpu.framework.ir import (IrGraph, PassManager, apply_pass,
+                                     new_pass, register_pass,
+                                     registered_passes)
+
+
+def _build_mlp():
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 7
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [8])
+        h = layers.fc(x, 16, act=None)
+        h = layers.relu(h)
+        out = layers.fc(h, 4, act=None)
+    return main, startup, out
+
+
+def _run(prog, startup, fetch, feed, scope=None):
+    scope = scope or Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    (out,) = exe.run(prog, feed=feed, fetch_list=[fetch], scope=scope)
+    return out, scope
+
+
+def test_graph_roundtrip_preserves_semantics():
+    main, startup, out = _build_mlp()
+    rebuilt = IrGraph(main).to_program()
+    feed = {"x": np.random.RandomState(0).randn(3, 8).astype(np.float32)}
+    r1, _ = _run(main, startup, out.name, feed)
+    r2, _ = _run(rebuilt, startup, out.name, feed)
+    np.testing.assert_allclose(r1, r2, rtol=1e-6)
+
+
+def test_graph_producer_consumer_edges():
+    main, _, out = _build_mlp()
+    g = IrGraph(main)
+    prod = g.var_producer(out.name)
+    assert prod is not None and "elementwise_add" in [
+        n.type for n in g.all_op_nodes()]
+    # fc = mul + elementwise_add; the mul output feeds exactly one add
+    muls = [n for n in g.all_op_nodes() if n.type == "mul"]
+    assert muls
+    mid = muls[0].op.output("Out")[0]
+    assert [c.type for c in g.var_consumers(mid)] == ["elementwise_add"]
+
+
+def test_fuse_elewise_add_act_pass_rewrites_and_matches():
+    main, startup, out = _build_mlp()
+    feed = {"x": np.random.RandomState(1).randn(5, 8).astype(np.float32)}
+    ref, _ = _run(main, startup, out.name, feed)
+
+    fused_prog = apply_pass(main, "fuse_elewise_add_act_pass")
+    types = [op.type for op in fused_prog.global_block().ops]
+    assert "fused_elemwise_activation" in types
+    # the add+relu pair is gone; the second (act-less) fc's add remains
+    assert types.count("elementwise_add") == 1
+    assert "relu" not in types
+    got, _ = _run(fused_prog, startup, out.name, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # original program untouched (passes are functional)
+    assert "relu" in [op.type for op in main.global_block().ops]
+
+
+def test_fused_elemwise_activation_trains():
+    """Generic vjp grads flow through the fused op: fused program still
+    learns (grad path exercises the fused lowering)."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 11
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        h = layers.relu(layers.fc(x, 8, act=None))
+        pred = layers.fc(h, 1, act=None)
+        loss = layers.reduce_mean(
+            layers.square(layers.elementwise_sub(pred, y)))
+    fused = apply_pass(main, "fuse_elewise_add_act_pass")
+    floss = fused.global_block().var(loss.name)
+    with program_guard(fused, startup):
+        from paddle_tpu.optimizer import SGD
+        SGD(learning_rate=0.1).minimize(floss)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(2)
+    losses = []
+    for _ in range(60):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = (xb.sum(1, keepdims=True) > 0).astype(np.float32)
+        (l,) = exe.run(fused, feed={"x": xb, "y": yb},
+                       fetch_list=[loss.name], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_single_consumer_constraint_blocks_fusion():
+    """An intermediate read by two ops must NOT be fused away."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        a = layers.elementwise_add(x, x)
+        r = layers.relu(a)
+        b = layers.elementwise_mul(a, a)  # second reader of `a`
+        out = layers.elementwise_add(r, b)  # noqa: F841
+    fused = apply_pass(main, "fuse_elewise_add_act_pass")
+    types = [op.type for op in fused.global_block().ops]
+    assert "fused_elemwise_activation" not in types
+
+
+def test_delete_dropout_pass_inference():
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 3
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [6])
+        h = layers.fc(x, 6)
+        d = layers.dropout(h, dropout_prob=0.4,
+                           dropout_implementation="upscale_in_train")
+        out = layers.fc(d, 2)
+    infer = main.clone(for_test=True)
+    cleaned = apply_pass(infer, "delete_dropout_op_pass")
+    types = [op.type for op in cleaned.global_block().ops]
+    assert "dropout" not in types
+    feed = {"x": np.random.RandomState(4).randn(3, 6).astype(np.float32)}
+    ref, _ = _run(infer, startup, out.name, feed)
+    got, _ = _run(cleaned, startup, out.name, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_fuse_bn_act_pass_inference_parity():
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 9
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [3, 8, 8])
+        c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+        bn = layers.batch_norm(c)
+        out = layers.relu(bn)
+    infer = main.clone(for_test=True)
+    fused = apply_pass(infer, "fuse_bn_act_pass")
+    types = [op.type for op in fused.global_block().ops]
+    assert "fused_scale_bias_relu" in types and "batch_norm" not in types
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.random.RandomState(5).randn(2, 3, 8, 8)
+            .astype(np.float32)}
+    (ref,) = exe.run(infer, feed=feed, fetch_list=[out.name], scope=scope)
+    (got,) = exe.run(fused, feed=feed, fetch_list=[out.name], scope=scope)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_custom_pass_registration_and_manager():
+    name = "test_count_matmuls_pass"
+    if name not in registered_passes():
+        @register_pass(name)
+        def _count(graph):
+            n = sum(1 for op in graph.all_op_nodes()
+                    if op.type in ("mul", "matmul_v2"))
+            graph.block.create_var("matmul_count")  # visible side effect
+            graph._matmul_count = n
+    main, _, _ = _build_mlp()
+    p = new_pass(name)
+    g = IrGraph(main)
+    p.apply(g)
+    assert g._matmul_count == 2
+    # PassManager chains by name
+    out_prog = PassManager(["fuse_elewise_add_act_pass", name]).apply(main)
+    assert "matmul_count" in out_prog.global_block().vars
+
+
+def test_build_strategy_applies_passes_via_compiled_program():
+    main, startup, out = _build_mlp()
+    feed = {"x": np.random.RandomState(6).randn(8, 8).astype(np.float32)}
+    ref, _ = _run(main, startup, out.name, feed)
+    bs = BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    compiled = CompiledProgram(main, build_strategy=bs)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(compiled, feed=feed, fetch_list=[out.name],
+                     scope=scope)
+    fused_types = [op.type for op in
+                   compiled._program.global_block().ops]
+    assert "fused_elemwise_activation" in fused_types
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fuse_pass_multiple_chains():
+    """Two fusable pairs in one program: indices renumber after the
+    first rewrite; both must fuse correctly (stale-index regression)."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 13
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [8])
+        h = layers.relu(layers.fc(x, 16, act=None))
+        h = layers.relu(layers.fc(h, 16, act=None))
+        out = layers.fc(h, 4, act=None)
+    feed = {"x": np.random.RandomState(7).randn(3, 8).astype(np.float32)}
+    ref, _ = _run(main, startup, out.name, feed)
+    fused = apply_pass(main, "fuse_elewise_add_act_pass")
+    types = [op.type for op in fused.global_block().ops]
+    assert types.count("fused_elemwise_activation") == 2
+    assert "relu" not in types
+    got, _ = _run(fused, startup, out.name, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fuse_bn_act_pass_multiple_chains():
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 15
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [3, 8, 8])
+        c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+        h = layers.relu(layers.batch_norm(c))
+        c2 = layers.conv2d(h, num_filters=4, filter_size=3, padding=1)
+        out = layers.relu(layers.batch_norm(c2))
+    infer = main.clone(for_test=True)
+    fused = apply_pass(infer, "fuse_bn_act_pass")
+    types = [op.type for op in fused.global_block().ops]
+    assert types.count("fused_scale_bias_relu") == 2
+    assert "batch_norm" not in types
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.random.RandomState(8).randn(2, 3, 8, 8)
+            .astype(np.float32)}
+    (ref,) = exe.run(infer, feed=feed, fetch_list=[out.name], scope=scope)
+    (got,) = exe.run(fused, feed=feed, fetch_list=[out.name], scope=scope)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fuse_preserves_gelu_approximate():
+    """Fusing add+gelu must keep the tanh-approximation flag (the GPT
+    MLP uses approximate=True); exact-gelu substitution would silently
+    change numerics."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 17
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [8])
+        h = layers.fc(x, 8, act=None)
+        out = layers.gelu(h, approximate=True)
+    feed = {"x": 3.0 * np.random.RandomState(9).randn(4, 8)
+            .astype(np.float32)}
+    ref, _ = _run(main, startup, out.name, feed)
+    fused = apply_pass(main, "fuse_elewise_add_act_pass")
+    types = [op.type for op in fused.global_block().ops]
+    assert "fused_elemwise_activation" in types and "gelu" not in types
+    got, _ = _run(fused, startup, out.name, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)

@@ -35,14 +35,10 @@ def _parse_input(spec: str):
 
 def _parse_attr(spec: str):
     k, _, v = spec.partition("=")
-    for conv in (int, float):
-        try:
-            return k, conv(v)
-        except ValueError:
-            pass
-    if v.lower() in ("true", "false"):
-        return k, v.lower() == "true"
-    return k, v
+    try:
+        return k, json.loads(v)  # numbers, bools, lists, dicts
+    except (json.JSONDecodeError, ValueError):
+        return k, v
 
 
 def main(argv=None):
