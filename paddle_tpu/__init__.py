@@ -46,6 +46,7 @@ from . import incubate
 from . import reader
 from . import inference
 from . import enforce
+from . import trainer_desc
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

@@ -5,3 +5,4 @@ from . import fleet
 from .collective import (ReduceOp, all_gather, all_reduce, barrier,
                          broadcast, reduce, reduce_scatter, scatter, split)
 from .parallel import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from .spawn import spawn

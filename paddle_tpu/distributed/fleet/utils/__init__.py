@@ -1,0 +1,4 @@
+"""Fleet utilities (fleet/utils/): filesystem shell, helpers."""
+
+from . import fs
+from .fs import HDFSClient, LocalFS

@@ -1,0 +1,103 @@
+"""paddle.distributed.spawn parity — multiprocessing fan-out.
+
+Analog of python/paddle/distributed/spawn.py:231. The reference spawns
+one process per GPU for dygraph DataParallel. On TPU a single process
+drives all local chips SPMD, so spawn's remaining jobs are (a) CPU-mesh
+tests/tools that want real process isolation and (b) PS-style
+host-process fan-out. Each child gets the PADDLE_* env plane
+(launch_utils.py:407-411 convention) and runs ``func(*args)``; errors
+propagate to the parent with the child traceback.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Sequence
+
+
+class SpawnContext:
+    def __init__(self, procs):
+        self._procs = procs
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every child; on any failure terminate the surviving
+        siblings (the pod-teardown convention, launch_utils
+        terminate_local_procs) then raise."""
+        try:
+            for rank, (p, q) in enumerate(self._procs):
+                p.join(timeout)
+                if p.exitcode is None:
+                    raise TimeoutError(
+                        f"spawned process {rank} still running")
+                if p.exitcode != 0:
+                    err = None
+                    try:
+                        if q is not None and not q.empty():
+                            err = q.get_nowait()
+                    except Exception:
+                        pass
+                    raise RuntimeError(
+                        f"spawned process {rank} exited with code "
+                        f"{p.exitcode}" + (f":\n{err}" if err else ""))
+        except BaseException:
+            self._terminate_all()
+            raise
+        return True
+
+    def _terminate_all(self):
+        for p, _ in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p, _ in self._procs:
+            p.join(5)
+
+    @property
+    def processes(self):
+        return [p for p, _ in self._procs]
+
+
+def _worker(func, args, rank, nprocs, env, err_q):
+    os.environ.update(env)
+    try:
+        func(*args)
+    except Exception:
+        err_q.put(traceback.format_exc())
+        raise
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options) -> Optional[SpawnContext]:
+    """Launch ``nprocs`` processes running ``func(*args)`` with the
+    PADDLE_* env plane set per rank (paddle.distributed.spawn parity).
+
+    options: ``backend`` ignored (XLA owns collectives); ``started_port``
+    sets the base port for PADDLE_TRAINER_ENDPOINTS.
+    """
+    ctx = mp.get_context("spawn")
+    base_port = int(options.get("started_port", 6170))
+    endpoints = ",".join(f"127.0.0.1:{base_port + i}"
+                         for i in range(nprocs))
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
+        }
+        err_q = ctx.Queue()
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, env, err_q),
+                        daemon=daemon)
+        p.start()
+        procs.append((p, err_q))
+    context = SpawnContext(procs)
+    if join:
+        context.join()
+        return None
+    return context
+
+
+__all__ = ["SpawnContext", "spawn"]

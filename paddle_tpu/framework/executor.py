@@ -221,7 +221,8 @@ class Executor:
                            scope: Optional[Scope] = None,
                            fetch_list: Optional[Sequence[Any]] = None,
                            fetch_info: Optional[Sequence[str]] = None,
-                           print_period: int = 100, debug: bool = False):
+                           print_period: Optional[int] = None,
+                           debug: bool = False, trainer_desc=None):
         """Run one epoch over a Dataset (analog of
         executor.py:1597 train_from_dataset -> MultiTrainer::Run,
         multi_trainer.cc:120). The reference spawns C++ device-worker
@@ -231,6 +232,16 @@ class Executor:
         final batch (and prints periodically like LodTensorPrinter)."""
         if dataset is None:
             raise ValueError("train_from_dataset requires a dataset")
+        if trainer_desc is not None:
+            # TrainerDesc config plane (trainer_desc.py parity);
+            # explicit arguments always win over the desc
+            fetch_list = fetch_list or trainer_desc._fetch_vars
+            fetch_info = fetch_info or trainer_desc._fetch_info
+            if print_period is None:
+                print_period = trainer_desc._print_period
+            debug = debug or bool(trainer_desc._fetch_vars)
+        if print_period is None:
+            print_period = 100
         scope = scope or global_scope()
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]

@@ -93,3 +93,22 @@ class LayerHelper:
                        outputs={"Out": out},
                        attrs={"axis": -1})
         return out
+
+
+def build_simple_op(op_type: str, inputs, attrs, out_slots=("Out",),
+                    dtype="float32", out_shapes=None, out_dtypes=None):
+    """One-op builder: create fresh output vars for ``out_slots``,
+    append the op, return the vars (single var if one slot). Shared by
+    the sequence/detection layer modules. ``out_shapes`` maps slot ->
+    static shape so downstream builders (e.g. fc) can infer sizes;
+    ``out_dtypes`` maps slot -> dtype overriding ``dtype`` (e.g. int64
+    length outputs alongside float data)."""
+    helper = LayerHelper(op_type)
+    outs = {s: helper.create_variable_for_type_inference(
+        (out_dtypes or {}).get(s, dtype)) for s in out_slots}
+    for s, shape in (out_shapes or {}).items():
+        if shape is not None:
+            outs[s].shape = list(shape)
+    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs)
+    vals = tuple(outs[s] for s in out_slots)
+    return vals[0] if len(vals) == 1 else vals

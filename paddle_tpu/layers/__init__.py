@@ -15,3 +15,10 @@ from .control_flow import (While, case, cond, equal, greater_equal,
                            greater_than, less_equal, less_than, logical_and,
                            logical_not, logical_or, not_equal, switch_case,
                            while_loop)
+from . import detection
+from .sequence_lod import (sequence_concat, sequence_conv,
+                           sequence_enumerate, sequence_expand,
+                           sequence_expand_as, sequence_first_step,
+                           sequence_last_step, sequence_mask, sequence_pad,
+                           sequence_pool, sequence_reverse, sequence_slice,
+                           sequence_softmax, sequence_unpad)

@@ -215,3 +215,174 @@ def _sequence_expand(ctx, ins, attrs):
     x = ins["X"][0]
     k = int(attrs.get("times", 1))
     return {"Out": [jnp.repeat(x, k, axis=0)]}
+
+
+@register("sequence_pad", no_grad_slots=("Length",))
+def _sequence_pad(ctx, ins, attrs):
+    """Packed rows [total, d] + Length [b] -> padded [b, maxlen, d] +
+    Length passthrough (sequence_pad_op.cc analog over the packed
+    layout). ``padded_length`` must be static (XLA shapes); positions
+    past each length take PadValue."""
+    x = ins["X"][0]
+    lengths = ins["Length"][0].reshape(-1)
+    pad_value = ins.get("PadValue", [jnp.zeros((), x.dtype)])[0]
+    maxlen = int(attrs.get("padded_length", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_pad requires a static padded_length "
+                         "(XLA needs static shapes)")
+    b = lengths.shape[0]
+    starts = jnp.cumsum(lengths) - lengths          # row offsets in x
+    # rows longer than padded_length are truncated (the reference
+    # errors instead; under jit lengths are runtime values, so clamp
+    # the reported Length to keep (Out, Length) self-consistent)
+    clamped = jnp.minimum(lengths, maxlen)
+    t = jax.lax.broadcasted_iota(jnp.int32, (b, maxlen), 1)
+    src = jnp.clip(starts[:, None] + t, 0, x.shape[0] - 1)
+    gathered = x[src]                               # [b, maxlen, ...]
+    mask = (t < clamped[:, None]).reshape(
+        (b, maxlen) + (1,) * (x.ndim - 1))
+    out = jnp.where(mask, gathered, pad_value.astype(x.dtype))
+    return {"Out": [out], "Length": [clamped]}
+
+
+@register("sequence_unpad", no_grad_slots=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    """Padded [b, s, d] + Length [b] -> packed [b*s, d] with valid rows
+    compacted to the front (zeros after) and the total count.
+
+    The reference's LoD output has a data-dependent leading dim; XLA
+    needs static shapes, so the packed buffer keeps the b*s bound and
+    callers use Total (or sum(Length)) to know the live prefix."""
+    x = ins["X"][0]
+    lengths = ins["Length"][0].reshape(-1)
+    b, s = x.shape[0], x.shape[1]
+    t = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+    valid = (t < lengths[:, None]).reshape(-1)
+    # stable argsort: valid rows (key 0) before padding (key 1),
+    # original order preserved within each class
+    order = jnp.argsort(jnp.where(valid, 0, 1), stable=True)
+    flat = x.reshape((b * s,) + x.shape[2:])
+    packed = jnp.where(
+        valid[order].reshape((-1,) + (1,) * (x.ndim - 2)),
+        flat[order], jnp.zeros((), x.dtype))
+    return {"Out": [packed], "Total": [valid.sum().astype(jnp.int64)]}
+
+
+@register("sequence_conv", no_grad_slots=("Length",))
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time (sequence_conv_op.cc): x [b, s, d],
+    Filter [context_length*d, m] -> [b, s, m]. Window rows outside
+    [0, length) contribute zeros, matching the reference's zero padding
+    of out-of-bounds context."""
+    x = ins["X"][0]
+    w = ins["Filter"][0]
+    lengths = ins.get("Length", [None])[0]
+    cl = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    cs = int(attrs.get("contextStart", attrs.get("context_start",
+                                                 -(cl - 1) // 2)))
+    if int(attrs.get("contextStride", 1)) != 1:
+        raise ValueError("sequence_conv only supports contextStride=1 "
+                         "(the reference has the same restriction)")
+    b, s, d = x.shape
+    if lengths is None:
+        valid = jnp.ones((b, s), bool)
+    else:
+        t = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        valid = t < lengths.reshape(-1)[:, None]
+    xm = jnp.where(valid[..., None], x, 0)
+    cols = []
+    for k in range(cl):
+        shift = cs + k
+        rolled = jnp.roll(xm, -shift, axis=1)
+        t = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1) + shift
+        inb = (t >= 0) & (t < s)
+        cols.append(jnp.where(inb[..., None], rolled, 0))
+    windows = jnp.concatenate(cols, axis=-1)        # [b, s, cl*d]
+    out = windows.reshape(b * s, cl * d) @ w
+    out = out.reshape(b, s, -1)
+    out = jnp.where(valid[..., None], out, 0)
+    return {"Out": [out]}
+
+
+@register("sequence_slice", no_grad_slots=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row slice [offset, offset+length) of each sequence
+    (sequence_slice_op.h): x [b, s, ...] + Offset [b] + Length [b] ->
+    [b, s, ...] with the slice moved to the front and zeros after."""
+    x = ins["X"][0]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    b, s = x.shape[0], x.shape[1]
+    t = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+    src = jnp.clip(off[:, None] + t, 0, s - 1)
+    gathered = jnp.take_along_axis(
+        x, src.reshape((b, s) + (1,) * (x.ndim - 2)), axis=1)
+    mask = (t < ln[:, None]).reshape((b, s) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(mask, gathered, 0)]}
+
+
+@register("sequence_concat", no_grad_slots=("Length",))
+def _sequence_concat(ctx, ins, attrs):
+    """Ragged concat along time (sequence_concat_op.cc): inputs are
+    padded [b, s_i, d] with Length entries aligned to X; each output
+    row is x1[:l1] ++ x2[:l2] ++ ... then zero padding. Output time dim
+    = sum of input time dims (static bound)."""
+    xs = ins["X"]
+    lens = [ln.reshape(-1) for ln in ins["Length"]]
+    b = xs[0].shape[0]
+    s_total = sum(x.shape[1] for x in xs)
+    trailing = xs[0].shape[2:]
+    t = jax.lax.broadcasted_iota(jnp.int32, (b, s_total), 1)
+    out = jnp.zeros((b, s_total) + trailing, xs[0].dtype)
+    start = jnp.zeros((b,), jnp.int32)
+    for x, ln in zip(xs, lens):
+        ln = ln.astype(jnp.int32)
+        # out positions [start, start+ln) <- x[0:ln)
+        rel = t - start[:, None]
+        inseg = (rel >= 0) & (rel < ln[:, None])
+        src = jnp.clip(rel, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, src.reshape((b, s_total) + (1,) * (x.ndim - 2)), axis=1)
+        out = jnp.where(
+            inseg.reshape((b, s_total) + (1,) * (x.ndim - 2)),
+            gathered, out)
+        start = start + ln
+    total_len = sum(ln.astype(jnp.int64) for ln in lens)
+    return {"Out": [out], "Length": [total_len]}
+
+
+@register("sequence_enumerate", not_differentiable=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding window of ids (sequence_enumerate_op.cc): x [b, s] int
+    -> [b, s, win_size]; positions past the row (or past length) take
+    pad_value."""
+    x = ins["X"][0]
+    win = int(attrs.get("win_size", 2))
+    pad = int(attrs.get("pad_value", 0))
+    lengths = ins.get("Length", [None])[0]
+    b, s = x.shape
+    t = jax.lax.broadcasted_iota(jnp.int32, (b, s, win), 1)
+    k = jax.lax.broadcasted_iota(jnp.int32, (b, s, win), 2)
+    src = t + k
+    limit = (lengths.reshape(-1)[:, None, None] if lengths is not None
+             else jnp.full((b, 1, 1), s, jnp.int32))
+    inb = src < limit
+    vals = jnp.take_along_axis(x[:, :, None].repeat(win, 2),
+                               jnp.clip(src, 0, s - 1), axis=1)
+    return {"Out": [jnp.where(inb, vals, pad)]}
+
+
+@register("sequence_expand_as", no_grad_slots=("Length",))
+def _sequence_expand_as(ctx, ins, attrs):
+    """Broadcast per-row features over time (sequence_expand_as_op.cc):
+    x [b, d] + Length [b] + maxlen -> [b, maxlen, d] masked to each
+    row's length."""
+    x = ins["X"][0]
+    lengths = ins["Length"][0].reshape(-1)
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_expand_as requires static maxlen")
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], maxlen) + x.shape[1:])
+    mask = _length_mask(lengths, maxlen, x.dtype).reshape(
+        (x.shape[0], maxlen) + (1,) * (x.ndim - 1))
+    return {"Out": [out * mask]}

@@ -1,0 +1,298 @@
+"""Detection op tests vs numpy references.
+
+Parity: operators/detection/ (iou_similarity_op, box_coder_op,
+box_clip_op, prior_box_op, anchor_generator_op, yolo_box_op,
+multiclass_nms_op, roi_align_op) + fluid layers/detection.py. The
+fixed-capacity NMS contract (padded rows, explicit count) replaces the
+reference's LoD output.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+from paddle_tpu.dygraph.tape import run_op
+from paddle_tpu.dygraph.tensor import Tensor
+
+
+def _run(op, ins, attrs):
+    tin = {k: [Tensor(np.asarray(v)) for v in vs] for k, vs in ins.items()}
+    return {k: [np.asarray(t.numpy()) for t in ts]
+            for k, ts in run_op(op, tin, attrs).items()}
+
+
+def _iou_np(a, b):
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    area = lambda z: (z[:, 2] - z[:, 0]) * (z[:, 3] - z[:, 1])
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(4, 4).astype(np.float32), -1)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.rand(3, 4).astype(np.float32), -1)[:, [0, 2, 1, 3]]
+    out = _run("iou_similarity", {"X": [a], "Y": [b]},
+               {"box_normalized": True})["Out"][0]
+    np.testing.assert_allclose(out, _iou_np(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -3.0, 120.0, 140.0],
+                       [10.0, 20.0, 30.0, 40.0]]], np.float32)
+    im_info = np.array([[100.0, 80.0, 1.0]], np.float32)
+    out = _run("box_clip", {"Input": [boxes], "ImInfo": [im_info]},
+               {})["Output"][0]
+    np.testing.assert_allclose(
+        out[0, 0], [0.0, 0.0, 79.0, 99.0])
+    np.testing.assert_allclose(out[0, 1], boxes[0, 1])
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.sort(rng.rand(5, 4).astype(np.float32), -1)[:, [0, 2, 1, 3]]
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    targets = np.sort(rng.rand(5, 4).astype(np.float32),
+                      -1)[:, [0, 2, 1, 3]] + 0.05
+    enc = _run("box_coder",
+               {"PriorBox": [priors], "PriorBoxVar": [var],
+                "TargetBox": [targets]},
+               {"code_type": "encode_center_size"})["OutputBox"][0]
+    assert enc.shape == (5, 5, 4)
+    # decode the diagonal (each target against its own prior)
+    diag = np.stack([enc[i, i] for i in range(5)])[:, None, :]
+    dec = _run("box_coder",
+               {"PriorBox": [priors], "PriorBoxVar": [var],
+                "TargetBox": [np.repeat(diag, 5, 1)]},
+               {"code_type": "decode_center_size",
+                "axis": 0})["OutputBox"][0]
+    got = np.stack([dec[i, i] for i in range(5)])
+    np.testing.assert_allclose(got, targets, rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_shapes_and_range():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    boxes, variances = (
+        _run("prior_box", {"Input": [feat], "Image": [img]},
+             {"min_sizes": [16.0], "max_sizes": [32.0],
+              "aspect_ratios": [2.0], "flip": True, "clip": True,
+              "variances": [0.1, 0.1, 0.2, 0.2]})[k][0]
+        for k in ("Boxes", "Variances"))
+    # priors per cell: 1 (ar 1) + 2 (ar 2, flip) + 1 (max size) = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert variances.shape == boxes.shape
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+    # center cell (1,1): ar-1 prior is centered at (1.5/4 * 64) px
+    cx = (boxes[1, 1, 0, 0] + boxes[1, 1, 0, 2]) / 2
+    np.testing.assert_allclose(cx, 1.5 * 16 / 64, atol=1e-5)
+    np.testing.assert_allclose(variances[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_shapes():
+    feat = np.zeros((1, 8, 3, 5), np.float32)
+    anchors, variances = (
+        _run("anchor_generator", {"Input": [feat]},
+             {"anchor_sizes": [64.0, 128.0],
+              "aspect_ratios": [0.5, 1.0],
+              "stride": [16.0, 16.0]})[k][0]
+        for k in ("Anchors", "Variances"))
+    assert anchors.shape == (3, 5, 4, 4)
+    # square anchor (ar=1, size 64) at cell (0,0): 64x64 centered at 8,8
+    sq = anchors[0, 0, 2]
+    np.testing.assert_allclose(sq, [8 - 32, 8 - 32, 8 + 32, 8 + 32],
+                               atol=1e-4)
+
+
+def test_yolo_box_decode():
+    an = [10, 13, 16, 30]  # two anchors
+    nc = 2
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2 * (5 + nc), 2, 2).astype(np.float32)
+    img = np.array([[64, 64]], np.int64)
+    out = _run("yolo_box", {"X": [x], "ImgSize": [img]},
+               {"anchors": an, "class_num": nc, "conf_thresh": 0.0,
+                "downsample_ratio": 32, "clip_bbox": True})
+    boxes, scores = out["Boxes"][0], out["Scores"][0]
+    assert boxes.shape == (1, 8, 4) and scores.shape == (1, 8, nc)
+    # manual decode of anchor 0 at cell (0, 0)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    t = x[0, :7]
+    cx = (sig(t[0, 0, 0]) + 0) / 2 * 64
+    cy = (sig(t[1, 0, 0]) + 0) / 2 * 64
+    bw = np.exp(t[2, 0, 0]) * 10 / (32 * 2) * 64
+    bh = np.exp(t[3, 0, 0]) * 13 / (32 * 2) * 64
+    expect = [max(cx - bw / 2, 0), max(cy - bh / 2, 0),
+              min(cx + bw / 2, 63), min(cy + bh / 2, 63)]
+    np.testing.assert_allclose(boxes[0, 0], expect, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        scores[0, 0], sig(t[4, 0, 0]) * sig(t[5:7, 0, 0]), rtol=1e-5)
+    # boxes clipped into the image
+    assert boxes.min() >= 0 and boxes.max() <= 63
+
+
+def _nms_np(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if scores[i] == -np.inf:
+            continue
+        if all(_iou_np(boxes[i:i + 1], boxes[j:j + 1])[0, 0] <= thresh
+               for j in keep):
+            keep.append(i)
+    return keep
+
+
+def test_multiclass_nms_matches_greedy_reference():
+    rng = np.random.RandomState(3)
+    m, c = 12, 3
+    base = np.sort(rng.rand(m, 2), 1)
+    boxes = np.concatenate(
+        [base[:, :1], base[:, :1], base[:, 1:], base[:, 1:]],
+        1).astype(np.float32)
+    boxes = boxes[None]  # [1, M, 4]
+    scores = rng.rand(1, c, m).astype(np.float32)
+    out = _run("multiclass_nms",
+               {"BBoxes": [boxes], "Scores": [scores]},
+               {"background_label": 0, "score_threshold": 0.2,
+                "nms_threshold": 0.4, "nms_top_k": 10, "keep_top_k": 8,
+                "normalized": True})
+    rows, num = out["Out"][0][0], int(out["NumDetected"][0][0])
+    valid = rows[rows[:, 0] >= 0]
+    assert len(valid) == num
+    # scores sorted descending across surviving rows
+    assert (np.diff(valid[:, 1]) <= 1e-6).all()
+    # numpy reference: per non-background class, pre-truncate to the
+    # top nms_top_k candidates (reference NMSFast), then greedy nms
+    expect = set()
+    for cls in range(1, c):
+        s = scores[0, cls].copy()
+        s[s < 0.2] = -np.inf
+        kth = np.sort(s)[::-1][min(10, len(s)) - 1]
+        s[s < kth] = -np.inf
+        for i in _nms_np(boxes[0], s, 0.4):
+            expect.add((cls, round(float(scores[0, cls, i]), 5)))
+    got = {(int(r[0]), round(float(r[1]), 5)) for r in valid}
+    assert got == set(list(sorted(expect, key=lambda t: -t[1]))[:8])
+
+
+def test_roi_align_constant_region():
+    # constant image -> every pooled value equals the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+    out = _run("roi_align", {"X": [x], "ROIs": [rois]},
+               {"pooled_height": 2, "pooled_width": 2,
+                "spatial_scale": 1.0, "sampling_ratio": 2})["Out"][0]
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.5, rtol=1e-6)
+
+
+def test_roi_align_batch_routing():
+    # two images with distinct constants; RoisNum routes rois
+    x = np.stack([np.full((1, 4, 4), 1.0), np.full((1, 4, 4), 2.0)]
+                 ).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]] * 3, np.float32)
+    rois_num = np.array([1, 2], np.int32)
+    out = _run("roi_align",
+               {"X": [x], "ROIs": [rois], "RoisNum": [rois_num]},
+               {"pooled_height": 1, "pooled_width": 1,
+                "spatial_scale": 1.0})["Out"][0]
+    np.testing.assert_allclose(out.ravel(), [1.0, 2.0, 2.0], rtol=1e-6)
+
+
+class TestRoiAlignGrad(OpTest):
+    op_type = "roi_align"
+
+    def setup(self):
+        rng = np.random.RandomState(4)
+        self.inputs = {
+            "X": [("x", rng.randn(1, 2, 6, 6).astype(np.float64))],
+            "ROIs": [("rois", np.array([[0.5, 0.5, 4.5, 4.5],
+                                        [1.0, 2.0, 5.0, 5.5]],
+                                       np.float64))],
+        }
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        self.outputs = {"Out": [("out", np.zeros((2, 2, 2, 2)))]}
+
+    def test(self):
+        self.setup()
+        self.check_grad(["x"], "out", max_relative_error=5e-3)
+
+
+def test_detection_layers_static():
+    """layers.detection builders compose in a static program."""
+    import paddle_tpu.layers as L
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        feat = L.data("feat", [8, 4, 4])
+        img = L.data("img", [3, 64, 64])
+        boxes, variances = L.detection.prior_box(
+            feat, img, min_sizes=[16.0], aspect_ratios=[2.0], flip=True,
+            clip=True)
+        x = L.data("x", [2, 8, 8])
+        rois = L.data("rois", [4], dtype="float32")
+        pooled = L.detection.roi_align(x, rois, pooled_height=2,
+                                       pooled_width=2)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(5)
+    outs = exe.run(main, feed={
+        "feat": rng.randn(1, 8, 4, 4).astype(np.float32),
+        "img": rng.randn(1, 3, 64, 64).astype(np.float32),
+        "x": rng.randn(1, 2, 8, 8).astype(np.float32),
+        "rois": np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)},
+        fetch_list=[boxes.name, pooled.name], scope=scope)
+    assert np.asarray(outs[0]).shape == (4, 4, 3, 4)
+    assert np.asarray(outs[1]).shape == (1, 2, 2, 2)
+
+
+def test_yolo_box_anchor_major_ordering():
+    """Row index = anchor*h*w + y*w + x (reference ordering)."""
+    an = [10, 13, 16, 30]
+    nc = 1
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 2 * 6, 2, 2).astype(np.float32)
+    img = np.array([[64, 64]], np.int64)
+    boxes = _run("yolo_box", {"X": [x], "ImgSize": [img]},
+                 {"anchors": an, "class_num": nc, "conf_thresh": 0.0,
+                  "downsample_ratio": 32, "clip_bbox": False})["Boxes"][0]
+    # row 5 = anchor 1, cell y=0, x=1 (1*4 + 0*2 + 1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    t = x[0, 6:]  # anchor 1 block
+    cx = (sig(t[0, 0, 1]) + 1) / 2 * 64
+    np.testing.assert_allclose((boxes[0, 5, 0] + boxes[0, 5, 2]) / 2, cx,
+                               rtol=1e-4)
+
+
+def test_box_clip_respects_scale():
+    boxes = np.array([[[0.0, 0.0, 700.0, 500.0]]], np.float32)
+    im_info = np.array([[600.0, 800.0, 2.0]], np.float32)  # orig 300x400
+    out = _run("box_clip", {"Input": [boxes], "ImInfo": [im_info]},
+               {})["Output"][0]
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 399.0, 299.0])
+
+
+def test_nms_top_k_truncates_before_suppression():
+    """Boxes ranked below nms_top_k never appear, even if they would
+    survive suppression (reference pre-NMS truncation)."""
+    # 4 disjoint boxes, scores descending; nms_top_k=2 keeps only the
+    # top 2 candidates regardless of overlap
+    boxes = np.array([[[0, 0, 1, 1], [2, 2, 3, 3], [4, 4, 5, 5],
+                       [6, 6, 7, 7]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7, 0.6]]], np.float32)
+    out = _run("multiclass_nms",
+               {"BBoxes": [boxes], "Scores": [scores]},
+               {"background_label": -1, "score_threshold": 0.0,
+                "nms_threshold": 0.5, "nms_top_k": 2, "keep_top_k": 4,
+                "normalized": True})
+    num = int(out["NumDetected"][0][0])
+    assert num == 2
+    kept_scores = sorted(out["Out"][0][0][:num, 1], reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.8], rtol=1e-5)

@@ -13,6 +13,7 @@ import pytest
 import paddle_tpu as pt
 import paddle_tpu.nn as nn
 from paddle_tpu.dygraph.tape import run_op
+from op_test import OpTest
 from paddle_tpu.dygraph.tensor import Tensor
 
 
@@ -210,3 +211,192 @@ def test_beam_search_eos_stops():
     eos = int(out[0, 4])
     out2 = greedy_search(model, ids, max_new_tokens=8, eos_token_id=eos)
     assert out2.shape[1] <= out.shape[1]
+
+
+# ------------------------------------ new sequence ops (pad/unpad/...)
+
+def test_sequence_pad_unpad_roundtrip():
+    rng = np.random.RandomState(1)
+    lengths = np.array([3, 1, 2], np.int64)
+    packed = rng.randn(6, 4).astype(np.float32)  # 3+1+2 rows
+    out = _seq_op("sequence_pad",
+                  {"X": [packed], "Length": [lengths],
+                   "PadValue": [np.float32(0)]},
+                  {"padded_length": 4})
+    padded = out["Out"][0]
+    assert padded.shape == (3, 4, 4)
+    np.testing.assert_allclose(padded[0, :3], packed[:3])
+    np.testing.assert_allclose(padded[1, :1], packed[3:4])
+    np.testing.assert_allclose(padded[2, :2], packed[4:6])
+    assert (padded[0, 3:] == 0).all() and (padded[1, 1:] == 0).all()
+
+    back = _seq_op("sequence_unpad",
+                   {"X": [padded], "Length": [lengths]}, {})
+    unp, total = back["Out"][0], back["Total"][0]
+    assert int(total) == 6
+    np.testing.assert_allclose(unp[:6], packed)
+    assert (unp[6:] == 0).all()
+
+
+def test_sequence_conv_matches_reference_window():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    lengths = np.array([5, 3], np.int64)
+    w = rng.randn(9, 4).astype(np.float32)  # context 3 x d 3
+    out = _seq_op("sequence_conv",
+                  {"X": [x], "Filter": [w], "Length": [lengths]},
+                  {"contextLength": 3, "contextStart": -1})["Out"][0]
+    # numpy reference: row 1 has length 3; context rows outside
+    # [0, len) are zero
+    xm = x.copy()
+    xm[1, 3:] = 0
+    for b, ln in enumerate(lengths):
+        for t in range(ln):
+            window = []
+            for k in (-1, 0, 1):
+                s = t + k
+                window.append(xm[b, s] if 0 <= s < ln else
+                              np.zeros(3, np.float32))
+            expect = np.concatenate(window) @ w
+            np.testing.assert_allclose(out[b, t], expect, rtol=1e-5,
+                                       atol=1e-5)
+    assert (out[1, 3:] == 0).all()
+
+
+def test_sequence_slice_concat_enumerate_expand_as():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 5, 2).astype(np.float32)
+    off = np.array([1, 0], np.int64)
+    ln = np.array([2, 3], np.int64)
+    sl = _seq_op("sequence_slice",
+                 {"X": [x], "Offset": [off], "Length": [ln]}, {})["Out"][0]
+    np.testing.assert_allclose(sl[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(sl[1, :3], x[1, :3])
+    assert (sl[0, 2:] == 0).all()
+
+    x1 = rng.randn(2, 3, 2).astype(np.float32)
+    l1 = np.array([2, 3], np.int64)
+    x2 = rng.randn(2, 2, 2).astype(np.float32)
+    l2 = np.array([1, 2], np.int64)
+    cc = _seq_op("sequence_concat",
+                 {"X": [x1, x2], "Length": [l1, l2]}, {})
+    out, lens = cc["Out"][0], cc["Length"][0]
+    np.testing.assert_array_equal(lens, [3, 5])
+    np.testing.assert_allclose(out[0, :2], x1[0, :2])
+    np.testing.assert_allclose(out[0, 2:3], x2[0, :1])
+    assert (out[0, 3:] == 0).all()
+    np.testing.assert_allclose(out[1, :3], x1[1])
+    np.testing.assert_allclose(out[1, 3:5], x2[1, :2])
+
+    ids = np.array([[1, 2, 3, 4]], np.int64)
+    en = _seq_op("sequence_enumerate", {"X": [ids]},
+                 {"win_size": 2, "pad_value": 0})["Out"][0]
+    np.testing.assert_array_equal(
+        en[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    feat = rng.randn(2, 3).astype(np.float32)
+    ex = _seq_op("sequence_expand_as",
+                 {"X": [feat], "Length": [np.array([2, 1], np.int64)]},
+                 {"maxlen": 3})["Out"][0]
+    np.testing.assert_allclose(ex[0, :2], np.stack([feat[0]] * 2))
+    assert (ex[0, 2:] == 0).all() and (ex[1, 1:] == 0).all()
+
+
+def test_sequence_layers_static_graph():
+    """layers.sequence_* builders compose in a static program and the
+    padding never leaks (fluid layers/sequence_lod.py parity)."""
+    import paddle_tpu.layers as L
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 5
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [6, 8])           # [b, s, d]
+        lens = L.data("lens", [], dtype="int64")
+        c = L.sequence_conv(x, num_filters=8, filter_size=3,
+                            sequence_length=lens, act="relu")
+        probs = L.sequence_softmax(L.reduce_sum(c, dim=-1), lens)
+        pooled = L.sequence_pool(c, "average", lens)
+        last = L.sequence_last_step(c, lens)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(6)
+    feed = {"x": rng.randn(3, 6, 8).astype(np.float32),
+            "lens": np.array([6, 4, 2], np.int64)}
+    p, pl, lst = exe.run(main, feed=feed,
+                         fetch_list=[probs.name, pooled.name, last.name],
+                         scope=scope)
+    np.testing.assert_allclose(np.asarray(p).sum(1), np.ones(3), rtol=1e-5)
+    assert np.asarray(p)[2, 2:].max() == 0
+    assert np.asarray(pl).shape == (3, 8)
+    assert np.asarray(lst).shape == (3, 8)
+
+
+class TestSequenceConvGrad(OpTest):
+    op_type = "sequence_conv"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        self.inputs = {
+            "X": [("x", rng.randn(2, 4, 3).astype(np.float64))],
+            "Filter": [("w", rng.randn(9, 2).astype(np.float64))],
+            "Length": [("ln", np.array([4, 2], np.int64))],
+        }
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+        self.outputs = {"Out": [("out", np.zeros((2, 4, 2)))]}
+
+    def test(self):
+        self.setup()
+        self.check_grad(["x", "w"], "out", max_relative_error=5e-3)
+
+
+class TestSequencePadGrad(OpTest):
+    op_type = "sequence_pad"
+
+    def setup(self):
+        rng = np.random.RandomState(8)
+        self.inputs = {
+            "X": [("x", rng.randn(5, 3).astype(np.float64))],
+            "PadValue": [("pv", np.zeros((), np.float64))],
+            "Length": [("ln", np.array([3, 2], np.int64))],
+        }
+        self.attrs = {"padded_length": 4}
+        self.outputs = {"Out": [("out", np.zeros((2, 4, 3)))],
+                        "Length": [("lout", np.zeros(2, np.int64))]}
+
+    def test(self):
+        self.setup()
+        self.check_grad(["x"], "out", max_relative_error=5e-3,
+                        no_grad_set=("pv",))
+
+
+class TestSequenceSliceGrad(OpTest):
+    op_type = "sequence_slice"
+
+    def setup(self):
+        rng = np.random.RandomState(9)
+        self.inputs = {
+            "X": [("x", rng.randn(2, 5, 2).astype(np.float64))],
+            "Offset": [("off", np.array([1, 0], np.int64))],
+            "Length": [("ln", np.array([2, 3], np.int64))],
+        }
+        self.attrs = {}
+        self.outputs = {"Out": [("out", np.zeros((2, 5, 2)))]}
+
+    def test(self):
+        self.setup()
+        self.check_grad(["x"], "out", max_relative_error=5e-3)
+
+
+def test_sequence_pad_clamps_overlong_lengths():
+    """Rows longer than padded_length truncate AND report the clamped
+    length, keeping (Out, Length) self-consistent."""
+    packed = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lengths = np.array([4, 1], np.int64)
+    out = _seq_op("sequence_pad",
+                  {"X": [packed], "Length": [lengths],
+                   "PadValue": [np.float32(0)]},
+                  {"padded_length": 3})
+    np.testing.assert_array_equal(out["Length"][0], [3, 1])
+    np.testing.assert_allclose(out["Out"][0][0], packed[:3])

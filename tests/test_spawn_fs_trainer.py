@@ -1,0 +1,114 @@
+"""spawn API, fleet fs shell, TrainerDesc plane.
+
+Parity: distributed/spawn.py:231, fleet/utils/fs.py,
+trainer_desc.py:24-343 + executor train_from_dataset:1597.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+
+# module-level so the spawn pickler can find it
+def _spawn_target(out_dir):
+    import os
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    n = os.environ["PADDLE_TRAINERS_NUM"]
+    ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    with open(os.path.join(out_dir, f"rank{rank}.txt"), "w") as f:
+        f.write(f"{rank}/{n}@{ep}")
+
+
+def _spawn_failer():
+    raise ValueError("child boom")
+
+
+def test_spawn_env_plane_and_join(tmp_path):
+    from paddle_tpu.distributed import spawn
+    spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+    r0 = (tmp_path / "rank0.txt").read_text()
+    r1 = (tmp_path / "rank1.txt").read_text()
+    assert r0.startswith("0/2@127.0.0.1:") and r1.startswith("1/2@")
+
+
+def test_spawn_propagates_child_error():
+    from paddle_tpu.distributed import spawn
+    with pytest.raises(RuntimeError, match="child boom"):
+        spawn(_spawn_failer, nprocs=1)
+
+
+def test_local_fs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    fs = LocalFS()
+    d = tmp_path / "ckpt"
+    fs.mkdirs(str(d / "sub"))
+    fs.touch(str(d / "a.txt"))
+    dirs, files = fs.ls_dir(str(d))
+    assert dirs == ["sub"] and files == ["a.txt"]
+    assert fs.is_dir(str(d)) and fs.is_file(str(d / "a.txt"))
+    fs.mv(str(d / "a.txt"), str(d / "b.txt"))
+    assert fs.is_exist(str(d / "b.txt")) and not fs.is_exist(
+        str(d / "a.txt"))
+    from paddle_tpu.distributed.fleet.utils.fs import FSFileExistsError
+    fs.touch(str(d / "c.txt"))
+    with pytest.raises(FSFileExistsError):
+        fs.mv(str(d / "b.txt"), str(d / "c.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+
+
+def test_hdfs_client_requires_hadoop():
+    from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                       HDFSClient)
+    if not os.path.exists("/usr/bin/hadoop"):
+        with pytest.raises(ExecuteError):
+            HDFSClient(hadoop_home="/nonexistent")
+
+
+def test_trainer_desc_drives_train_from_dataset(tmp_path, capsys):
+    import paddle_tpu as pt
+    import paddle_tpu.layers as L
+    from paddle_tpu.dataset import InMemoryDataset
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+    from paddle_tpu.trainer_desc import (Hogwild, MultiTrainer,
+                                         TrainerFactory)
+
+    # slot file: label + 2 dense-ish slots of ids
+    f = tmp_path / "part-000"
+    rng = np.random.RandomState(0)
+    with open(f, "w") as fh:
+        for _ in range(32):
+            a, b = rng.randint(0, 9, 2)
+            fh.write(f"{int(a + b > 8)} 0:{a} 1:{b}\n")
+    ds = InMemoryDataset(slot_names=["a", "b"])
+    ds.set_filelist([str(f)])
+    ds.set_batch_size(8)
+    ds.load_into_memory()
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 4
+    with program_guard(main, startup), unique_name.guard():
+        a = L.data("a", [1], dtype="int64")
+        b = L.data("b", [1], dtype="int64")
+        y = L.data("label", [1], dtype="float32")
+        x = L.concat([L.cast(a, "float32"), L.cast(b, "float32")], axis=1)
+        logit = L.fc(x, 1)
+        loss = L.reduce_mean(L.sigmoid_cross_entropy_with_logits(
+            logit, y))
+        from paddle_tpu.optimizer import SGD
+        SGD(learning_rate=0.05).minimize(loss)
+
+    trainer = TrainerFactory().create_trainer(
+        {"fetch_var_names": [loss.name], "print_period": 2,
+         "thread_num": 1})
+    assert isinstance(trainer, MultiTrainer)
+    assert isinstance(trainer._device_worker, Hogwild)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    last = exe.train_from_dataset(main, ds, scope=scope,
+                                  trainer_desc=trainer)
+    assert last is not None
+    out = capsys.readouterr().out
+    assert "train_from_dataset" in out  # print_period plumbing fired
