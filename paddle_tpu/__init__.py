@@ -47,6 +47,7 @@ from . import reader
 from . import inference
 from . import enforce
 from . import trainer_desc
+from . import slim
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

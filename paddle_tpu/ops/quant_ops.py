@@ -1,0 +1,126 @@
+"""Fake-quantization ops for QAT/PTQ — simulated int8 on TPU.
+
+Analog of paddle/fluid/operators/fake_quantize_op.{cc,cu,h}
+(fake_quantize_dequantize_abs_max, channel-wise variant,
+moving_average_abs_max + the dequantize pairs). Quantize-dequantize in
+one op ("simulated quantization"): float in, float out, rounded to the
+int grid — the standard QAT formulation. Backward is the straight-
+through estimator (STE): d(qdq(x))/dx ≈ 1, registered as a custom grad
+maker (round() has zero/undefined derivative, so the generic vjp path
+would produce useless grads).
+
+Moving-average scale state follows the batch_norm convention: OutScale/
+OutState/OutAccum alias the persistable input vars and the executor
+writes them back each step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _qdq(x, scale, qmax):
+    """round(clip(x/scale)) on the int grid, back to float."""
+    scale = jnp.maximum(scale, 1e-8)
+    y = jnp.clip(x / scale, -1.0, 1.0)
+    return jnp.round(y * qmax) / qmax * scale
+
+
+def _ste_grad_maker(op, out_grad_names, wanted_input_grads):
+    """STE: dX = dOut, ignore scale inputs (fake_quantize_op.h
+    FakeQuantizeDequantizeGradKernel)."""
+    gs = out_grad_names.get("Out", [])
+    g = next((x for x in gs if x is not None), None)
+    gx = next((x for x in wanted_input_grads.get("X", [])
+               if x is not None), None)
+    if g is None or gx is None:
+        return []
+    return [("ste_identity_grad", {"Out@GRAD": [g]}, {"X@GRAD": [gx]}, {})]
+
+
+@register("ste_identity_grad", not_differentiable=True)
+def _ste_identity_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0]]}
+
+
+@register("fake_quantize_dequantize_abs_max",
+          custom_grad_maker=_ste_grad_maker)
+def _fake_qdq_abs_max(ctx, ins, attrs):
+    """Per-tensor dynamic abs-max quant-dequant
+    (fake_quantize_dequantize_abs_max op)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_qdq(x, scale, qmax)], "OutScale": [scale]}
+
+
+@register("fake_channel_wise_quantize_dequantize_abs_max",
+          custom_grad_maker=_ste_grad_maker)
+def _fake_qdq_channel_abs_max(ctx, ins, attrs):
+    """Per-channel weight quant-dequant; quant_axis selects the channel
+    dim (0 for conv filters [Cout,...], 1 for mul weights [in, out])."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    qmax = float(2 ** (bits - 1) - 1)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=reduce_axes, keepdims=True)
+    out = _qdq(x, scale, qmax)
+    return {"Out": [out], "OutScale": [scale.reshape(-1)]}
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max",
+          no_grad_slots=("InScale", "InAccum", "InState"),
+          custom_grad_maker=_ste_grad_maker)
+def _fake_qdq_moving_avg(ctx, ins, attrs):
+    """Activation quant-dequant with moving-average abs-max scale
+    (fake_quantize_dequantize_moving_average_abs_max op).
+
+    Training: state = rate*state + 1; accum = rate*accum + absmax(x);
+    scale = accum / state. Inference (is_test): scale = InScale frozen.
+    """
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    qmax = float(2 ** (bits - 1) - 1)
+    outs = {}
+    if attrs.get("is_test"):
+        scale = in_scale
+        outs["OutScale"] = [scale]
+    else:
+        cur = jnp.max(jnp.abs(x))
+        state = ins.get("InState", [jnp.ones(())])[0].reshape(())
+        accum = ins.get("InAccum", [in_scale])[0].reshape(())
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+        outs["OutScale"] = [scale]
+        outs["OutState"] = [new_state]
+        outs["OutAccum"] = [new_accum]
+    outs["Out"] = [_qdq(x, scale, qmax)]
+    return outs
+
+
+@register("moving_average_abs_max_scale",
+          no_grad_slots=("InAccum", "InState"),
+          custom_grad_maker=_ste_grad_maker)
+def _moving_avg_scale_observer(ctx, ins, attrs):
+    """Scale observer only — Out passes X through unchanged
+    (moving_average_abs_max_scale op, used by OutScaleForTraining)."""
+    x = ins["X"][0]
+    rate = float(attrs.get("moving_rate", 0.9))
+    outs = {"Out": [x]}
+    if not attrs.get("is_test"):
+        cur = jnp.max(jnp.abs(x))
+        state = ins.get("InState", [jnp.ones(())])[0].reshape(())
+        accum = ins.get("InAccum", [jnp.zeros(())])[0].reshape(())
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        outs["OutScale"] = [new_accum / new_state]
+        outs["OutState"] = [new_state]
+        outs["OutAccum"] = [new_accum]
+    return outs

@@ -1,0 +1,3 @@
+"""Model compression toolkit (contrib/slim analog)."""
+
+from . import quantization

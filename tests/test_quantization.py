@@ -1,0 +1,228 @@
+"""Quantization: fake-quant ops, QAT transform, freeze, PTQ.
+
+Parity: operators/fake_quantize_op.cc, contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass:174, FreezePass),
+post_training_quantization.py. STE grads are checked at the program
+level (numerical grads of round() are meaningless).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.layers as L
+from paddle_tpu.dygraph.tape import run_op
+from paddle_tpu.dygraph.tensor import Tensor
+from paddle_tpu.framework import (Executor, Program, Scope,
+                                  append_backward, program_guard,
+                                  unique_name)
+from paddle_tpu.slim.quantization import (PostTrainingQuantization,
+                                          convert, quant_aware)
+
+
+def _run(op, ins, attrs):
+    tin = {k: [Tensor(np.asarray(v)) for v in vs] for k, vs in ins.items()}
+    return {k: [np.asarray(t.numpy()) for t in ts]
+            for k, ts in run_op(op, tin, attrs).items()}
+
+
+def _qdq_np(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    scale = max(scale, 1e-8)
+    return np.round(np.clip(x / scale, -1, 1) * qmax) / qmax * scale
+
+
+def test_fake_qdq_abs_max_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 6) * 3).astype(np.float32)
+    out = _run("fake_quantize_dequantize_abs_max", {"X": [x]},
+               {"bit_length": 8})
+    scale = float(np.abs(x).max())
+    np.testing.assert_allclose(out["OutScale"][0], scale, rtol=1e-6)
+    np.testing.assert_allclose(out["Out"][0], _qdq_np(x, scale),
+                               rtol=1e-5, atol=1e-6)
+    # 8-bit grid: max abs error bounded by scale/254 per element
+    assert np.abs(out["Out"][0] - x).max() <= scale / 254 + 1e-6
+
+
+def test_fake_qdq_channel_wise():
+    rng = np.random.RandomState(1)
+    w = (rng.randn(3, 5) * np.array([[1.0], [10.0], [0.1]])
+         ).astype(np.float32)
+    out = _run("fake_channel_wise_quantize_dequantize_abs_max",
+               {"X": [w]}, {"bit_length": 8, "quant_axis": 0})
+    scales = np.abs(w).max(axis=1)
+    np.testing.assert_allclose(out["OutScale"][0], scales, rtol=1e-6)
+    for c in range(3):
+        np.testing.assert_allclose(out["Out"][0][c],
+                                   _qdq_np(w[c], scales[c]),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_moving_average_state_update_and_test_mode():
+    x = np.full((2, 2), 4.0, np.float32)
+    ins = {"X": [x], "InScale": [np.float32(2.0)],
+           "InState": [np.float32(1.0)], "InAccum": [np.float32(2.0)]}
+    out = _run("fake_quantize_dequantize_moving_average_abs_max", ins,
+               {"bit_length": 8, "moving_rate": 0.9, "is_test": False})
+    # state = .9*1+1 = 1.9; accum = .9*2+4 = 5.8; scale = 5.8/1.9
+    np.testing.assert_allclose(out["OutState"][0], 1.9, rtol=1e-6)
+    np.testing.assert_allclose(out["OutAccum"][0], 5.8, rtol=1e-6)
+    np.testing.assert_allclose(out["OutScale"][0], 5.8 / 1.9, rtol=1e-6)
+    np.testing.assert_allclose(out["Out"][0],
+                               _qdq_np(x, 5.8 / 1.9), rtol=1e-5)
+    # is_test: frozen scale, no state outputs
+    out_t = _run("fake_quantize_dequantize_moving_average_abs_max", ins,
+                 {"bit_length": 8, "is_test": True})
+    assert "OutState" not in out_t
+    np.testing.assert_allclose(out_t["Out"][0], _qdq_np(x, 2.0),
+                               rtol=1e-5)
+
+
+def test_ste_gradient_passes_through():
+    """d(qdq(x))/dx == 1 at the program level (STE)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [4])
+        x.stop_gradient = False
+        blk = main.global_block()
+        blk.create_var("q", stop_gradient=False)
+        blk.create_var("qs")
+        blk.append_op("fake_quantize_dequantize_abs_max", {"X": ["x"]},
+                      {"Out": ["q"], "OutScale": ["qs"]},
+                      {"bit_length": 8})
+        q = blk.var("q")
+        loss = L.reduce_sum(q)
+        append_backward(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+    (gx,) = exe.run(main, feed={"x": xv}, fetch_list=["x@GRAD"],
+                    scope=scope)
+    np.testing.assert_allclose(np.asarray(gx), np.ones_like(xv))
+
+
+def _build_mlp(seed=7):
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [8])
+        y = L.data("y", [1])
+        h = L.fc(x, 16, act="relu")
+        pred = L.fc(h, 1)
+        loss = L.reduce_mean(L.square(L.elementwise_sub(pred, y)))
+    return main, startup, x, y, pred, loss
+
+
+def test_quant_aware_inserts_ops_and_trains():
+    main, startup, x, y, pred, loss = _build_mlp()
+    qprog = quant_aware(main, startup)
+    types = [op.type for op in qprog.global_block().ops]
+    # 2 fc layers -> 2 weight quants + 2 activation quants
+    assert types.count(
+        "fake_channel_wise_quantize_dequantize_abs_max") == 2
+    assert types.count(
+        "fake_quantize_dequantize_moving_average_abs_max") == 2
+    # original untouched
+    assert "fake_channel_wise_quantize_dequantize_abs_max" not in [
+        op.type for op in main.global_block().ops]
+
+    qloss = qprog.global_block().var(loss.name)
+    with program_guard(qprog, startup):
+        from paddle_tpu.optimizer import SGD
+        SGD(learning_rate=0.05).minimize(qloss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(3)
+    W = rng.randn(8, 1).astype(np.float32)
+    losses = []
+    for _ in range(80):
+        xb = rng.randn(16, 8).astype(np.float32)
+        (lv,) = exe.run(qprog, feed={"x": xb, "y": xb @ W},
+                        fetch_list=[loss.name], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+    # freeze: scales fixed, state ops in test mode, runs, and the
+    # learned scale map is reported
+    frozen, scales = convert(qprog, scope=scope)
+    assert scales and all(s > 0 for s in scales.values())
+    infer = frozen._prune([pred], keep_var_names=["x"])
+    xb = rng.randn(4, 8).astype(np.float32)
+    (p1,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred.name],
+                    scope=scope)
+    (p2,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred.name],
+                    scope=scope)
+    np.testing.assert_allclose(p1, p2)  # no state drift in test mode
+
+
+def test_post_training_quantization_close_to_float():
+    main, startup, x, y, pred, loss = _build_mlp(seed=11)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(4)
+    # "trained" float model = random init is fine for PTQ math
+    xb = rng.randn(16, 8).astype(np.float32)
+    (ref,) = exe.run(main._prune([pred], keep_var_names=["x"]),
+                     feed={"x": xb}, fetch_list=[pred.name], scope=scope)
+
+    ptq = PostTrainingQuantization(
+        exe, main._prune([pred], keep_var_names=["x"]), scope=scope)
+    for _ in range(4):
+        ptq.collect({"x": rng.randn(16, 8).astype(np.float32)})
+    qprog, scales = ptq.quantize()
+    assert scales
+    (got,) = exe.run(qprog, feed={"x": xb}, fetch_list=[pred.name],
+                     scope=scope)
+    # int8 simulation: close but not identical to float
+    err = np.abs(np.asarray(got) - np.asarray(ref)).max()
+    denom = np.abs(ref).max() + 1e-6
+    assert err / denom < 0.05, err / denom
+    assert err > 0  # actually quantized, not a no-op
+
+
+def test_quant_aware_pretrained_scope_flow():
+    """Fine-tune flow: weights already trained in a scope; scale vars
+    init directly there — startup is NOT re-run, weights survive."""
+    main, startup, x, y, pred, loss = _build_mlp(seed=13)
+    rng = np.random.RandomState(5)
+    W = rng.randn(8, 1).astype(np.float32)
+    # pretrain the float model (minimize BEFORE startup runs, the
+    # standard fluid order)
+    train = main.clone()
+    tloss = train.global_block().var(loss.name)
+    with program_guard(train, startup):
+        from paddle_tpu.optimizer import SGD
+        SGD(learning_rate=0.05).minimize(tloss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    for _ in range(60):
+        xb = rng.randn(16, 8).astype(np.float32)
+        exe.run(train, feed={"x": xb, "y": xb @ W},
+                fetch_list=[], scope=scope)
+    w_before = scope.get_numpy(
+        [n for n in scope.var_names() if n.endswith(".w_0")][0]).copy()
+
+    qprog = quant_aware(main, scope=scope)  # no startup touched
+    qloss = qprog.global_block().var(loss.name)
+    startup2 = Program()  # fresh: only the new optimizer state inits
+    with program_guard(qprog, startup2):
+        from paddle_tpu.optimizer import SGD
+        SGD(learning_rate=0.01).minimize(qloss)
+    exe.run(startup2, scope=scope)  # safe: touches no model weights
+    # weights in scope survived the quantization plumbing untouched
+    w_name = [n for n in scope.var_names() if n.endswith(".w_0")][0]
+    np.testing.assert_array_equal(w_before, scope.get_numpy(w_name))
+    # a few QAT steps let the moving-average activation scales warm up
+    # from their 1.0 init (clipping noise shrinks as they converge)
+    for _ in range(20):
+        xb = rng.randn(16, 8).astype(np.float32)
+        (lv,) = exe.run(qprog, feed={"x": xb, "y": xb @ W},
+                        fetch_list=[loss.name], scope=scope)
+    xb = rng.randn(16, 8).astype(np.float32)
+    (fl,) = exe.run(main, feed={"x": xb, "y": xb @ W},
+                    fetch_list=[loss.name], scope=scope)
+    (lv,) = exe.run(qprog, feed={"x": xb, "y": xb @ W},
+                    fetch_list=[loss.name], scope=scope)
+    # converged QAT tracks the float loss (pretrained weights intact +
+    # bounded int8 noise), instead of restarting from scratch (~8.0)
+    assert float(lv) < float(fl) + 0.3, (float(lv), float(fl))
