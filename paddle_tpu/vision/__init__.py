@@ -1,13 +1,17 @@
-"""paddle.vision parity: model zoo (+ transforms stub surface).
+"""paddle.vision parity: model zoo, transforms, datasets.
 
 Analog of python/paddle/vision/ — models power the ResNet-50 Fleet DP
 baseline config (BASELINE.json configs[1], mirroring
-fluid/tests dist_se_resnext.py-style workloads).
+fluid/tests dist_se_resnext.py-style workloads); transforms are
+numpy-HWC pipelines; datasets read local files (no downloads).
 """
 
+from . import datasets
 from . import models
+from . import transforms
 from .models import (LeNet, ResNet, resnet18, resnet34, resnet50,
                      resnet101, vgg11, vgg16, VGG)
 
-__all__ = ["models", "LeNet", "ResNet", "resnet18", "resnet34",
-           "resnet50", "resnet101", "VGG", "vgg11", "vgg16"]
+__all__ = ["datasets", "models", "transforms", "LeNet", "ResNet",
+           "resnet18", "resnet34", "resnet50", "resnet101", "VGG",
+           "vgg11", "vgg16"]

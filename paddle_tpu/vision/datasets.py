@@ -1,0 +1,186 @@
+"""Vision datasets — local-file readers (no downloads; zero egress).
+
+Analog of python/paddle/vision/datasets/ (mnist.py, cifar.py,
+folder.py). The reference downloads archives on demand; this
+environment has no egress, so every dataset takes explicit local paths
+and raises a clear error when they're missing. ``FakeData`` generates
+deterministic synthetic batches for tests/benchmarks (the reference's
+unittest stand-in pattern).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+
+class MNIST(Dataset):
+    """idx-ubyte MNIST reader (datasets/mnist.py). Pass the image and
+    label file paths (gz or raw). Yields (HW uint8 image, int label)."""
+
+    def __init__(self, image_path: str, label_path: str,
+                 transform: Optional[Callable] = None,
+                 backend: str = "numpy"):
+        for p in (image_path, label_path):
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} not found; download MNIST idx files and pass "
+                    f"their local paths (no network in this runtime)")
+        self.images = self._read_idx(image_path, expect_dims=3)
+        self.labels = self._read_idx(label_path, expect_dims=1)
+        if len(self.images) != len(self.labels):
+            raise ValueError("image/label count mismatch")
+        self.transform = transform
+
+    @staticmethod
+    def _read_idx(path: str, expect_dims: int) -> np.ndarray:
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            if ndim != expect_dims:
+                raise ValueError(f"{path}: expected {expect_dims}-d idx, "
+                                 f"got {ndim}-d")
+            shape = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(shape)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 python-pickle reader from the official tar.gz
+    (datasets/cifar.py). Yields (HWC uint8 image, int label)."""
+
+    _train_batches = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_batches = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 transform: Optional[Callable] = None):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; download the CIFAR archive and "
+                f"pass its local path (no network in this runtime)")
+        wanted = (self._train_batches if mode == "train"
+                  else self._test_batches)
+        images, labels = [], []
+        with tarfile.open(data_file) as tar:
+            for member in tar.getmembers():
+                base = os.path.basename(member.name)
+                if base not in wanted:
+                    continue
+                blob = pickle.load(tar.extractfile(member),
+                                   encoding="bytes")
+                images.append(np.asarray(blob[b"data"], np.uint8))
+                labels.extend(blob[self._label_key])
+        if not images:
+            raise ValueError(f"no {mode} batches inside {data_file}")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    _train_batches = ["train"]
+    _test_batches = ["test"]
+    _label_key = b"fine_labels"
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image folder (datasets/folder.py). Needs an
+    image decoder: uses PIL when available, else raises at init."""
+
+    IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+
+    def __init__(self, root: str,
+                 transform: Optional[Callable] = None):
+        if not os.path.isdir(root):
+            raise FileNotFoundError(root)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class subdirectories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(self.IMG_EXTENSIONS):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.transform = transform
+        self._pil = None
+        if not all(p.endswith(".npy") for p, _ in self.samples):
+            try:
+                from PIL import Image
+                self._pil = Image
+            except ImportError as e:
+                raise ImportError(
+                    "DatasetFolder with non-.npy images requires PIL; "
+                    "store .npy arrays instead on this runtime") from e
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            img = np.asarray(self._pil.open(path).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images for tests/benchmarks."""
+
+    def __init__(self, num_samples: int = 128,
+                 image_shape=(3, 32, 32), num_classes: int = 10,
+                 transform: Optional[Callable] = None, seed: int = 0):
+        self.num_samples = int(num_samples)
+        self.image_shape = tuple(image_shape)
+        self.num_classes = int(num_classes)
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randint(0, 256, self.image_shape).astype(np.uint8)
+        label = int(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+__all__ = ["Cifar10", "Cifar100", "DatasetFolder", "FakeData", "MNIST"]

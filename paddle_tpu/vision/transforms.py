@@ -1,0 +1,297 @@
+"""Vision transforms — numpy HWC pipeline.
+
+Analog of python/paddle/vision/transforms/transforms.py (Compose,
+Resize, crops, flips, Normalize, Permute, color ops). The reference
+backends onto cv2/PIL; these are pure-numpy equivalents (bilinear
+resize included) so the data pipeline has zero native-image
+dependencies. Convention matches the reference: transforms consume
+HWC uint8/float arrays; ``Permute`` converts to the CHW float32 the
+models eat.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Compose:
+    """Chain transforms (transforms.py:63)."""
+
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _size_pair(size) -> Tuple[int, int]:
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    h, w = size
+    return int(h), int(w)
+
+
+def _resize_bilinear(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    """HWC bilinear resize, align_corners=False convention."""
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+    y1 = np.clip(y0 + 1, 0, ih - 1)
+    x1 = np.clip(x0 + 1, 0, iw - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = img.astype(np.float32)
+    if im.ndim == 2:
+        im = im[..., None]
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if img.ndim == 2:
+        out = out[..., 0]
+    if np.issubdtype(img.dtype, np.integer):
+        out = np.clip(np.round(out), 0, 255).astype(img.dtype)
+    return out
+
+
+class Resize:
+    """Resize to (h, w) or shorter-side int (transforms.py:208)."""
+
+    def __init__(self, size, interpolation: str = "bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        if isinstance(self.size, numbers.Number):
+            ih, iw = img.shape[:2]
+            short = int(self.size)
+            if ih <= iw:
+                h, w = short, max(1, round(iw * short / ih))
+            else:
+                h, w = max(1, round(ih * short / iw)), short
+        else:
+            h, w = _size_pair(self.size)
+        if self.interpolation == "nearest":
+            ys = np.clip((np.arange(h) * img.shape[0] // h), 0,
+                         img.shape[0] - 1)
+            xs = np.clip((np.arange(w) * img.shape[1] // w), 0,
+                         img.shape[1] - 1)
+            return img[ys][:, xs]
+        return _resize_bilinear(img, h, w)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = _size_pair(size)
+
+    def __call__(self, img):
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = max(0, (ih - h) // 2)
+        left = max(0, (iw - w) // 2)
+        return img[top:top + h, left:left + w]
+
+
+class RandomCrop:
+    def __init__(self, size, pad_if_needed: bool = True):
+        self.size = _size_pair(size)
+        self.pad_if_needed = pad_if_needed
+
+    def __call__(self, img):
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        if self.pad_if_needed and (ih < h or iw < w):
+            ph, pw = max(0, h - ih), max(0, w - iw)
+            pad = [(ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2)]
+            pad += [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pad)
+            ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            raise ValueError(
+                f"image {(ih, iw)} smaller than crop {(h, w)}; pass "
+                f"pad_if_needed=True or Resize first")
+        top = np.random.randint(0, ih - h + 1)
+        left = np.random.randint(0, iw - w + 1)
+        return img[top:top + h, left:left + w]
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize (transforms.py:245)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        ih, iw = img.shape[:2]
+        area = ih * iw
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < h <= ih and 0 < w <= iw:
+                top = np.random.randint(0, ih - h + 1)
+                left = np.random.randint(0, iw - w + 1)
+                crop = img[top:top + h, left:left + w]
+                return _resize_bilinear(crop, *self.size)
+        return _resize_bilinear(CenterCrop(min(ih, iw))(img), *self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return img[:, ::-1].copy() if np.random.rand() < self.prob else img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return img[::-1].copy() if np.random.rand() < self.prob else img
+
+
+class Normalize:
+    """(x - mean) / std per channel (transforms.py:475).
+    ``data_format`` says where the channel axis lives: 'CHW' (the
+    reference default — use AFTER Permute) or 'HWC' (before)."""
+
+    def __init__(self, mean, std, data_format: str = "CHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        if data_format not in ("CHW", "HWC"):
+            raise ValueError(f"data_format must be CHW or HWC, "
+                             f"got {data_format!r}")
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        mean, std = self.mean, self.std
+        if self.data_format == "CHW" and img.ndim == 3:
+            mean = mean.reshape(-1, 1, 1)
+            std = std.reshape(-1, 1, 1)
+        return (img - mean) / std
+
+
+class Permute:
+    """HWC -> CHW float32 (transforms.py:517); the model-facing end of
+    every pipeline."""
+
+    def __init__(self, to_rgb: bool = False):
+        self.to_rgb = to_rgb
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[..., None]
+        if self.to_rgb:
+            img = img[..., ::-1]
+        return np.ascontiguousarray(img.transpose(2, 0, 1))
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4  # left, top, right, bottom
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        left, top, right, bottom = self.padding
+        pad = [(top, bottom), (left, right)] + [(0, 0)] * (img.ndim - 2)
+        return np.pad(img, pad, constant_values=self.fill)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels: int = 1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        if img.ndim == 2:
+            g = img.astype(np.float32)
+        else:
+            g = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+                 + 0.114 * img[..., 2])
+        g = (np.clip(np.round(g), 0, 255).astype(img.dtype)
+             if np.issubdtype(img.dtype, np.integer)
+             else g.astype(img.dtype))
+        return np.repeat(g[..., None], self.num_output_channels, -1)
+
+
+def _blend(a, b, factor, dtype):
+    out = a.astype(np.float32) * factor + b * (1.0 - factor)
+    if np.issubdtype(dtype, np.integer):
+        return np.clip(np.round(out), 0, 255).astype(dtype)
+    return out.astype(dtype)
+
+
+class BrightnessTransform:
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return _blend(img, 0.0, factor, img.dtype)
+
+
+class ContrastTransform:
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = float(np.mean(Grayscale()(img)[..., 0]))
+        return _blend(img, mean, factor, img.dtype)
+
+
+class SaturationTransform:
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = Grayscale(3)(img) if img.ndim == 3 else img
+        return _blend(img, gray.astype(np.float32), factor, img.dtype)
+
+
+class ColorJitter:
+    """brightness/contrast/saturation jitter in random order
+    (transforms.py:759; hue needs HSV conversion and is rarely load-
+    bearing — apply SaturationTransform twice for a crude analog)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        self.ts: List = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+
+    def __call__(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+__all__ = [
+    "BrightnessTransform", "CenterCrop", "ColorJitter", "Compose",
+    "ContrastTransform", "Grayscale", "Normalize", "Pad", "Permute",
+    "RandomCrop", "RandomHorizontalFlip", "RandomResizedCrop",
+    "RandomVerticalFlip", "Resize", "SaturationTransform",
+]

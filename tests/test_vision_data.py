@@ -1,0 +1,160 @@
+"""Vision transforms + datasets.
+
+Parity: python/paddle/vision/transforms/transforms.py, datasets/
+(mnist.py idx format, cifar.py pickle format, folder.py, FakeData).
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import datasets, transforms as T
+
+
+def test_resize_bilinear_and_shorter_side():
+    img = np.arange(16, dtype=np.uint8).reshape(4, 4)
+    out = T.Resize((2, 2))(img)
+    assert out.shape == (2, 2)
+    # constant image stays constant under bilinear
+    const = np.full((5, 7, 3), 9, np.uint8)
+    out2 = T.Resize((3, 4))(const)
+    assert out2.shape == (3, 4, 3) and (out2 == 9).all()
+    # shorter-side int keeps aspect
+    tall = np.zeros((40, 20, 3), np.uint8)
+    assert T.Resize(10)(tall).shape == (20, 10, 3)
+
+
+def test_crops_flips_pad_gray():
+    img = np.arange(5 * 6 * 3, dtype=np.uint8).reshape(5, 6, 3)
+    c = T.CenterCrop((3, 2))(img)
+    np.testing.assert_array_equal(c, img[1:4, 2:4])
+    np.random.seed(0)
+    rc = T.RandomCrop((3, 3))(img)
+    assert rc.shape == (3, 3, 3)
+    f = T.RandomHorizontalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(f, img[:, ::-1])
+    v = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(v, img[::-1])
+    p = T.Pad(1, fill=7)(img)
+    assert p.shape == (7, 8, 3) and p[0, 0, 0] == 7
+    g = T.Grayscale(3)(img)
+    assert g.shape == (5, 6, 3)
+    assert (g[..., 0] == g[..., 1]).all()
+
+
+def test_normalize_permute_pipeline():
+    img = np.full((4, 4, 3), 128, np.uint8)
+    pipe = T.Compose([
+        T.Normalize(mean=[128.0] * 3, std=[64.0] * 3, data_format="HWC"),
+        T.Permute(),
+    ])
+    out = pipe(img)
+    assert out.shape == (3, 4, 4) and out.dtype == np.float32
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_color_jitter_bounds():
+    np.random.seed(1)
+    img = np.random.randint(0, 256, (8, 8, 3)).astype(np.uint8)
+    out = T.ColorJitter(brightness=0.3, contrast=0.3,
+                        saturation=0.3)(img)
+    assert out.shape == img.shape and out.dtype == np.uint8
+
+
+def _write_idx(path, arr):
+    ndim = arr.ndim
+    magic = 2048 + ndim  # 0x08 ubyte type code << 8 | ndim
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", magic))
+        f.write(struct.pack(f">{ndim}I", *arr.shape))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_idx_reader(tmp_path):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, 10).astype(np.uint8)
+    _write_idx(tmp_path / "img.gz", images)
+    _write_idx(tmp_path / "lbl.gz", labels)
+    ds = datasets.MNIST(str(tmp_path / "img.gz"), str(tmp_path / "lbl.gz"),
+                        transform=T.Compose([T.Normalize([127.5], [127.5],
+                                                         data_format="HWC"),
+                                             T.Permute()]))
+    assert len(ds) == 10
+    img, label = ds[3]
+    assert img.shape == (1, 28, 28) and img.dtype == np.float32
+    assert label == int(labels[3])
+    with pytest.raises(FileNotFoundError, match="no network"):
+        datasets.MNIST(str(tmp_path / "nope"), str(tmp_path / "lbl.gz"))
+
+
+def test_cifar_tar_reader(tmp_path):
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 256, (20, 3072)).astype(np.uint8)
+    blob = {b"data": data, b"labels": list(range(10)) * 2}
+    tar_path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tar:
+        import io
+        for name in ("data_batch_1", "test_batch"):
+            raw = pickle.dumps(blob)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(raw)
+            tar.addfile(info, io.BytesIO(raw))
+    ds = datasets.Cifar10(str(tar_path), mode="train")
+    assert len(ds) == 20
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3) and 0 <= label < 10
+    np.testing.assert_array_equal(
+        img, data[0].reshape(3, 32, 32).transpose(1, 2, 0))
+
+
+def test_dataset_folder_npy(tmp_path):
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            np.save(tmp_path / cls / f"{i}.npy",
+                    np.zeros((4, 4, 3), np.uint8))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    img, label = ds[5]
+    assert img.shape == (4, 4, 3) and label == 1
+
+
+def test_fake_data_deterministic_and_loadable():
+    from paddle_tpu.io.dataloader import DataLoader
+    ds = datasets.FakeData(num_samples=16, image_shape=(1, 8, 8),
+                           num_classes=4, seed=7)
+    a1, l1 = ds[3]
+    a2, l2 = ds[3]
+    np.testing.assert_array_equal(a1, a2)
+    assert l1 == l2
+    loader = DataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert np.asarray(xb).shape == (4, 1, 8, 8)
+    assert np.asarray(yb).shape == (4,)
+
+
+def test_normalize_chw_default_matches_reference_order():
+    """Reference default: Normalize comes AFTER Permute (CHW)."""
+    img = np.zeros((4, 4, 3), np.uint8)
+    img[..., 1] = 100
+    pipe = T.Compose([T.Permute(),
+                      T.Normalize([0.0, 100.0, 0.0], [1.0, 50.0, 1.0])])
+    out = pipe(img)
+    assert out.shape == (3, 4, 4)
+    np.testing.assert_allclose(out[0], 0.0)
+    np.testing.assert_allclose(out[1], 0.0)  # (100-100)/50
+
+
+def test_random_crop_too_small_raises():
+    with pytest.raises(ValueError, match="smaller than crop"):
+        T.RandomCrop((32, 32), pad_if_needed=False)(
+            np.zeros((28, 28), np.uint8))

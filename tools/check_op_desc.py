@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Op-registry compat check: dump every registered op's grad contract.
+
+Analog of the reference's tools/check_op_desc.py (CI guard against
+incompatible op changes). Dumps op type -> differentiability + slot
+metadata as JSON; diff two dumps to catch silently-breaking registry
+changes.
+
+    python tools/check_op_desc.py > ops.json
+    python tools/check_op_desc.py --diff ops.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def dump_ops() -> dict:
+    from paddle_tpu.ops import registry as reg
+    out = {}
+    for name in reg.registered_ops():
+        d = reg.get_op_def(name)
+        out[name] = {
+            "not_differentiable": d.not_differentiable,
+            "no_grad_slots": sorted(d.no_grad_slots),
+            "nondiff_outputs": sorted(d.nondiff_outputs),
+            "grad_drops_inputs": sorted(d.grad_drops_inputs),
+            "grad_needs_outputs": sorted(d.grad_needs_outputs),
+            "custom_grad": d.custom_grad_maker is not None,
+        }
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("check_op_desc")
+    p.add_argument("--diff", help="baseline JSON to compare against")
+    args = p.parse_args(argv)
+    ops = dump_ops()
+    if not args.diff:
+        json.dump(ops, sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
+    with open(args.diff) as f:
+        base = json.load(f)
+    removed = sorted(set(base) - set(ops))
+    added = sorted(set(ops) - set(base))
+    changed = sorted(k for k in set(base) & set(ops)
+                     if base[k] != ops[k])
+    for kind, names in (("REMOVED", removed), ("CHANGED", changed)):
+        for n in names:
+            print(f"{kind}: {n}")
+    for n in added:
+        print(f"added: {n}")
+    if removed or changed:
+        print(f"\nINCOMPATIBLE: {len(removed)} removed, "
+              f"{len(changed)} changed (additions are fine)")
+        return 1
+    print(f"OK: {len(ops)} ops, {len(added)} new")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
