@@ -93,6 +93,27 @@ def _unpack_array(buf: bytes, off: int) -> Tuple[np.ndarray, int]:
     return a, off + n
 
 
+def _pack_rows(rows: Dict[str, np.ndarray]) -> bytes:
+    """'<q count, then (str key, array)*' — the ONE encoding of a table
+    snapshot, shared by client state/load and server dispatch."""
+    out = [struct.pack("<q", len(rows))]
+    for k, v in rows.items():
+        out.append(_pack_str(k))
+        out.append(_pack_array(np.asarray(v, np.float32)))
+    return b"".join(out)
+
+
+def _unpack_rows(buf: bytes, off: int = 0) -> Dict[str, np.ndarray]:
+    (n,) = struct.unpack_from("<q", buf, off)
+    off += 8
+    rows = {}
+    for _ in range(n):
+        k, off = _unpack_str(buf, off)
+        v, off = _unpack_array(buf, off)
+        rows[k] = v
+    return rows
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: "PSServer" = self.server.ps_server  # type: ignore
@@ -154,8 +175,12 @@ class PSServer:
 
     def run(self):
         """Blocking serve loop (fleet.run_server: listen_and_serv
-        RunImpl)."""
-        self._tcp.serve_forever()
+        RunImpl). If start() already serves in a background thread,
+        park on it instead (shutdown unblocks the join)."""
+        if self._thread is not None:
+            self._thread.join()
+        else:
+            self._tcp.serve_forever()
 
     def stop(self):
         self._tcp.shutdown()
@@ -195,22 +220,10 @@ class PSServer:
             return struct.pack("<q", self._table(name).size())
         if op == OP_STATE:
             name, _ = _unpack_str(payload, 0)
-            state = self._table(name).state()
-            out = [struct.pack("<q", len(state))]
-            for k, v in state.items():
-                out.append(_pack_str(k))
-                out.append(_pack_array(v))
-            return b"".join(out)
+            return _pack_rows(self._table(name).state())
         if op == OP_LOAD:
             name, off = _unpack_str(payload, 0)
-            (n,) = struct.unpack_from("<q", payload, off)
-            off += 8
-            rows = {}
-            for _ in range(n):
-                k, off = _unpack_str(payload, off)
-                v, off = _unpack_array(payload, off)
-                rows[k] = v
-            self._table(name).load_state(rows)
+            self._table(name).load_state(_unpack_rows(payload, off))
             return b""
         if op == OP_BARRIER:
             # blocking rendezvous: the handler thread parks on a condition
@@ -378,6 +391,28 @@ class PSClient:
                                  self._call(i, OP_SIZE, _pack_str(name)))
             total += n
         return total
+
+    def state(self, name: str) -> Dict[str, np.ndarray]:
+        """Full table snapshot gathered from every server (checkpoint
+        tier for remote tables; large_scale_kv Save analog).
+        Accumulator entries ride under ``a:<key>`` names."""
+        rows: Dict[str, np.ndarray] = {}
+        for i in range(len(self.endpoints)):
+            rows.update(_unpack_rows(
+                self._call(i, OP_STATE, _pack_str(name))))
+        return rows
+
+    def load(self, name: str, rows: Dict[str, np.ndarray]):
+        """Scatter a snapshot back, each row to its residue-class
+        server (large_scale_kv Load analog)."""
+        per_server: List[Dict[str, np.ndarray]] = [
+            {} for _ in self.endpoints]
+        for k, v in rows.items():
+            key = int(k[2:]) if k.startswith("a:") else int(k)
+            per_server[key % len(self.endpoints)][k] = v
+        for i, shard in enumerate(per_server):
+            self._call(i, OP_LOAD, _pack_str(name) + _pack_rows(shard))
+        return self
 
     def heartbeat(self, worker_id: int):
         """Announce liveness to every server (HeartBeatMonitor feed)."""

@@ -131,9 +131,14 @@ def init_server(fleet, *args, **kwargs):
     eps = fleet._role_maker.get_pserver_endpoints()
     if not eps:
         return  # single-process backend: REGISTRY tables are local
-    from .rpc import PSServer
+    import os
+
+    from .native_server import make_server
     idx = getattr(fleet._role_maker, "_server_id", 0)
-    _server = PSServer(eps[idx], idx, len(eps))
+    # the C++ server (GIL-free data plane) unless explicitly disabled
+    prefer_native = os.environ.get("PADDLE_PS_NATIVE", "1") != "0"
+    _server = make_server(eps[idx], idx, len(eps),
+                          prefer_native=prefer_native)
 
 
 def run_server(fleet):

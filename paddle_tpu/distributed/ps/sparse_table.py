@@ -85,16 +85,27 @@ class SparseTable:
         return sum(len(s) for s in self._shards)
 
     def state(self):
-        """Serializable snapshot (checkpoint tier)."""
+        """Serializable snapshot (checkpoint tier). Optimizer
+        accumulators ride under ``a:<key>`` entries so a restored
+        adagrad table keeps its decayed step sizes (losing them makes
+        the first post-restore updates ~lr instead of lr/sqrt(acc))."""
         rows = {}
         for s in self._shards:
             rows.update({str(k): v for k, v in s.items()})
+        for s in self._accum:
+            rows.update({f"a:{k}": v for k, v in s.items()})
         return rows
 
     def load_state(self, rows: Dict[str, np.ndarray]):
         for k, v in rows.items():
-            key = int(k)
-            self._shards[self._shard(key)][key] = np.asarray(v, np.float32)
+            if k.startswith("a:"):
+                key = int(k[2:])
+                self._accum[self._shard(key)][key] = \
+                    np.asarray(v, np.float32)
+            else:
+                key = int(k)
+                self._shards[self._shard(key)][key] = \
+                    np.asarray(v, np.float32)
 
 
 class TableRegistry:

@@ -34,7 +34,7 @@ def build_and_load(name: str) -> Optional[ctypes.CDLL]:
                     or os.path.getmtime(so) < os.path.getmtime(src)):
                 subprocess.run(
                     ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     src, "-o", so],
+                     "-pthread", src, "-o", so],
                     check=True, capture_output=True, timeout=120)
             lib = ctypes.CDLL(so)
         except (OSError, subprocess.SubprocessError):
