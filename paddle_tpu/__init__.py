@@ -48,6 +48,7 @@ from . import inference
 from . import enforce
 from . import trainer_desc
 from . import slim
+from . import text
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

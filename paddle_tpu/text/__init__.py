@@ -1,0 +1,12 @@
+"""paddle.text parity: NLP datasets + vocab utilities.
+
+Analog of python/paddle/text/ (datasets/imdb.py, imikolov.py,
+uci_housing.py, ...). Local-file readers only — this runtime has no
+egress, so every dataset takes explicit paths and errors clearly when
+they're missing.
+"""
+
+from . import datasets
+from .datasets import Imdb, Imikolov, UCIHousing, Vocab
+
+__all__ = ["datasets", "Imdb", "Imikolov", "UCIHousing", "Vocab"]

@@ -1,0 +1,191 @@
+"""Text datasets — local-file readers (no downloads).
+
+Analog of python/paddle/text/datasets/: Imdb (aclImdb tar, word-dict
+with frequency cutoff, imdb.py:33), Imikolov (PTB-style n-gram/seq
+corpus, imikolov.py), UCIHousing (numeric table, uci_housing.py).
+Each yields numpy-ready samples through the common io Dataset
+interface so DataLoader/hapi consume them directly.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import string
+import tarfile
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+
+def _require(path: str, what: str):
+    if not path or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what} file {path!r} not found; download it and pass the "
+            f"local path (no network in this runtime)")
+
+
+class Vocab:
+    """token -> id map with <unk> (imdb.py _build_work_dict analog:
+    frequency-sorted, cutoff drops rare words)."""
+
+    def __init__(self, counter: Counter, cutoff: int = 0):
+        kept = [(w, c) for w, c in counter.items() if c > cutoff]
+        # sort by (-freq, word) for a stable, reference-like ordering
+        kept.sort(key=lambda wc: (-wc[1], wc[0]))
+        self.word_idx: Dict[str, int] = {
+            w: i for i, (w, _) in enumerate(kept)}
+        # corpora like PTB contain a literal '<unk>' token; reuse its
+        # id instead of appending a duplicate entry
+        if "<unk>" not in self.word_idx:
+            self.word_idx["<unk>"] = len(self.word_idx)
+
+    def __len__(self):
+        return len(self.word_idx)
+
+    def __getitem__(self, word: str) -> int:
+        return self.word_idx.get(word, self.word_idx["<unk>"])
+
+    def to_ids(self, tokens: Sequence[str]) -> np.ndarray:
+        return np.asarray([self[t] for t in tokens], np.int64)
+
+
+_WORD_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+def _tokenize(text: str) -> List[str]:
+    return _WORD_RE.findall(text.lower())
+
+
+class Imdb(Dataset):
+    """IMDB sentiment from the aclImdb tar (text/datasets/imdb.py:33).
+    Yields (int64 id sequence, label 0/1); pos label 1."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 cutoff: int = 150,
+                 vocab: Optional[Vocab] = None):
+        _require(data_file, "aclImdb tar")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # the word dict always comes from the TRAIN split (imdb.py:89
+        # builds word_idx from train regardless of mode) so train/test
+        # id mappings agree without the caller passing vocab through
+        train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        texts: List[List[str]] = []
+        labels: List[int] = []
+        counter: Counter = Counter()
+        with tarfile.open(data_file) as tar:
+            for member in tar.getmembers():
+                m = pat.match(member.name)
+                is_train = vocab is None and train_pat.match(member.name)
+                if not m and not is_train:
+                    continue
+                toks = _tokenize(
+                    tar.extractfile(member).read().decode("utf-8",
+                                                          "ignore"))
+                if is_train:
+                    counter.update(toks)
+                if m:
+                    texts.append(toks)
+                    labels.append(1 if m.group(1) == "pos" else 0)
+        if not texts:
+            raise ValueError(f"no {mode} reviews inside {data_file}")
+        self.vocab = vocab or Vocab(counter, cutoff)
+        self.docs = [self.vocab.to_ids(t) for t in texts]
+        self.labels = np.asarray(labels, np.int64)
+
+    @property
+    def word_idx(self):
+        return self.vocab.word_idx
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB-style corpus as n-grams or sequences
+    (text/datasets/imikolov.py). data_type 'NGRAM' yields fixed
+    windows [w0..w{n-1}] (inputs + next-word target in one array);
+    'SEQ' yields (<s> ... sentence, sentence ... <e>) pairs."""
+
+    def __init__(self, data_file: str, data_type: str = "NGRAM",
+                 window_size: int = 5, min_word_freq: int = 50,
+                 vocab: Optional[Vocab] = None):
+        _require(data_file, "corpus")
+        with open(data_file, encoding="utf-8") as f:
+            lines = [line.split() for line in f]
+        counter: Counter = Counter()
+        for toks in lines:
+            counter.update(toks)
+        if vocab is None:
+            # reference keeps words with freq >= min_word_freq
+            kept = {w: c for w, c in counter.items()
+                    if c >= min_word_freq}
+            vocab = Vocab(Counter(kept), 0)
+        self.vocab = vocab
+        self.data_type = data_type.upper()
+        self.window_size = int(window_size)
+        # <s>/<e> markers like the reference reader
+        s_id = len(vocab.word_idx)
+        e_id = s_id + 1
+        self._s, self._e = s_id, e_id
+        self.samples: List[np.ndarray] = []
+        for toks in lines:
+            ids = [s_id] + [vocab[t] for t in toks] + [e_id]
+            if self.data_type == "NGRAM":
+                n = self.window_size
+                for i in range(len(ids) - n + 1):
+                    self.samples.append(np.asarray(ids[i:i + n],
+                                                   np.int64))
+            elif self.data_type == "SEQ":
+                self.samples.append(np.asarray(ids, np.int64))
+            else:
+                raise ValueError("data_type must be NGRAM or SEQ")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        if self.data_type == "SEQ":
+            ids = self.samples[idx]
+            return ids[:-1], ids[1:]
+        return self.samples[idx]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression table
+    (text/datasets/uci_housing.py): 13 features + target, whitespace
+    file; features normalized to [0,1] by column max/min like the
+    reference, 80/20 train/test split."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: str, mode: str = "train"):
+        _require(data_file, "housing data")
+        raw = np.loadtxt(data_file, ndmin=2).astype(np.float32)
+        if raw.shape[1] != self.FEATURE_DIM + 1:
+            raise ValueError(
+                f"expected {self.FEATURE_DIM + 1} columns, got "
+                f"{raw.shape[1]}")
+        feats = raw[:, :-1]
+        lo, hi = feats.min(0), feats.max(0)
+        span = np.where(hi > lo, hi - lo, 1.0)
+        feats = (feats - lo) / span
+        split = int(len(raw) * 0.8)
+        sl = slice(0, split) if mode == "train" else slice(split, None)
+        self.x = feats[sl]
+        self.y = raw[sl, -1:]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Vocab"]

@@ -1,0 +1,151 @@
+"""paddle.text datasets: Imdb tar reader, Imikolov n-grams, UCIHousing.
+
+Parity: python/paddle/text/datasets/{imdb.py:33, imikolov.py,
+uci_housing.py}.
+"""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import Imdb, Imikolov, UCIHousing, Vocab
+
+
+def _make_imdb_tar(path):
+    reviews = {
+        "aclImdb/train/pos/0_9.txt": b"a great great movie truly great",
+        "aclImdb/train/pos/1_8.txt": b"wonderful acting and a great plot",
+        "aclImdb/train/neg/0_2.txt": b"terrible movie truly awful",
+        "aclImdb/train/neg/1_3.txt": b"awful acting awful plot",
+        "aclImdb/test/pos/0_9.txt": b"great fun",
+    }
+    with tarfile.open(path, "w:gz") as tar:
+        for name, data in reviews.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+
+
+def test_imdb_reader_and_vocab(tmp_path):
+    tar = tmp_path / "aclImdb_v1.tar.gz"
+    _make_imdb_tar(tar)
+    ds = Imdb(str(tar), mode="train", cutoff=0)
+    assert len(ds) == 4
+    labels = sorted(int(ds[i][1]) for i in range(4))
+    assert labels == [0, 0, 1, 1]
+    # most frequent word gets the smallest id
+    freqs = {"great": 4, "awful": 3}
+    assert ds.word_idx["great"] < ds.word_idx["awful"]
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and doc.ndim == 1
+    # unknown words map to <unk>
+    assert ds.vocab["zzzzz"] == ds.word_idx["<unk>"]
+    # test split shares the train vocab when passed through
+    test = Imdb(str(tar), mode="test", vocab=ds.vocab)
+    assert len(test) == 1 and test.vocab is ds.vocab
+    with pytest.raises(FileNotFoundError, match="no network"):
+        Imdb(str(tmp_path / "missing.tar"))
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    corpus = tmp_path / "ptb.train.txt"
+    corpus.write_text("the cat sat\nthe dog sat on the mat\n")
+    ds = Imikolov(str(corpus), data_type="NGRAM", window_size=3,
+                  min_word_freq=1)
+    # line1: 5 tokens incl <s>/<e> -> 3 trigrams; line2: 8 -> 6
+    assert len(ds) == 9
+    g = ds[0]
+    assert g.shape == (3,) and g.dtype == np.int64
+    assert g[0] == ds._s  # first window starts at <s>
+
+    seq = Imikolov(str(corpus), data_type="SEQ", min_word_freq=1)
+    x, y = seq[0]
+    np.testing.assert_array_equal(x[1:], y[:-1])  # shifted pair
+    assert x[0] == seq._s and y[-1] == seq._e
+
+
+def test_uci_housing_normalization_and_split(tmp_path):
+    rng = np.random.RandomState(0)
+    table = np.hstack([rng.rand(50, 13) * 100,
+                       rng.rand(50, 1) * 50])
+    f = tmp_path / "housing.data"
+    np.savetxt(f, table)
+    train = UCIHousing(str(f), mode="train")
+    test = UCIHousing(str(f), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # normalized features within [0,1] across the whole table
+    allx = np.vstack([train.x, test.x])
+    assert allx.min() >= 0.0 and allx.max() <= 1.0 + 1e-6
+
+
+def test_text_trains_bow_classifier(tmp_path):
+    """End-to-end: Imdb -> bag-of-words -> static logistic regression
+    learns to separate pos/neg."""
+    import paddle_tpu.layers as L
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+    from paddle_tpu.optimizer import SGD
+
+    tar = tmp_path / "imdb.tar.gz"
+    _make_imdb_tar(tar)
+    ds = Imdb(str(tar), cutoff=0)
+    V = len(ds.vocab)
+    X = np.zeros((len(ds), V), np.float32)
+    Y = np.zeros((len(ds), 1), np.float32)
+    for i in range(len(ds)):
+        doc, label = ds[i]
+        np.add.at(X[i], doc, 1.0)
+        Y[i] = label
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 1
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [V])
+        y = L.data("y", [1])
+        logit = L.fc(x, 1)
+        loss = L.reduce_mean(
+            L.sigmoid_cross_entropy_with_logits(logit, y))
+        SGD(learning_rate=0.5).minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y},
+                        fetch_list=[loss.name], scope=scope)
+    assert float(lv) < 0.1
+
+
+def test_vocab_literal_unk_token_no_collision():
+    """A corpus containing '<unk>' literally (PTB) must not create a
+    duplicate entry or collide with Imikolov's sentence markers."""
+    v = Vocab(__import__("collections").Counter(
+        {"the": 5, "<unk>": 3, "cat": 2}))
+    assert len(set(v.word_idx.values())) == len(v.word_idx) == 3
+    assert v["zzz"] == v.word_idx["<unk>"]
+
+
+def test_imikolov_ptb_unk_disjoint_from_markers(tmp_path):
+    corpus = tmp_path / "ptb.txt"
+    corpus.write_text("the <unk> sat\nthe <unk> ran\n")
+    ds = Imikolov(str(corpus), data_type="SEQ", min_word_freq=1)
+    assert ds.vocab["<unk>"] not in (ds._s, ds._e)
+
+
+def test_imdb_test_mode_uses_train_vocab(tmp_path):
+    tar = tmp_path / "imdb.tar.gz"
+    _make_imdb_tar(tar)
+    train = Imdb(str(tar), mode="train", cutoff=0)
+    test = Imdb(str(tar), mode="test", cutoff=0)  # no vocab passed
+    assert test.word_idx == train.word_idx
+    doc, _ = test[0]  # "great fun": 'great' shares the train id
+    assert train.word_idx["great"] in doc
+
+
+def test_uci_housing_single_row_clear_error(tmp_path):
+    f = tmp_path / "one.data"
+    f.write_text(" ".join(["1.0"] * 5) + "\n")
+    with pytest.raises(ValueError, match="columns"):
+        UCIHousing(str(f))
