@@ -49,6 +49,9 @@ from . import enforce
 from . import trainer_desc
 from . import slim
 from . import text
+from . import static
+from . import utils
+from .hapi.summary import summary
 from .tensor_api import *  # noqa: F401,F403
 from . import tensor_api as tensor
 

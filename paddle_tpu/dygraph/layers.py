@@ -96,6 +96,8 @@ class Layer:
 
     # -- traversal ---------------------------------------------------------
     def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        if not include_sublayers:
+            return list(self._parameters.values())
         return [p for _, p in self.named_parameters()]
 
     def named_parameters(self, prefix: str = "") -> Iterator[

@@ -4,6 +4,7 @@ Analog of python/paddle/hapi/ (model.py:788 Model, fit:1243, callbacks).
 """
 
 from .model import Model
+from .summary import summary
 from .callbacks import Callback, ProgBarLogger
 
-__all__ = ["Model", "Callback", "ProgBarLogger"]
+__all__ = ["Callback", "Model", "ProgBarLogger", "summary"]

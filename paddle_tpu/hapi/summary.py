@@ -1,0 +1,67 @@
+"""Model summary — per-layer output shapes + parameter counts.
+
+Analog of python/paddle/hapi/model_summary.py (paddle.summary): hook
+every sublayer, run one forward on zeros, tabulate layer type, output
+shape, and parameter count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def summary(net, input_size: Sequence[int], dtypes: str = "float32",
+            verbose: bool = True) -> Dict[str, int]:
+    """paddle.summary parity: ``input_size`` includes the batch dim
+    (use 1 or -1 for a free batch). Returns {'total_params': n,
+    'trainable_params': n}."""
+    import paddle_tpu as pt
+
+    shape = [1 if d in (-1, None) else int(d) for d in input_size]
+    rows: List[tuple] = []
+    hooks = []
+
+    def make_hook(layer, name):
+        def hook(lyr, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) \
+                else output
+            oshape = tuple(getattr(out, "shape", ()) or ())
+            n_params = sum(
+                int(np.prod(p.value.shape)) if p.value.shape else 1
+                for p in lyr.parameters(include_sublayers=False))
+            rows.append((name or lyr.full_name(),
+                         type(lyr).__name__, oshape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        handle = layer.register_forward_post_hook(
+            make_hook(layer, name))
+        hooks.append((layer, handle))
+    try:
+        x = pt.to_tensor(np.zeros(shape, dtypes))
+        net(x)
+    finally:
+        for layer, handle in hooks:
+            layer._forward_post_hooks.pop(handle, None)
+
+    total = 0
+    trainable = 0
+    for p in net.parameters():
+        n = int(np.prod(p.value.shape)) if p.value.shape else 1
+        total += n
+        if not getattr(p, "stop_gradient", False):
+            trainable += n
+    if verbose:
+        header = (f"{'Layer (type)':<36}{'Output Shape':<24}"
+                  f"{'Param #':>10}")
+        print(header)
+        print("-" * len(header))
+        for name, kind, oshape, n_params in rows:
+            print(f"{name + ' (' + kind + ')':<36}"
+                  f"{str(list(oshape)):<24}{n_params:>10}")
+        print("-" * len(header))
+        print(f"Total params: {total}")
+        print(f"Trainable params: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -247,12 +247,18 @@ def to_static_multi_step(fn, *, layers, optimizers=None,
 
 
 class InputSpec:
-    """Shape/dtype spec for jit.save tracing (paddle.static.InputSpec)."""
+    """Shape/dtype spec for jit.save tracing — the ONE InputSpec class,
+    re-exported as paddle.static.InputSpec (they are the same class in
+    the reference too). None dims normalize to -1."""
 
     def __init__(self, shape, dtype="float32", name=None):
-        self.shape = tuple(shape)
+        self.shape = [-1 if d is None else int(d) for d in shape]
         self.dtype = dtype
         self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
 
 
 class TranslatedLayer:

@@ -1,0 +1,48 @@
+"""paddle.utils parity: install check, deprecation, lazy imports.
+
+Analog of python/paddle/utils/ (install_check.py run_check,
+deprecated.py, lazy import helpers).
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from .install_check import run_check
+
+
+def deprecated(update_to: str = "", since: str = "",
+               reason: str = ""):
+    """Warn-once decorator (utils/deprecated.py analog)."""
+    def deco(fn):
+        msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+    return deco
+
+
+def try_import(module_name: str):
+    """Import-or-explain (utils/lazy_import.py analog)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{module_name} is required for this feature but is not "
+            f"installed (no network in this runtime — it must be baked "
+            f"into the image)") from e
+
+
+__all__ = ["deprecated", "run_check", "try_import"]

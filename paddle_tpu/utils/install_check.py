@@ -1,0 +1,84 @@
+"""Install sanity check (utils/install_check.py run_check analog).
+
+``run_check()`` trains a 2-layer net for a few steps on the default
+device in BOTH execution modes (dygraph eager + static executor),
+verifies the loss decreases, and prints the device/backend summary —
+the "is my install functional" front door."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _check_static() -> float:
+    import paddle_tpu.layers as L
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+    from paddle_tpu.optimizer import SGD
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 2024
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [4])
+        y = L.data("y", [1])
+        h = L.fc(x, 8, act="relu")
+        loss = L.reduce_mean(L.square(L.elementwise_sub(L.fc(h, 1), y)))
+        SGD(learning_rate=0.1).minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    first = last = None
+    for _ in range(20):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                        fetch_list=[loss.name], scope=scope)
+        last = float(np.asarray(lv))
+        first = first if first is not None else last
+    if not last < first:
+        raise RuntimeError(
+            f"static-graph training did not converge ({first} -> {last})"
+            " — the install is broken")
+    return last
+
+
+def _check_dygraph() -> float:
+    import paddle_tpu as pt
+    from paddle_tpu.nn import Linear, MSELoss
+    from paddle_tpu.optimizer import SGD
+
+    net = Linear(4, 1)
+    opt = SGD(learning_rate=0.1, parameters=net.parameters())
+    lossfn = MSELoss()
+    rng = np.random.RandomState(1)
+    first = last = None
+    for _ in range(20):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        loss = lossfn(net(pt.to_tensor(xb)), pt.to_tensor(yb))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        last = float(np.asarray(loss.numpy()))
+        first = first if first is not None else last
+    if not last < first:
+        raise RuntimeError(
+            f"dygraph training did not converge ({first} -> {last})"
+            " — the install is broken")
+    return last
+
+
+def run_check(verbose: bool = True) -> bool:
+    """install_check.run_check parity: raise on a broken install,
+    return True and print the device summary on success."""
+    import jax
+    static_loss = _check_static()
+    dygraph_loss = _check_dygraph()
+    if verbose:
+        devs = jax.devices()
+        print(f"paddle_tpu is installed successfully! "
+              f"backend={jax.default_backend()} devices={len(devs)} "
+              f"({devs[0].device_kind if devs else 'none'}); "
+              f"static loss {static_loss:.4f}, "
+              f"dygraph loss {dygraph_loss:.4f}")
+    return True

@@ -1,0 +1,115 @@
+"""paddle.static namespace, paddle.utils, paddle.summary.
+
+Parity: python/paddle/static/__init__.py, utils/install_check.py
+run_check, utils/deprecated.py, hapi/model_summary.py.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.static as static
+
+
+def test_static_namespace_train_roundtrip(tmp_path):
+    """2.0-style static program: static.data takes FULL shapes."""
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = 5
+    with static.program_guard(main, startup):
+        from paddle_tpu.framework import unique_name
+        with unique_name.guard():
+            x = static.data("x", [None, 6])
+            assert tuple(x.shape) == (-1, 6)
+            pred = static.nn.fc(x, 3)
+    exe = static.Executor()
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[pred.name],
+                     scope=scope)
+    assert np.asarray(out).shape == (2, 3)
+    d = str(tmp_path / "m")
+    static.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+    prog2, feeds, fetches = static.load_inference_model(d, exe,
+                                                        scope=scope)
+    (out2,) = exe.run(prog2, feed={"x": xv}, fetch_list=fetches,
+                      scope=scope)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                               rtol=1e-6)
+    # quantization rides the static namespace (paddle.static.quantization)
+    assert hasattr(static.quantization, "QuantizationTransformPass")
+
+
+def test_input_spec():
+    spec = static.InputSpec([None, 3, 224, 224], "float32", name="img")
+    assert spec.shape == [-1, 3, 224, 224]
+    assert "img" in repr(spec)
+
+
+def test_run_check(capsys):
+    assert pt.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_deprecated_decorator():
+    @pt.utils.deprecated(update_to="pt.new_api", since="2.0")
+    def old_api():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api() == 42
+    assert any("deprecated" in str(x.message) for x in w)
+    assert "pt.new_api" in old_api.__deprecated_message__
+
+
+def test_try_import_error_message():
+    with pytest.raises(ImportError, match="not\ninstalled|not installed"):
+        pt.utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_summary_layers_and_params(capsys):
+    from paddle_tpu.vision import LeNet
+    info = pt.summary(LeNet(num_classes=10), (1, 1, 28, 28))
+    out = capsys.readouterr().out
+    assert "Total params" in out and "Conv2D" in out
+    # this LeNet: conv(1->6,3x3)+6 + conv(6->16,5x5)+16 +
+    # fc(400x120)+120 + fc(120x84)+84 + fc(84x10)+10
+    expect = (9 * 6 + 6) + (6 * 16 * 25 + 16) + (400 * 120 + 120) \
+        + (120 * 84 + 84) + (84 * 10 + 10)
+    assert info["total_params"] == expect
+    assert info["trainable_params"] == expect
+
+
+def test_input_spec_is_jit_input_spec_and_saves(tmp_path):
+    """static.InputSpec IS jit.InputSpec (one class), so jit.save
+    accepts it directly."""
+    from paddle_tpu import jit
+    from paddle_tpu.nn import Linear
+    assert static.InputSpec is jit.InputSpec
+    net = Linear(4, 2)
+    path = str(tmp_path / "lin")
+    jit.save(net, path, input_spec=[static.InputSpec([None, 4])])
+    loaded = jit.load(path)
+    xv = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    out = loaded(xv)
+    ref = net(pt.to_tensor(xv))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-5)
+
+
+def test_summary_containers_show_own_params_only(capsys):
+    """Container layers (Sequential) report 0 own params; the column
+    sums to the total (paddle.summary convention)."""
+    from paddle_tpu.vision import LeNet
+    info = pt.summary(LeNet(num_classes=10), (1, 1, 28, 28))
+    out = capsys.readouterr().out
+    col_sum = 0
+    for line in out.splitlines():
+        parts = line.rsplit(None, 1)
+        if len(parts) == 2 and parts[1].isdigit() and "(" in parts[0]:
+            col_sum += int(parts[1])
+    assert col_sum == info["total_params"]
