@@ -517,7 +517,7 @@ def _apply_localsgd(program: Program, params, nranks: int, k_steps: int):
     if ctx is not None:
         ctx.__enter__()
     try:
-        step = create_global_var([1], 0.0, "float32", persistable=True,
+        step = create_global_var([1], 0, "int64", persistable=True,
                                  name=unique_name.generate("lsgd_step"))
     finally:
         if ctx is not None:
@@ -600,7 +600,7 @@ def _apply_gradient_merge(program: Program, params_grads, k_steps: int,
         return params_grads
     from ...layers.tensor import create_global_var
     block = program.global_block()
-    step = create_global_var([1], 0.0, "float32", persistable=True,
+    step = create_global_var([1], 0, "int64", persistable=True,
                              name=unique_name.generate("gm_step"))
     gate_b = _emit_every_k_gate(block, step.name, k_steps, "backward")
     gate = block.create_var(unique_name.generate("gm_gate"),

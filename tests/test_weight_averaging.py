@@ -1,0 +1,180 @@
+"""EMA, ModelAverage, Lookahead optimizers.
+
+Parity: fluid optimizer.py:3416 ExponentialMovingAverage, :3107
+ModelAverage, :4828 LookaheadOptimizer. Each is checked against a
+numpy simulation of the same update rule.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.layers as L
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  unique_name)
+from paddle_tpu.optimizer import (SGD, ExponentialMovingAverage,
+                                  LookaheadOptimizer, ModelAverage)
+
+
+def _build(seed=3):
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [2])
+        y = L.data("y", [1])
+        pred = L.fc(x, 1, bias_attr=False)
+        loss = L.reduce_mean(L.square(L.elementwise_sub(pred, y)))
+    return main, startup, pred, loss
+
+
+def _w_name(scope):
+    return [n for n in scope.var_names() if n.endswith(".w_0")][0]
+
+
+def test_ema_tracks_numpy_shadow():
+    main, startup, pred, loss = _build()
+    with program_guard(main, startup):
+        SGD(learning_rate=0.1).minimize(loss)
+        ema = ExponentialMovingAverage(0.9).update()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    wname = _w_name(scope)
+    shadow = np.asarray(scope.find_var(wname)).copy()
+    rng = np.random.RandomState(0)
+    for _ in range(10):
+        xb = rng.randn(8, 2).astype(np.float32)
+        yb = xb.sum(1, keepdims=True)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[], scope=scope)
+        w = np.asarray(scope.find_var(wname))
+        shadow = 0.9 * shadow + 0.1 * w
+    ema_name = dict(ema._pairs)[wname]
+    np.testing.assert_allclose(np.asarray(scope.find_var(ema_name)),
+                               shadow, rtol=1e-5, atol=1e-6)
+    # apply swaps the param; restore brings it back
+    w_before = np.asarray(scope.find_var(wname)).copy()
+    with ema.apply(scope):
+        np.testing.assert_allclose(np.asarray(scope.find_var(wname)),
+                                   shadow, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(scope.find_var(wname)),
+                               w_before)
+
+
+def test_model_average_matches_trajectory_mean():
+    main, startup, pred, loss = _build(seed=5)
+    with program_guard(main, startup):
+        SGD(learning_rate=0.1).minimize(loss)
+        ma = ModelAverage().update()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    wname = _w_name(scope)
+    traj = []
+    rng = np.random.RandomState(1)
+    for _ in range(7):
+        xb = rng.randn(8, 2).astype(np.float32)
+        yb = xb.sum(1, keepdims=True)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[], scope=scope)
+        traj.append(np.asarray(scope.find_var(wname)).copy())
+    with ma.apply(scope):
+        got = np.asarray(scope.find_var(wname))
+        np.testing.assert_allclose(got, np.mean(traj, axis=0),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(scope.find_var(wname)),
+                               traj[-1])
+
+
+def test_lookahead_matches_numpy_simulation():
+    main, startup, pred, loss = _build(seed=7)
+    with program_guard(main, startup):
+        LookaheadOptimizer(SGD(learning_rate=0.1), alpha=0.5,
+                           k=3).minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    wname = _w_name(scope)
+    w = np.asarray(scope.find_var(wname)).copy()   # fast
+    slow = w.copy()
+    rng = np.random.RandomState(2)
+    for step in range(1, 8):
+        xb = rng.randn(8, 2).astype(np.float32)
+        yb = xb.sum(1, keepdims=True)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[], scope=scope)
+        # numpy: same sgd grad on the simulated fast weights
+        grad = (2.0 / len(xb)) * xb.T @ (xb @ w - yb)
+        w = w - 0.1 * grad
+        if step % 3 == 0:
+            slow = slow + 0.5 * (w - slow)
+            w = slow.copy()
+        np.testing.assert_allclose(np.asarray(scope.find_var(wname)), w,
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"step {step}")
+
+
+def test_lookahead_still_converges():
+    main, startup, pred, loss = _build(seed=9)
+    with program_guard(main, startup):
+        LookaheadOptimizer(SGD(learning_rate=0.2), alpha=0.8,
+                           k=2).minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(60):
+        xb = rng.randn(16, 2).astype(np.float32)
+        yb = xb.sum(1, keepdims=True)
+        (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                        fetch_list=[loss.name], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < 1e-3, losses[-1]
+
+
+def test_model_average_window_restarts():
+    """max_average_window caps the window: after a restart, apply()
+    averages only the steps since the restart."""
+    main, startup, pred, loss = _build(seed=11)
+    with program_guard(main, startup):
+        SGD(learning_rate=0.1).minimize(loss)
+        ma = ModelAverage(max_average_window=3).update()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    wname = _w_name(scope)
+    traj = []
+    rng = np.random.RandomState(4)
+    for _ in range(5):
+        xb = rng.randn(8, 2).astype(np.float32)
+        yb = xb.sum(1, keepdims=True)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[], scope=scope)
+        traj.append(np.asarray(scope.find_var(wname)).copy())
+    # numpy simulation of the restart rule (mask computed BEFORE the
+    # counter reset, matching the op order)
+    num, ssum = 0, 0.0
+    for p in traj:
+        num += 1
+        reset = (num == 3)
+        if reset:
+            num = 1
+        acc = ssum + p
+        ssum = p if reset else acc
+    with ma.apply(scope):
+        got = np.asarray(scope.find_var(wname))
+        np.testing.assert_allclose(got, ssum / num, rtol=1e-5,
+                                   atol=1e-6)
+    # the window actually restarted (not cumulative over all 5)
+    assert num < 5
+
+
+def test_lookahead_respects_parameter_list():
+    """No slow weights / sync ops for params excluded from the inner
+    optimizer's parameter_list."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 13
+    with program_guard(main, startup), unique_name.guard():
+        x = L.data("x", [2])
+        y = L.data("y", [1])
+        h = L.fc(x, 4, bias_attr=False)        # frozen from training
+        pred = L.fc(h, 1, bias_attr=False)     # trained
+        loss = L.reduce_mean(L.square(L.elementwise_sub(pred, y)))
+        frozen, trained = [v for v in main.global_block().vars.values()
+                           if getattr(v, "is_parameter", False)]
+        LookaheadOptimizer(SGD(learning_rate=0.1), k=2).minimize(
+            loss, parameter_list=[trained])
+    slow_vars = [n for n in main.global_block().vars if ".slow" in n]
+    assert len(slow_vars) == 1
+    assert slow_vars[0].startswith(trained.name)
