@@ -81,7 +81,13 @@ GPT_TENSOR_PARALLEL_RULES = ShardingRules([
     (r"linear1\.weight$", P(None, "mp")),
     (r"linear1\.bias$", P("mp")),
     (r"linear2\.weight$", P("mp", None)),
+    # encoder families (ERNIE/BERT): vocab-parallel word embedding
+    (r"word_embeddings\.weight$", P("mp", None)),
 ])
+
+# the rule table is transformer-generic (nn.MultiHeadAttention /
+# TransformerEncoderLayer names) — the ERNIE family shards with it too
+ERNIE_TENSOR_PARALLEL_RULES = GPT_TENSOR_PARALLEL_RULES
 
 # ZeRO-style optimizer/param sharding over the data axis (sharding
 # stage-3 analog): shard the largest dim of every tensor over "dp".

@@ -42,7 +42,8 @@ def _forward_needs_grad(block: Block, no_grad_set: Set[str]) -> Set[str]:
         d = _op_def(op.type)
         if d is None or d.not_differentiable:
             continue
-        if any(n in needs for n in op.input_names()):
+        virtual = getattr(d, "virtual_param", False)
+        if virtual or any(n in needs for n in op.input_names()):
             for slot, names in op.outputs.items():
                 if slot in d.nondiff_outputs:
                     continue

@@ -381,6 +381,13 @@ def reshape(x, shape, name=None):
     helper = LayerHelper("reshape", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
     xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    # static shape inference so downstream builders (fc) see sizes;
+    # 0 copies the input dim (reference reshape convention)
+    inferred = [int(d) for d in shape]
+    if x.shape:
+        inferred = [x.shape[i] if d == 0 and i < len(x.shape) else d
+                    for i, d in enumerate(inferred)]
+    out.shape = inferred
     helper.append_op("reshape2", inputs={"X": x},
                      outputs={"Out": out, "XShape": xshape},
                      attrs={"shape": list(shape)})

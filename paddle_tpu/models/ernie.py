@@ -1,0 +1,166 @@
+"""ERNIE — bidirectional-encoder model family (masked-LM pretraining).
+
+Capability analog of the ERNIE family the BASELINE configs[3] target
+(ERNIE-3.0 sharding/pipeline workload). Architecture: BERT-style
+bidirectional transformer encoder (token + position + segment
+embeddings -> N encoder blocks -> tied-embedding MLM head + pooled
+next-sentence head), built from this repo's nn.TransformerEncoder
+stack so the GSPMD sharding rules that cover GPT's fused blocks apply
+here too (attention/MLP weights shard on the same axes).
+
+TPU notes: static shapes (fixed seq len, mask tensor instead of ragged
+batches), bf16-friendly (no data-dependent control flow), and the MLM
+loss masks ignore-positions arithmetically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import paddle_tpu as pt
+
+from ..nn import Embedding, Layer, LayerNorm, Linear
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+
+@dataclasses.dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+
+
+ERNIE_CONFIGS = {
+    "ernie-tiny": ErnieConfig(vocab_size=1000, hidden_size=64,
+                              num_hidden_layers=2, num_attention_heads=4,
+                              intermediate_size=256,
+                              max_position_embeddings=128),
+    "ernie-base": ErnieConfig(),
+    "ernie-3.0-medium": ErnieConfig(hidden_size=768,
+                                    num_hidden_layers=6),
+    "ernie-3.0-xbase": ErnieConfig(hidden_size=1024,
+                                   num_hidden_layers=20,
+                                   num_attention_heads=16,
+                                   intermediate_size=4096),
+}
+
+
+class ErnieEmbeddings(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq = input_ids.shape[1]
+        pos = pt.to_tensor(np.arange(seq, dtype=np.int32)[None, :])
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.layer_norm(x)
+
+
+class ErnieModel(Layer):
+    """Encoder trunk: embeddings -> TransformerEncoder -> (sequence
+    output, pooled [CLS] output)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            d_model=cfg.hidden_size, nhead=cfg.num_attention_heads,
+            dim_feedforward=cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_probs_dropout_prob)
+        self.encoder = TransformerEncoder(enc_layer,
+                                          cfg.num_hidden_layers)
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        # bidirectional: the mask only hides padding, never the future.
+        # A conventional [b, s] 0/1 keep-mask converts to the additive
+        # [b, 1, 1, s] form the attention expects (PaddleNLP ErnieModel
+        # does the same conversion); pre-built additive masks pass
+        # through untouched.
+        if attention_mask is not None and \
+                len(attention_mask.shape) == 2:
+            keep = attention_mask.astype("float32")
+            attention_mask = (keep.unsqueeze(1).unsqueeze(1)
+                              - 1.0) * 1e4
+        x = self.encoder(x, src_mask=attention_mask)
+        pooled = pt.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(Layer):
+    """MLM head (tied to the word embedding, the vocab-parallel
+    pattern) + sentence-order head; returns the joint loss when labels
+    are given."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_ln = LayerNorm(cfg.hidden_size)
+        self.seq_relationship = Linear(cfg.hidden_size, 2)
+        self.cfg = cfg
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, masked_lm_labels=None,
+                next_sentence_label=None):
+        seq_out, pooled = self.ernie(input_ids, token_type_ids,
+                                     attention_mask)
+        import paddle_tpu.nn.functional as F
+        h = self.transform_ln(F.gelu(self.transform(seq_out)))
+        # tied LM head: logits = h @ word_embedding^T
+        w = self.ernie.embeddings.word_embeddings.weight
+        logits = pt.matmul(h, w, transpose_y=True)
+        ns_logits = self.seq_relationship(pooled)
+        if masked_lm_labels is None:
+            return logits, ns_logits
+        # -100 marks unmasked positions (ignored)
+        mlm = F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]),
+            masked_lm_labels.reshape([-1, 1]), ignore_index=-100)
+        loss = mlm
+        if next_sentence_label is not None:
+            loss = loss + F.cross_entropy(
+                ns_logits, next_sentence_label.reshape([-1, 1]))
+        return loss
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask)
+        return self.classifier(pooled)
+
+
+def ernie_tiny():
+    return ErnieForPretraining(ERNIE_CONFIGS["ernie-tiny"])
+
+
+__all__ = ["ERNIE_CONFIGS", "ErnieConfig", "ErnieForPretraining",
+           "ErnieForSequenceClassification", "ErnieModel", "ernie_tiny"]

@@ -51,7 +51,7 @@ def _lookup_table_grad_maker(op, out_grad_names, wanted_input_grads):
 
 
 @register("distributed_lookup_table", no_grad_slots=("Ids",),
-          grad_drops_inputs=("W",),
+          grad_drops_inputs=("W",), virtual_param=True,
           custom_grad_maker=_lookup_table_grad_maker)
 def _distributed_lookup_table(ctx, ins, attrs):
     """Pull rows from the host sparse table (init-on-miss)."""

@@ -120,6 +120,11 @@ class OpDef:
     # True if the op has no gradient at all.
     not_differentiable: bool = False
     custom_grad_maker: Optional[GradMaker] = None
+    # True when the op's trainable state lives OUTSIDE the program (a
+    # host-side sparse table): its outputs carry gradient even when no
+    # in-program input does, so backward still emits the grad op whose
+    # custom maker routes the push.
+    virtual_param: bool = False
 
 
 OPS: Dict[str, OpDef] = {}

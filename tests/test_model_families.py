@@ -1,0 +1,224 @@
+"""ERNIE and CTR (Wide&Deep / DeepFM) model families.
+
+Parity: BASELINE configs[3] (ERNIE sharding workload) and configs[4]
+(dist_fleet_ctr.py sparse CTR workload).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import (ERNIE_CONFIGS, DeepFM,
+                               ErnieForPretraining,
+                               ErnieForSequenceClassification, WideDeep,
+                               ernie_tiny)
+from paddle_tpu.optimizer import Adam
+
+
+def _mlm_batch(rng, cfg, b=4, s=16):
+    ids = rng.randint(3, cfg.vocab_size, (b, s)).astype(np.int32)
+    labels = np.full((b, s), -100, np.int64)
+    mask_pos = rng.rand(b, s) < 0.25
+    labels[mask_pos] = ids[mask_pos]
+    ids_masked = ids.copy()
+    ids_masked[mask_pos] = 1  # [MASK]
+    return ids_masked, labels
+
+
+def test_ernie_pretraining_trains():
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    model = ernie_tiny()
+    model.train()
+    opt = Adam(learning_rate=3e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(25):
+        ids, labels = _mlm_batch(rng, cfg)
+        nsl = rng.randint(0, 2, (ids.shape[0], 1)).astype(np.int64)
+        loss = model(pt.to_tensor(ids),
+                     masked_lm_labels=pt.to_tensor(labels),
+                     next_sentence_label=pt.to_tensor(nsl))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.numpy())))
+    # MLM over 1000-vocab starts ~ln(1000)+ln(2); must move down
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+
+def test_ernie_classification_shapes_and_mask():
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    model = ErnieForSequenceClassification(cfg, num_classes=3)
+    model.eval()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    tok = np.zeros((2, 12), np.int32)
+    out = model(pt.to_tensor(ids), token_type_ids=pt.to_tensor(tok))
+    assert tuple(np.asarray(out.numpy()).shape) == (2, 3)
+    # additive padding mask changes nothing when it is all zeros
+    mask = np.zeros((2, 1, 1, 12), np.float32)
+    out2 = model(pt.to_tensor(ids), token_type_ids=pt.to_tensor(tok),
+                 attention_mask=pt.to_tensor(mask))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(out2.numpy()), rtol=1e-5,
+                               atol=1e-6)
+
+
+def _ctr_batch(rng, n=64, slots=6, vocab=500):
+    ids = rng.randint(1, vocab, (n, slots)).astype(np.int32)
+    # clickable iff slot-0 id is even (learnable from embedding alone)
+    y = (ids[:, 0] % 2 == 0).astype(np.float32)[:, None]
+    return ids, y
+
+
+@pytest.mark.parametrize("cls", [WideDeep, DeepFM])
+def test_ctr_models_learn_auc(cls):
+    from paddle_tpu.metric import Auc
+    model = cls(vocab_size=500, embed_dim=8, num_slots=6,
+                hidden_sizes=(32, 16))
+    model.train()
+    opt = Adam(learning_rate=0.01, parameters=model.parameters())
+    rng = np.random.RandomState(2)
+    import paddle_tpu.nn.functional as F
+    for _ in range(60):
+        ids, y = _ctr_batch(rng)
+        logit = model(pt.to_tensor(ids))
+        loss = F.binary_cross_entropy_with_logits(
+            logit, pt.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    auc = Auc()
+    ids, y = _ctr_batch(rng, n=256)
+    model.eval()
+    probs = 1 / (1 + np.exp(-np.asarray(model(
+        pt.to_tensor(ids)).numpy())))
+    auc.update(probs, y.astype(np.int64))
+    assert auc.accumulate() > 0.9, auc.accumulate()
+
+
+def test_ernie_tp_loss_parity_vs_unsharded():
+    """ERNIE shards with the transformer-generic TP rules: per-step
+    loss parity vs the unsharded step (the configs[3] axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import jit
+    from paddle_tpu.distributed.sharding import (
+        ERNIE_TENSOR_PARALLEL_RULES)
+
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    rng = np.random.RandomState(5)
+    data = []
+    for _ in range(2):
+        ids, labels = _mlm_batch(rng, cfg, b=8, s=16)
+        data.append((ids, labels))
+
+    def build():
+        pt.seed(0)
+        model = ErnieForPretraining(cfg)
+        model.eval()  # dropout off: determinism across both builds
+        opt = Adam(learning_rate=1e-3, parameters=model.parameters())
+
+        def step(ids, labels):
+            loss = model(ids, masked_lm_labels=labels)
+            model.clear_gradients()
+            loss.backward()
+            opt.step()
+            return loss
+        return model, opt, step
+
+    model, opt, step = build()
+    ref_step = jit.to_static(step, layers=[model], optimizers=[opt])
+
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("dp", "mp"))
+    tp_model, tp_opt, tp_fn = build()
+    tp_step = jit.to_static(tp_fn, layers=[tp_model],
+                            optimizers=[tp_opt], mesh=mesh,
+                            param_rules=ERNIE_TENSOR_PARALLEL_RULES,
+                            arg_specs=(P("dp", None), P("dp", None)))
+    for i, (ids, labels) in enumerate(data):
+        ref = float(np.asarray(ref_step(ids, labels).value))
+        tp = float(np.asarray(tp_step(ids, labels).value))
+        assert np.isfinite(tp)
+        np.testing.assert_allclose(tp, ref, rtol=2e-3,
+                                   err_msg=f"step {i}")
+
+
+def test_ps_tier_wide_deep_program_trains():
+    """configs[4] regime: static Wide&Deep whose embedding rides
+    distributed_lookup_table against the host sparse table."""
+    from paddle_tpu.distributed.ps.sparse_table import REGISTRY
+    from paddle_tpu.framework import Executor, Scope
+    from paddle_tpu.models.ctr import build_wide_deep_program
+
+    REGISTRY.clear()
+    main, startup, loss, logit = build_wide_deep_program(
+        num_slots=4, embed_dim=8, hidden_sizes=(16,),
+        table_name="wd_emb", sparse_lr=5.0, dense_lr=0.05)
+    assert "distributed_lookup_table_grad" in [
+        op.type for op in main.global_block().ops]
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(3)
+
+    def batch(n=32):
+        ids = rng.randint(1, 300, (n, 4)).astype(np.int64)
+        y = (ids[:, 0] % 2 == 0).astype(np.float32)[:, None]
+        return ids, y
+
+    losses = []
+    for _ in range(150):
+        ids, y = batch()
+        (lv,) = exe.run(main, feed={"ids": ids, "label": y},
+                        fetch_list=[loss.name], scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.75, (losses[0], losses[-1])
+    assert REGISTRY.get("wd_emb").size() > 0  # rows live host-side
+
+
+def test_ernie_binary_padding_mask_actually_masks():
+    """A conventional [b, s] 0/1 keep-mask must change (and stabilize)
+    outputs: masking trailing junk makes two inputs that differ only
+    in the junk agree."""
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    model.eval()
+    rng = np.random.RandomState(7)
+    base = rng.randint(3, cfg.vocab_size, (1, 10)).astype(np.int32)
+    a = base.copy()
+    b = base.copy()
+    b[0, 6:] = 7  # different junk in the padded tail
+    keep = np.ones((1, 10), np.float32)
+    keep[0, 6:] = 0.0
+    oa = np.asarray(model(pt.to_tensor(a),
+                          attention_mask=pt.to_tensor(keep)).numpy())
+    ob = np.asarray(model(pt.to_tensor(b),
+                          attention_mask=pt.to_tensor(keep)).numpy())
+    np.testing.assert_allclose(oa, ob, rtol=1e-4, atol=1e-5)
+    # and without the mask they disagree (the mask is load-bearing)
+    ua = np.asarray(model(pt.to_tensor(a)).numpy())
+    ub = np.asarray(model(pt.to_tensor(b)).numpy())
+    assert np.abs(ua - ub).max() > 1e-4
+
+
+def test_ctr_models_accept_multi_hot():
+    """[b, slots, k] multi-hot input with 0 padding sum-pools over k."""
+    for cls in (WideDeep, DeepFM):
+        model = cls(vocab_size=100, embed_dim=4, num_slots=3,
+                    hidden_sizes=(8,))
+        model.eval()
+        ids3 = np.array([[[1, 2, 0], [5, 0, 0], [7, 8, 9]]], np.int32)
+        out = model(pt.to_tensor(ids3))
+        assert tuple(np.asarray(out.numpy()).shape) == (1, 1)
+        # 0-padding contributes nothing: adding an extra pad id is a
+        # no-op
+        ids3b = np.array([[[1, 2, 0], [5, 0, 0], [7, 8, 9]]], np.int32)
+        pad_more = np.concatenate(
+            [ids3b, np.zeros((1, 3, 1), np.int32)], axis=2)
+        out2 = model(pt.to_tensor(pad_more))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(out2.numpy()), rtol=1e-5,
+                                   atol=1e-6)
