@@ -7,6 +7,6 @@ they're missing.
 """
 
 from . import datasets
-from .datasets import Imdb, Imikolov, UCIHousing, Vocab
+from .datasets import Imdb, Imikolov, Movielens, UCIHousing, Vocab
 
-__all__ = ["datasets", "Imdb", "Imikolov", "UCIHousing", "Vocab"]
+__all__ = ["datasets", "Imdb", "Imikolov", "Movielens", "UCIHousing", "Vocab"]

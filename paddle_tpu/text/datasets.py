@@ -188,4 +188,65 @@ class UCIHousing(Dataset):
         return self.x[idx], self.y[idx]
 
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "Vocab"]
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Vocab"]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings from the official archive layout
+    (text/datasets/movielens.py): ``ratings.dat`` lines
+    ``user::movie::rating::timestamp`` plus optional ``users.dat``
+    side features. Yields (user_id, gender_id, age_raw, occupation,
+    movie_id, rating) — RAW MovieLens ids (user <= 6040, movie ids
+    sparse up to 3952, age in years); size embedding tables from
+    ``max_user_id``/``max_movie_id`` or densify downstream. Blank
+    lines are skipped; malformed lines error with file context."""
+
+    def __init__(self, ratings_path: str,
+                 users_path: str = None,
+                 mode: str = "train", test_ratio: float = 0.1,
+                 seed: int = 0):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train or test, got {mode!r}")
+        _require(ratings_path, "ratings.dat")
+        users = {}
+        if users_path:
+            _require(users_path, "users.dat")
+            for parts in self._lines(users_path, 5):
+                uid, gender, age, occupation, _zip = parts
+                users[int(uid)] = (0 if gender == "M" else 1,
+                                   int(age), int(occupation))
+        rows = []
+        for parts in self._lines(ratings_path, 4):
+            u, m, r, _ts = parts
+            g, a, o = users.get(int(u), (0, 0, 0))
+            rows.append((int(u), g, a, o, int(m), float(r)))
+        self.max_user_id = max((r[0] for r in rows), default=0)
+        self.max_movie_id = max((r[4] for r in rows), default=0)
+        rng = np.random.RandomState(seed)
+        take_test = rng.rand(len(rows)) < test_ratio
+        keep = ~take_test if mode == "train" else take_test
+        self.rows = [rows[i] for i in np.nonzero(keep)[0]]
+        if not self.rows:
+            raise ValueError(f"no {mode} rows in {ratings_path}")
+
+    @staticmethod
+    def _lines(path: str, nfields: int):
+        with open(path, encoding="latin-1") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split("::")
+                if len(parts) != nfields:
+                    raise ValueError(
+                        f"{path}:{lineno}: expected {nfields} "
+                        f"'::'-separated fields, got {len(parts)}")
+                yield parts
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, idx):
+        u, g, a, o, m, r = self.rows[idx]
+        return (np.int64(u), np.int64(g), np.int64(a), np.int64(o),
+                np.int64(m), np.float32(r))

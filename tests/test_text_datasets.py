@@ -149,3 +149,41 @@ def test_uci_housing_single_row_clear_error(tmp_path):
     f.write_text(" ".join(["1.0"] * 5) + "\n")
     with pytest.raises(ValueError, match="columns"):
         UCIHousing(str(f))
+
+
+def test_movielens_reader(tmp_path):
+    from paddle_tpu.text import Movielens
+    ratings = tmp_path / "ratings.dat"
+    ratings.write_text("".join(
+        f"{u}::{m}::{(u + m) % 5 + 1}::97830{u}\n"
+        for u in range(1, 21) for m in range(1, 6)))
+    users = tmp_path / "users.dat"
+    users.write_text("".join(
+        f"{u}::{'M' if u % 2 else 'F'}::25::{u % 7}::55117\n"
+        for u in range(1, 21)))
+    train = Movielens(str(ratings), str(users), mode="train",
+                      test_ratio=0.2, seed=1)
+    test = Movielens(str(ratings), str(users), mode="test",
+                     test_ratio=0.2, seed=1)
+    assert len(train) + len(test) == 100
+    assert 10 <= len(test) <= 35  # ~20%
+    u, g, a, o, m, r = train[0]
+    assert g in (0, 1) and a == 25 and 1 <= r <= 5
+    # deterministic split: same seed reproduces
+    again = Movielens(str(ratings), str(users), mode="test",
+                      test_ratio=0.2, seed=1)
+    assert len(again) == len(test) and again.rows == test.rows
+
+
+def test_movielens_validation_and_blank_lines(tmp_path):
+    from paddle_tpu.text import Movielens
+    ratings = tmp_path / "r.dat"
+    ratings.write_text("1::10::4::978300\n\n2::11::5::978301\n")
+    ds = Movielens(str(ratings), mode="train", test_ratio=0.0)
+    assert len(ds) == 2 and ds.max_user_id == 2 and ds.max_movie_id == 11
+    with pytest.raises(ValueError, match="mode must be"):
+        Movielens(str(ratings), mode="Train")
+    bad = tmp_path / "bad.dat"
+    bad.write_text("1::10::4\n")
+    with pytest.raises(ValueError, match="bad.dat:1"):
+        Movielens(str(bad))
