@@ -106,12 +106,24 @@ class Fleet:
     def main_program(self):
         return self._final_program or default_main_program()
 
-    def pipeline_runner(self):
-        """GPipe runner for a strategy.pipeline minimize()."""
+    def pipeline_runner(self, devices=None, schedule=None):
+        """Microbatch runner for a strategy.pipeline minimize().
+        ``devices`` pins each stage onto its own chip; ``schedule``
+        picks "gpipe" or "1f1b" (defaults to the strategy's
+        pipeline_configs["schedule"] or gpipe)."""
         runner = getattr(self, "_pipeline_runner", None)
         if runner is None:
             raise ValueError("no pipeline program; set strategy.pipeline "
                              "and call minimize() first")
+        new_devices = devices if devices is not None else runner.devices
+        new_schedule = schedule or runner.schedule
+        if (new_devices != runner.devices
+                or new_schedule != runner.schedule):
+            from .pipeline import PipelineRunner
+            runner = PipelineRunner(
+                runner.stages, runner.num_microbatches,
+                devices=new_devices, schedule=new_schedule)
+            self._pipeline_runner = runner
         return runner
 
     # -- checkpoint passthroughs ------------------------------------------
@@ -244,7 +256,8 @@ class _DistributedOptimizer:
             stages = split_pipeline_program(program, n_mb)
             program._pipeline_stages = stages
             program._pipeline_num_microbatches = n_mb
-            self._fleet._pipeline_runner = PipelineRunner(stages, n_mb)
+            self._fleet._pipeline_runner = PipelineRunner(
+                stages, n_mb, schedule=cfg.get("schedule", "gpipe"))
             self._fleet._final_program = program
             return opt_ops, params_grads
 

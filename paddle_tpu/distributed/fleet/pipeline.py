@@ -9,12 +9,13 @@ microbatch scopes -> backward over N -> optimize").
 
 TPU-first translation: no per-section C++ threads or blocking queues —
 each stage becomes THREE phase programs (forward / backward / optimize)
-holding that stage's ops; cross-stage and cross-phase values flow through
-the Scope (the queue analog: on multi-chip deployments these boundary
-tensors are exactly what rides the ICI between stage chips; the phase
-programs are what each stage's chip compiles). The schedule is GPipe:
-all microbatch forwards, then all backwards with gradient accumulation
-into persistable buffers, then one optimize apply.
+holding that stage's ops, compiled and pinned onto that stage's device
+(Executor(place=dev)); cross-stage boundary tensors hop devices through
+async jax.device_put (the inter-section queue = the per-device XLA
+execution stream + ICI transfer). Schedules: GPipe (all forwards, all
+backwards with gradient accumulation into persistable buffers, one
+optimize apply) or 1F1B (warmup forwards then one-forward-one-backward
+steady state — lower activation memory, identical numerics).
 
 Gradient accumulation is inserted at split time: each backward phase sums
 its parameter grads into ``<p>@GRAD@PACC``; the optimize phase reads the
@@ -24,6 +25,8 @@ accumulator (scaled by 1/num_microbatches) and zeroes it.
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ...framework import unique_name
 from ...framework.program import Operator, Program, default_startup_program
@@ -183,21 +186,129 @@ def split_pipeline_program(program: Program,
 
 
 class PipelineRunner:
-    """GPipe schedule over the split stages (PipelineTrainer analog).
+    """Microbatch scheduler over the split stages (PipelineTrainer /
+    SectionWorker analog, pipeline_trainer.cc:24, section_worker.cc:82).
 
-    ``run(exe, scope, microbatch_feeds, fetch_list)``:
-      1. forward: for each microbatch, stages 0..S-1 in order;
-      2. backward: for each microbatch (reverse order), stages S-1..0;
-      3. optimize: each stage once (accumulated, averaged grads).
-    Per-microbatch boundary tensors are renamed through the scope so
-    activations from microbatch i survive until its backward (the
-    reference's per-microbatch scopes, pipeline_trainer.cc:24).
+    Unlike the round-3 sequential simulation, stages now execute on
+    DISTINCT devices when ``devices`` is given: each stage gets its own
+    Executor whose ``place`` is that stage's device, so its compiled
+    phase programs and parameters live there, and boundary tensors hop
+    devices via async ``jax.device_put`` (the ICI transfer). Dispatch is
+    asynchronous — the host enqueues work in schedule order and never
+    blocks on values, so stage s runs microbatch i while stage s+1 runs
+    microbatch i-1 (the reference's concurrent section workers with
+    inter-section queues, here per-device XLA execution streams).
+
+    Schedules:
+      - ``"gpipe"``: all forwards, then all backwards, then optimize.
+      - ``"1f1b"``: each stage does ``min(M, S-1-s)`` warmup forwards,
+        then alternates one-forward-one-backward, then drains backwards
+        (lower peak activation memory, same numerics).
+    Both are linearized into one dependency-respecting dispatch order;
+    ``self.dispatch_log`` records it for inspection.
+
+    Per-microbatch state: every phase dispatch first restores that
+    microbatch's stashed boundary tensors (``<name>@MB<i>`` scope
+    entries — the per-microbatch scope analog), runs, then stashes its
+    own persistable outputs under the microbatch tag. Gradient
+    accumulators (``@PACC``) are deliberately never stashed — they are
+    shared across microbatches by design.
+
+    ``fetch_list`` is honored on EVERY microbatch; the returned value
+    for each fetch target is the mean across microbatches (equal to the
+    full-batch value for mean-reduced losses with equal microbatches).
     """
 
     def __init__(self, stages: Sequence[PipelineStage],
-                 num_microbatches: int):
+                 num_microbatches: int,
+                 devices: Optional[Sequence] = None,
+                 schedule: str = "gpipe"):
         self.stages = list(stages)
         self.num_microbatches = num_microbatches
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = schedule
+        self.devices = list(devices) if devices is not None else None
+        self._stage_execs = None
+        if self.devices is not None:
+            if len(self.devices) < len(self.stages):
+                raise ValueError(
+                    f"pipeline has {len(self.stages)} stages but only "
+                    f"{len(self.devices)} devices were given")
+            from ...framework.executor import Executor
+            self._stage_execs = [Executor(place=d)
+                                 for d in self.devices[:len(self.stages)]]
+        self.dispatch_log: List[Tuple[str, int, int]] = []
+
+    # -- schedule construction ----------------------------------------------
+    def _stage_orders(self) -> List[List[Tuple[str, int]]]:
+        """Per-stage local item order: list of (phase, microbatch)."""
+        S, M = len(self.stages), self.num_microbatches
+        orders = []
+        for s in range(S):
+            items: List[Tuple[str, int]] = []
+            if self.schedule == "gpipe":
+                items += [("F", mb) for mb in range(M)]
+                items += [("B", mb) for mb in range(M - 1, -1, -1)]
+            else:  # 1f1b
+                warmup = min(M, S - 1 - s)
+                items += [("F", mb) for mb in range(warmup)]
+                for i in range(M - warmup):
+                    items.append(("F", warmup + i))
+                    items.append(("B", i))
+                items += [("B", mb) for mb in range(M - warmup, M)]
+            items.append(("OPT", -1))
+            orders.append(items)
+        return orders
+
+    def _linearize(self) -> List[Tuple[str, int, int]]:
+        """Round-robin merge of the per-stage orders into one dispatch
+        sequence in which every item's cross-stage dependencies are
+        dispatched earlier (per-device queues keep same-stage order)."""
+        S = len(self.stages)
+        orders = self._stage_orders()
+        heads = [0] * S
+        done = set()
+        out: List[Tuple[str, int, int]] = []
+
+        def deps_met(phase, s, mb):
+            if phase == "F":
+                return s == 0 or ("F", s - 1, mb) in done
+            if phase == "B":
+                if ("F", s, mb) not in done:
+                    return False
+                return s == S - 1 or ("B", s + 1, mb) in done
+            # OPT is last in each stage's local order, after all its B's
+            return True
+
+        remaining = sum(len(o) for o in orders)
+        while remaining:
+            progressed = False
+            for s in range(S):
+                if heads[s] >= len(orders[s]):
+                    continue
+                phase, mb = orders[s][heads[s]]
+                if deps_met(phase, s, mb):
+                    out.append((phase, s, mb))
+                    done.add((phase, s, mb))
+                    heads[s] += 1
+                    remaining -= 1
+                    progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    "pipeline schedule deadlock (bug in schedule builder)")
+        return out
+
+    # -- execution -----------------------------------------------------------
+    @staticmethod
+    def _mb_vars(prog):
+        """Persistable, non-parameter vars of a phase program that carry
+        per-microbatch values (excludes shared grad accumulators)."""
+        for v in prog.global_block().vars.values():
+            if (v.persistable and not v.is_parameter
+                    and not v.name.endswith("@PACC")
+                    and "@MB" not in v.name):
+                yield v.name
 
     def run(self, exe, scope, microbatch_feeds: Sequence[dict],
             fetch_list: Optional[Sequence[str]] = None):
@@ -205,51 +316,58 @@ class PipelineRunner:
             raise ValueError(
                 f"expected {self.num_microbatches} microbatch feeds, got "
                 f"{len(microbatch_feeds)}")
-        fetch_list = list(fetch_list or [])
-        fetched = []
+        fetch_list = [f if isinstance(f, str) else f.name
+                      for f in (fetch_list or [])]
+        for f in fetch_list:
+            if not any(f in st.forward.global_block().vars
+                       for st in self.stages):
+                raise KeyError(
+                    f"fetch target {f!r} is not produced by any stage's "
+                    f"forward program (pipeline fetch supports forward "
+                    f"values; grads/optimizer state live in the scope)")
+        # fetch name -> list of per-microbatch device values
+        fetched: Dict[str, List] = {f: [] for f in fetch_list}
 
         def stash(prog, mb):
-            """After running a phase for microbatch mb, rename its
-            persistable non-param outputs to @MB<i> names in the scope."""
-            blk = prog.global_block()
-            for v in blk.vars.values():
-                if v.persistable and not v.is_parameter:
-                    arr = scope.find_var(v.name)
-                    if arr is not None:
-                        scope.set_var(f"{v.name}@MB{mb}", arr)
+            for n in self._mb_vars(prog):
+                arr = scope.find_var(n)
+                if arr is not None:
+                    scope.set_var(f"{n}@MB{mb}", arr)
 
         def unstash(prog, mb):
-            blk = prog.global_block()
-            for v in blk.vars.values():
-                if v.persistable and not v.is_parameter:
-                    arr = scope.find_var(f"{v.name}@MB{mb}")
-                    if arr is not None:
-                        scope.set_var(v.name, arr)
+            for n in self._mb_vars(prog):
+                arr = scope.find_var(f"{n}@MB{mb}")
+                if arr is not None:
+                    scope.set_var(n, arr)
 
-        # 1. forwards
-        for mb, feed in enumerate(microbatch_feeds):
-            for stage in self.stages:
-                fl = [f for f in fetch_list
-                      if f in stage.forward.global_block().vars] \
-                    if mb == 0 else []
-                vals = exe.run(stage.forward, feed=feed, fetch_list=fl,
-                               scope=scope)
-                if fl:
-                    fetched.extend(vals)
-            for stage in self.stages:
-                stash(stage.forward, mb)
+        plan = self._linearize()
+        self.dispatch_log = plan
+        phase_prog = {"F": lambda st: st.forward,
+                      "B": lambda st: st.backward,
+                      "OPT": lambda st: st.optimize}
+        for phase, s, mb in plan:
+            stage = self.stages[s]
+            runner_exe = (self._stage_execs[s]
+                          if self._stage_execs is not None else exe)
+            prog = phase_prog[phase](stage)
+            if phase == "OPT":
+                runner_exe.run(prog, feed={}, fetch_list=[], scope=scope)
+                continue
+            unstash(prog, mb)
+            fl = ([f for f in fetch_list
+                   if f in prog.global_block().vars]
+                  if phase == "F" else [])
+            # return_numpy=False keeps dispatch async: values stay device
+            # futures until the final conversion below.
+            vals = runner_exe.run(prog, feed=microbatch_feeds[mb],
+                                  fetch_list=fl, scope=scope,
+                                  return_numpy=False)
+            for f, v in zip(fl, vals):
+                fetched[f].append(v)
+            stash(prog, mb)
 
-        # 2. backwards (reverse microbatch order, reverse stage order);
-        # within one microbatch the boundary grads flow through the live
-        # scope names, so only forward activations need unstashing
-        for mb in range(self.num_microbatches - 1, -1, -1):
-            for stage in self.stages:
-                unstash(stage.forward, mb)
-            for stage in reversed(self.stages):
-                exe.run(stage.backward, feed=microbatch_feeds[mb],
-                        fetch_list=[], scope=scope)
-
-        # 3. optimize
-        for stage in self.stages:
-            exe.run(stage.optimize, feed={}, fetch_list=[], scope=scope)
-        return fetched
+        out = []
+        for f in fetch_list:
+            arrs = [np.asarray(v) for v in fetched[f]]  # sync point
+            out.append(np.mean(np.stack(arrs), axis=0))
+        return out

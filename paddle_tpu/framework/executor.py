@@ -146,6 +146,11 @@ class Executor:
     """Analog of fluid.Executor (executor.py:915 / executor.cc:180)."""
 
     def __init__(self, place: Any = None, donate_state: bool = False):
+        # place may be a jax.Device: feeds and scope state are then
+        # committed to that device, pinning the compiled computation
+        # there (the TPU analog of the reference's per-section place,
+        # section_worker.cc:82 — each pipeline stage gets its own
+        # Executor whose place is that stage's chip).
         self.place = place
         # donate_state=True reuses device buffers for scope state across
         # runs (in-place param update on TPU — big memory win) but
@@ -184,7 +189,17 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
 
-        feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
+        device = self.place if isinstance(self.place, jax.Device) else None
+        if device is not None:
+            # single hop host->device (device_put canonicalizes dtypes
+            # like jnp.asarray); staging through jnp.asarray first would
+            # commit to the default device and pay a second d2d copy
+            feed_arrays = {
+                k: jax.device_put(
+                    v if isinstance(v, jax.Array) else np.asarray(v), device)
+                for k, v in feed.items()}
+        else:
+            feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items()))
         # The scope-names signature catches "scope populated after first
@@ -207,7 +222,11 @@ class Executor:
                 raise KeyError(
                     f"variable {n!r} needed by the program is not in scope — "
                     f"did you run the startup program?")
-            state[n] = v if isinstance(v, jax.Array) else jnp.asarray(v)
+            arr = v if isinstance(v, jax.Array) else jnp.asarray(v)
+            # device_put is a no-op view when already resident; otherwise
+            # it schedules an async d2d copy (the ICI hop between pipeline
+            # stages), so cross-device reads never block the host.
+            state[n] = arr if device is None else jax.device_put(arr, device)
         rng = self._next_rng(program)
 
         fetches, new_state = compiled(state, feed_arrays, rng)

@@ -774,11 +774,15 @@ class PipelineOptimizer:
         program._pipeline_num_microbatches = self._num_microbatches
         return opt_ops, params_grads
 
-    def runner(self):
+    def runner(self, devices=None, schedule: str = "gpipe"):
+        """Build the microbatch runner. ``devices`` (list of jax.Device)
+        places each stage's compiled programs on its own chip for real
+        pipeline parallelism; ``schedule`` is "gpipe" or "1f1b"."""
         from .distributed.fleet.pipeline import PipelineRunner
         if self._stages is None:
             raise ValueError("call minimize() before runner()")
-        return PipelineRunner(self._stages, self._num_microbatches)
+        return PipelineRunner(self._stages, self._num_microbatches,
+                              devices=devices, schedule=schedule)
 
 
 # fluid-style aliases

@@ -117,6 +117,231 @@ def test_pipeline_matches_plain_training():
             err_msg=f"param {name} diverged between pipeline and plain")
 
 
+def _four_stage_program(seed=23, width=16):
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with program_guard(main, startup), unique_name.guard():
+        with device_guard("tpu:0"):
+            x = layers.data("x", [6])
+            y = layers.data("y", [1])
+            h = layers.fc(x, width, act="relu")
+        with device_guard("tpu:1"):
+            h = layers.fc(h, width, act="relu")
+        with device_guard("tpu:2"):
+            h = layers.fc(h, width, act="relu")
+        with device_guard("tpu:3"):
+            pred = layers.fc(h, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def test_1f1b_device_placement_and_parity():
+    """Stages compiled onto DISTINCT devices (section_worker.cc:82's
+    per-section place), 1F1B schedule, numerics identical to plain
+    full-batch training."""
+    import jax
+
+    n_mb = 4
+    feeds = _mb_feeds(n_mb)
+    devices = jax.devices()[:4]
+    assert len(devices) == 4
+
+    main, startup, loss = _four_stage_program()
+    with program_guard(main, startup):
+        opt = PipelineOptimizer(SGDOptimizer(0.1), num_microbatches=n_mb)
+        opt.minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    runner = opt.runner(devices=devices, schedule="1f1b")
+    for _ in range(3):
+        out = runner.run(exe, scope, feeds, fetch_list=[loss.name])
+    assert np.isfinite(out[0])
+
+    # (a) each stage's parameters live on that stage's device
+    for s, stage in enumerate(runner.stages):
+        for v in stage.optimize.global_block().vars.values():
+            if v.is_parameter:
+                arr = scope.find_var(v.name)
+                assert set(arr.devices()) == {devices[s]}, (
+                    f"param {v.name} of stage {s} on {arr.devices()}, "
+                    f"expected {devices[s]}")
+
+    # (b) parity with plain training on the concatenated batch, with a
+    # 4-layer plain twin of the staged net
+    mainp, startupp = Program(), Program()
+    mainp.random_seed = startupp.random_seed = 23
+    with program_guard(mainp, startupp), unique_name.guard():
+        x = layers.data("x", [6])
+        y = layers.data("y", [1])
+        h = layers.fc(x, 16, act="relu")
+        h = layers.fc(h, 16, act="relu")
+        h = layers.fc(h, 16, act="relu")
+        pred = layers.fc(h, 1)
+        lossp = layers.mean(layers.square_error_cost(pred, y))
+        SGDOptimizer(0.1).minimize(lossp)
+    scope2, exe2 = Scope(), Executor()
+    exe2.run(startupp, scope=scope2)
+    big_feed = {k: np.concatenate([f[k] for f in feeds]) for k in feeds[0]}
+    for _ in range(3):
+        exe2.run(mainp, feed=big_feed, fetch_list=[lossp.name], scope=scope2)
+    for p in mainp.all_parameters():
+        np.testing.assert_allclose(
+            scope.get_numpy(p.name), scope2.get_numpy(p.name),
+            rtol=1e-4, atol=1e-5, err_msg=f"param {p.name} diverged")
+
+
+def test_1f1b_schedule_structure():
+    """The 1F1B linearized dispatch has real pipelining: downstream
+    stages start before upstream stages finish their forwards, warmup
+    depth is S-1-s, and every item's cross-stage deps dispatch first."""
+    from paddle_tpu.distributed.fleet.pipeline import PipelineRunner
+
+    main, startup, loss = _four_stage_program()
+    with program_guard(main, startup):
+        opt = PipelineOptimizer(SGDOptimizer(0.1), num_microbatches=8)
+        opt.minimize(loss)
+    runner = PipelineRunner(main._pipeline_stages, 8, schedule="1f1b")
+    plan = runner._linearize()
+    pos = {item: i for i, item in enumerate(plan)}
+    S, M = 4, 8
+
+    # dependency order
+    for s in range(S):
+        for mb in range(M):
+            if s > 0:
+                assert pos[("F", s, mb)] > pos[("F", s - 1, mb)]
+            assert pos[("B", s, mb)] > pos[("F", s, mb)]
+            if s < S - 1:
+                assert pos[("B", s, mb)] > pos[("B", s + 1, mb)]
+    # pipelining: stage 1 starts mb0 before stage 0 has dispatched all
+    # forwards; last stage's first backward comes before stage 0's last
+    # forward (fwd/bwd overlap — the 1F1B signature)
+    assert pos[("F", 1, 0)] < pos[("F", 0, M - 1)]
+    assert pos[("B", S - 1, 0)] < pos[("F", 0, M - 1)]
+    # 1F1B steady state on the last stage: F and B alternate
+    last = [it for it in plan if it[1] == S - 1 and it[0] in "FB"]
+    kinds = "".join(k for k, _, _ in last)
+    assert kinds.startswith("FB" * (M - 1))
+    # optimize dispatches after every backward of its stage
+    for s in range(S):
+        assert pos[("OPT", s, -1)] > max(pos[("B", s, mb)]
+                                         for mb in range(M))
+
+
+_OVERLAP_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+devs = jax.devices()
+if len(devs) < 4:
+    print(json.dumps({"skip": f"only {len(devs)} devices"})); sys.exit(0)
+
+# concurrency probe: serial chained matmuls pinned to two devices; with
+# intra-op threading disabled, overlap across devices is the only
+# parallelism available
+@jax.jit
+def chain(x):
+    for _ in range(60):
+        x = jnp.tanh(x @ x)
+    return x
+
+probes = [jax.device_put(jnp.ones((192, 192), jnp.float32), d)
+          for d in devs[:2]]
+for p in probes:
+    chain(p).block_until_ready()
+t0 = time.perf_counter()
+for p in probes:
+    chain(p).block_until_ready()
+seq = time.perf_counter() - t0
+t0 = time.perf_counter()
+outs = [chain(p) for p in probes]
+for o in outs:
+    o.block_until_ready()
+par = time.perf_counter() - t0
+if par > 0.7 * seq:
+    print(json.dumps({"skip": f"devices serialize (par/seq={par/seq:.2f})"}))
+    sys.exit(0)
+
+from paddle_tpu import layers
+from paddle_tpu.framework import (Executor, Program, Scope, device_guard,
+                                  program_guard, unique_name)
+from paddle_tpu.optimizer import PipelineOptimizer, SGDOptimizer
+
+width, bs, n_mb = 768, 128, 8
+main, startup = Program(), Program()
+main.random_seed = startup.random_seed = 23
+with program_guard(main, startup), unique_name.guard():
+    with device_guard("tpu:0"):
+        x = layers.data("x", [6]); y = layers.data("y", [1])
+        h = layers.fc(x, width, act="relu")
+        h = layers.fc(h, width, act="relu")
+    with device_guard("tpu:1"):
+        h = layers.fc(h, width, act="relu")
+        h = layers.fc(h, width, act="relu")
+    with device_guard("tpu:2"):
+        h = layers.fc(h, width, act="relu")
+        h = layers.fc(h, width, act="relu")
+    with device_guard("tpu:3"):
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    opt = PipelineOptimizer(SGDOptimizer(0.01), num_microbatches=n_mb)
+    opt.minimize(loss)
+
+rng = np.random.RandomState(0)
+feeds = [{"x": rng.randn(bs, 6).astype(np.float32),
+          "y": rng.randn(bs, 1).astype(np.float32)} for _ in range(n_mb)]
+
+def timed(runner):
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    runner.run(exe, scope, feeds, fetch_list=[loss.name])  # compile
+    runner.run(exe, scope, feeds, fetch_list=[loss.name])  # warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        runner.run(exe, scope, feeds, fetch_list=[loss.name])
+    return (time.perf_counter() - t0) / 3
+
+t_par = timed(opt.runner(devices=devs[:4], schedule="1f1b"))
+t_seq = timed(opt.runner())
+print(json.dumps({"t_par": t_par, "t_seq": t_seq}))
+"""
+
+
+def test_pipeline_overlap_wallclock():
+    """Wall-clock: the device-placed async 1F1B pipeline beats the
+    sequential single-device runner. Measured in a subprocess with XLA
+    intra-op threading disabled (--xla_cpu_multi_thread_eigen=false) so
+    that cross-stage overlap is the only parallelism in play — otherwise
+    the 'sequential' baseline already spreads each matmul over all cores
+    and the comparison measures nothing. Skipped when the backend
+    serializes virtual-device execution (single-core hosts), where
+    overlap is physically impossible; the schedule/dependency/placement
+    properties are asserted deterministically in the tests above."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import pytest
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                        "--xla_cpu_multi_thread_eigen=false")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", _OVERLAP_CHILD], env=env,
+                          capture_output=True, text=True, timeout=900,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["t_par"] < result["t_seq"], result
+
+
 def test_fleet_pipeline_strategy():
     from paddle_tpu.distributed.fleet.distributed_strategy import \
         DistributedStrategy
