@@ -16,5 +16,12 @@ from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import index_ops  # noqa: F401
+from . import ctr_ops  # noqa: F401
+from . import structured_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import ps_ops  # noqa: F401

@@ -1,0 +1,160 @@
+"""CTR-stack op lowerings: cvm, data_norm, nce, sample_logits.
+
+Analogs of paddle/fluid/operators/{cvm_op.cc, data_norm_op.cc, nce_op.cc,
+sample_logits_op.cc} — the ops the reference's CTR/recommendation models
+(Wide&Deep, DeepFM over slot datasets) lean on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("cvm", no_grad_slots=("CVM",))
+def _cvm(ctx, ins, attrs):
+    """reference cvm_op.h:20-60: show/click (first two columns) get
+    log-transformed (use_cvm) or stripped (not use_cvm)."""
+    x = ins["X"][0]
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if use_cvm:
+        show = jnp.log(x[:, 0:1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return {"Y": [jnp.concatenate([show, click, x[:, 2:]], axis=1)]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register("data_norm",
+          no_grad_slots=("BatchSize", "BatchSum", "BatchSquareSum",
+                         "scale_w", "bias"))
+def _data_norm(ctx, ins, attrs):
+    """reference data_norm_op.cc:267-340: normalize by accumulated batch
+    stats; means = sum/size, scales = sqrt(size/square_sum). With slot_dim,
+    slots whose leading (show) element is 0 emit zeros."""
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0].reshape(-1)
+    bsum = ins["BatchSum"][0].reshape(-1)
+    bsq = ins["BatchSquareSum"][0].reshape(-1)
+    slot_dim = int(attrs.get("slot_dim", -1))
+
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x - means[None, :]) * scales[None, :]
+    if slot_dim > 0:
+        n, d = x.shape
+        show = x.reshape(n, d // slot_dim, slot_dim)[:, :, 0:1]
+        live = (jnp.abs(show) >= 1e-7).astype(x.dtype)
+        y = (y.reshape(n, d // slot_dim, slot_dim) * live).reshape(n, d)
+    return {"Y": [y], "Means": [means], "Scales": [scales]}
+
+
+def _noise_prob(sampler, labels, num_total, probs):
+    if sampler == 1:  # log-uniform (Zipf)
+        k = labels.astype(jnp.float32)
+        return jnp.log((k + 2.0) / (k + 1.0)) / jnp.log(float(num_total) + 1.0)
+    if sampler == 2 and probs is not None:
+        return probs[labels]
+    return jnp.full(labels.shape, 1.0 / num_total)
+
+
+@register("nce", no_grad_slots=("Label", "SampleWeight", "CustomDistProbs",
+                                "CustomDistAlias", "CustomDistAliasProbs"))
+def _nce(ctx, ins, attrs):
+    """reference nce_op.h:82-268: noise-contrastive estimation.
+
+    cost_i = sum_true -log(o/(o+b)) + sum_neg -log(b/(o+b)),
+    o = exp(logit(target)), b = P_noise(target) * num_neg_samples.
+    Negative sampling is functional (ctx.rng()), matching the reference's
+    per-step sampler draw.
+    """
+    x = ins["Input"][0]                      # (N, D)
+    label = ins["Label"][0]                  # (N, num_true)
+    weight = ins["Weight"][0]                # (C, D)
+    bias = ins.get("Bias", [None])[0]        # (C,)
+    sample_weight = ins.get("SampleWeight", [None])[0]
+    probs = ins.get("CustomDistProbs", [None])[0]
+    num_total = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    sampler = int(attrs.get("sampler", 0))
+
+    n = x.shape[0]
+    label = label.reshape(n, -1).astype(jnp.int32)
+    num_true = label.shape[1]
+
+    key = ctx.rng()
+    if sampler == 1:
+        # log-uniform: F^{-1}(u) = exp(u * log(range+1)) - 1
+        u = jax.random.uniform(key, (n, num_neg))
+        neg = (jnp.exp(u * jnp.log(float(num_total) + 1.0)) - 1.0)
+        neg = jnp.clip(neg.astype(jnp.int32), 0, num_total - 1)
+    elif sampler == 2 and probs is not None:
+        logp = jnp.log(jnp.maximum(probs, 1e-20))
+        neg = jax.random.categorical(key, logp, shape=(n, num_neg))
+        neg = neg.astype(jnp.int32)
+    else:
+        neg = jax.random.randint(key, (n, num_neg), 0, num_total,
+                                 dtype=jnp.int32)
+
+    samples = jnp.concatenate([label, neg], axis=1)       # (N, T+S)
+    w = weight[samples]                                   # (N, T+S, D)
+    logit = jnp.einsum("nd,nsd->ns", x, w)
+    if bias is not None:
+        logit = logit + bias.reshape(-1)[samples]
+    o = jnp.exp(logit)
+    b = _noise_prob(sampler, samples, num_total, probs) * num_neg
+    b = b.astype(o.dtype)
+    is_true = (jnp.arange(samples.shape[1]) < num_true)[None, :]
+    cost = jnp.where(is_true,
+                     -jnp.log(o / (o + b)),
+                     -jnp.log(b / (o + b)))
+    sw = (sample_weight.reshape(n, 1).astype(cost.dtype)
+          if sample_weight is not None else 1.0)
+    total = jnp.sum(cost * sw, axis=1, keepdims=True)
+    return {"Cost": [total], "SampleLogits": [logit],
+            "SampleLabels": [samples.astype(jnp.int64)]}
+
+
+@register("sample_logits",
+          no_grad_slots=("Labels", "CustomizedSamples",
+                         "CustomizedProbabilities"))
+def _sample_logits(ctx, ins, attrs):
+    """reference sample_logits_op.cc: subsample the softmax vocabulary —
+    gather logits at [true, sampled] classes, subtract log(q) (sampled
+    softmax correction), remap labels to sample space."""
+    logits = ins["Logits"][0]                # (N, C)
+    labels = ins["Labels"][0].astype(jnp.int32)  # (N, T)
+    num_samples = int(attrs.get("num_samples", 10))
+    use_customized = ins.get("CustomizedSamples", [None])[0] is not None
+    remove_accidental_hits = bool(attrs.get("remove_accidental_hits", True))
+    n, c = logits.shape
+    num_true = labels.shape[1]
+
+    if use_customized:
+        samples = ins["CustomizedSamples"][0].astype(jnp.int32)
+        probabilities = ins["CustomizedProbabilities"][0]
+    else:
+        # log-uniform sampler, same as the reference's default
+        u = jax.random.uniform(ctx.rng(), (n, num_samples))
+        neg = (jnp.exp(u * jnp.log(float(c) + 1.0)) - 1.0)
+        neg = jnp.clip(neg.astype(jnp.int32), 0, c - 1)
+        samples = jnp.concatenate([labels, neg], axis=1)
+        k = samples.astype(jnp.float32)
+        probabilities = jnp.log((k + 2.0) / (k + 1.0)) / jnp.log(c + 1.0)
+
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    sampled_logits = picked - jnp.log(
+        jnp.maximum(probabilities, 1e-20)).astype(picked.dtype)
+    if remove_accidental_hits:
+        # a negative equal to a true label gets -inf-ish logit
+        hit = (samples[:, None, :] == labels[:, :, None]).any(axis=1)
+        hit = hit & (jnp.arange(samples.shape[1]) >= num_true)[None, :]
+        sampled_logits = jnp.where(hit, sampled_logits - 1e20,
+                                   sampled_logits)
+    sampled_label = jnp.broadcast_to(
+        jnp.arange(num_true, dtype=jnp.int64)[None, :], (n, num_true))
+    return {"SampledLogits": [sampled_logits],
+            "Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [probabilities],
+            "SampledLabels": [sampled_label]}

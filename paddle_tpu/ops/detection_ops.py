@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import register
 
@@ -36,6 +37,15 @@ def _iou_matrix(a, b, normalized=True):
     inter = iw * ih
     union = area(a)[:, None] + area(b)[None, :] - inter
     return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _rois_batch_idx(rois_num, r):
+    """Map flat RoI rows to their source image: RoisNum gives per-image
+    counts; None means single-image batch 0."""
+    if rois_num is None:
+        return jnp.zeros((r,), jnp.int32)
+    counts = rois_num.reshape(-1)
+    return jnp.searchsorted(jnp.cumsum(counts), jnp.arange(r), side="right")
 
 
 # ------------------------------------------------------------- iou
@@ -365,13 +375,7 @@ def _roi_align(ctx, ins, attrs):
     aligned = bool(attrs.get("aligned", False))
     n, c, h, w = x.shape
     r = rois.shape[0]
-    if rois_num is not None:
-        # rois grouped per image: batch index from cumulative counts
-        counts = rois_num.reshape(-1)
-        batch_idx = jnp.searchsorted(
-            jnp.cumsum(counts), jnp.arange(r), side="right")
-    else:
-        batch_idx = jnp.zeros((r,), jnp.int32)
+    batch_idx = _rois_batch_idx(rois_num, r)
 
     half = 0.5 if aligned else 0.0
 
@@ -411,6 +415,413 @@ def _roi_align(ctx, ins, attrs):
                + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
         val = val.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
         return val
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+@register("roi_pool", no_grad_slots=("ROIs", "RoisNum"),
+          nondiff_outputs=("Argmax",))
+def _roi_pool(ctx, ins, attrs):
+    """RoIPool (reference detection/roi_pool... operators/roi_pool_op.cc):
+    quantized-bin max pooling. Bins are computed with the reference's
+    rounding; max over each bin via a per-bin membership mask (static
+    shapes — the O(ph*pw*H*W) mask is fine at RoI-head sizes)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    rois_num = ins.get("RoisNum", [None])[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_idx = _rois_batch_idx(rois_num, r)
+    neg = jnp.finfo(x.dtype).min
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(roi, bi):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        bi_ = jnp.arange(ph)[:, None]
+        bj_ = jnp.arange(pw)[None, :]
+        hstart = jnp.clip(jnp.floor(bi_ * bin_h) + y1, 0, h)
+        hend = jnp.clip(jnp.ceil((bi_ + 1) * bin_h) + y1, 0, h)
+        wstart = jnp.clip(jnp.floor(bj_ * bin_w) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((bj_ + 1) * bin_w) + x1, 0, w)
+        # membership masks: (ph, pw, H, W)
+        in_y = ((ys[None, None, :] >= hstart[:, :, None])
+                & (ys[None, None, :] < hend[:, :, None]))
+        in_x = ((xs[None, None, :] >= wstart[:, :, None])
+                & (xs[None, None, :] < wend[:, :, None]))
+        mask = in_y[:, :, :, None] & in_x[:, :, None, :]
+        img = x[bi]                              # (C, H, W)
+        masked = jnp.where(mask[None], img[:, None, None], neg)
+        val = masked.max(axis=(-1, -2))
+        amax = masked.reshape(c, ph, pw, -1).argmax(axis=-1)
+        empty = ~mask.any(axis=(-1, -2))
+        val = jnp.where(empty[None], 0.0, val)
+        return val, jnp.where(empty[None], -1, amax)
+
+    out, argmax = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out], "Argmax": [argmax.astype(jnp.int64)]}
+
+
+@register("psroi_pool", no_grad_slots=("ROIs", "RoisNum"))
+def _psroi_pool(ctx, ins, attrs):
+    """PSRoIPool (reference detection/psroi_pool_op.cc): position-
+    sensitive average pooling — bin (i,j) of output channel c averages
+    input channel c*ph*pw + i*pw + j over the bin region."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    rois_num = ins.get("RoisNum", [None])[0]
+    oc = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_idx = _rois_batch_idx(rois_num, r)
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(roi, bi):
+        # reference rounds the roi to integer grid then adds 1px slack
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = jnp.round(roi[2] + 1.0) * scale
+        y2 = jnp.round(roi[3] + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        bi_ = jnp.arange(ph)[:, None]
+        bj_ = jnp.arange(pw)[None, :]
+        hstart = jnp.clip(jnp.floor(bi_ * bin_h + y1), 0, h)
+        hend = jnp.clip(jnp.ceil((bi_ + 1) * bin_h + y1), 0, h)
+        wstart = jnp.clip(jnp.floor(bj_ * bin_w + x1), 0, w)
+        wend = jnp.clip(jnp.ceil((bj_ + 1) * bin_w + x1), 0, w)
+        in_y = ((ys[None, None, :] >= hstart[:, :, None])
+                & (ys[None, None, :] < hend[:, :, None]))
+        in_x = ((xs[None, None, :] >= wstart[:, :, None])
+                & (xs[None, None, :] < wend[:, :, None]))
+        mask = (in_y[:, :, :, None] & in_x[:, :, None, :]).astype(x.dtype)
+        area = jnp.maximum(mask.sum(axis=(-1, -2)), 1.0)     # (ph, pw)
+        img = x[bi].reshape(oc, ph, pw, h, w)                # ps groups
+        # per (c,i,j): mean over bin(i,j) of channel c*ph*pw+i*pw+j
+        summed = jnp.einsum("cijhw,ijhw->cij", img, mask)
+        empty = mask.sum(axis=(-1, -2)) == 0
+        return jnp.where(empty[None], 0.0, summed / area[None])
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+def _sce(x, z):
+    """Numerically-stable sigmoid cross entropy, reference
+    yolov3_loss_op.h:34 SigmoidCrossEntropy."""
+    return jax.nn.relu(x) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register("yolov3_loss",
+          no_grad_slots=("GTBox", "GTLabel", "GTScore"),
+          nondiff_outputs=("ObjectnessMask", "GTMatchMask"))
+def _yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 training loss (reference detection/yolov3_loss_op.h:257-400).
+
+    X (N, mask*(5+cls), H, W); GTBox (N, B, 4) cx/cy/w/h in [0,1];
+    GTLabel (N, B); optional GTScore (N, B) (mixup). The reference's
+    quadruple host loop becomes: one vectorized ignore-mask pass (pred
+    boxes vs all gts), then a static python loop over the B gt slots with
+    scatter updates — B is a compile-time constant so XLA unrolls it.
+    """
+    x = ins["X"][0]
+    gtbox = ins["GTBox"][0]
+    gtlabel = ins["GTLabel"][0].astype(jnp.int32)
+    gtscore = ins.get("GTScore", [None])[0]
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+    scale_xy = float(attrs.get("scale_x_y", 1.0))
+    bias_xy = -0.5 * (scale_xy - 1.0)
+
+    n, _, h, w = x.shape
+    b = gtbox.shape[1]
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    attrs_per = 5 + class_num
+    input_size = downsample * h
+    if gtscore is None:
+        gtscore = jnp.ones((n, b), x.dtype)
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        delta = min(1.0 / class_num, 1.0 / 40.0)
+        label_pos, label_neg = 1.0 - delta, delta
+
+    xr = x.reshape(n, mask_num, attrs_per, h, w)
+    aw = jnp.asarray([anchors[2 * i] for i in range(an_num)], x.dtype)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in range(an_num)], x.dtype)
+    maw = jnp.asarray([anchors[2 * m] for m in anchor_mask], x.dtype)
+    mah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask], x.dtype)
+
+    cols = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    rows = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    # pred boxes (reference GetYoloBox — grid_size=h for both axes)
+    px = (cols + jax.nn.sigmoid(xr[:, :, 0]) * scale_xy + bias_xy) / h
+    py = (rows + jax.nn.sigmoid(xr[:, :, 1]) * scale_xy + bias_xy) / h
+    pw_ = jnp.exp(xr[:, :, 2]) * maw[None, :, None, None] / input_size
+    ph_ = jnp.exp(xr[:, :, 3]) * mah[None, :, None, None] / input_size
+
+    gt_valid = (gtbox[:, :, 2] > 1e-6) & (gtbox[:, :, 3] > 1e-6)
+
+    def _iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+        l = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+        r_ = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+        t = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+        bo = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+        inter = jnp.maximum(r_ - l, 0.0) * jnp.maximum(bo - t, 0.0)
+        union = w1 * h1 + w2 * h2 - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    # ignore mask: best pred-gt IoU per cell
+    iou = _iou_cwh(px[..., None], py[..., None], pw_[..., None],
+                   ph_[..., None],
+                   gtbox[:, None, None, None, :, 0],
+                   gtbox[:, None, None, None, :, 1],
+                   gtbox[:, None, None, None, :, 2],
+                   gtbox[:, None, None, None, :, 3])
+    iou = jnp.where(gt_valid[:, None, None, None, :], iou, 0.0)
+    best_iou = iou.max(axis=-1)                   # (N, mask, H, W)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+    obj_mask = obj_mask.astype(x.dtype)
+
+    loss = jnp.zeros((n,), x.dtype)
+    match_mask = jnp.full((n, b), -1, jnp.int32)
+    mask_lookup = jnp.full((an_num,), -1, jnp.int32)
+    for mi, m in enumerate(anchor_mask):
+        mask_lookup = mask_lookup.at[m].set(mi)
+    narange = jnp.arange(n)
+
+    for t in range(b):
+        gx, gy = gtbox[:, t, 0], gtbox[:, t, 1]
+        gw, gh = gtbox[:, t, 2], gtbox[:, t, 3]
+        valid = gt_valid[:, t]
+        score = gtscore[:, t]
+        # best anchor by shape-only IoU
+        a_iou = _iou_cwh(0.0, 0.0, gw[:, None], gh[:, None], 0.0, 0.0,
+                         (aw / input_size)[None, :],
+                         (ah / input_size)[None, :])
+        best_n = jnp.argmax(a_iou, axis=1)
+        mask_idx = mask_lookup[best_n]
+        matched = valid & (mask_idx >= 0)
+        mi_c = jnp.maximum(mask_idx, 0)
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        sel = xr[narange, mi_c, :, gj, gi]        # (N, attrs_per)
+        tx = gx * w - gi
+        ty = gy * h - gj
+        tw = jnp.log(jnp.maximum(gw, 1e-9) * input_size / aw[best_n])
+        th = jnp.log(jnp.maximum(gh, 1e-9) * input_size / ah[best_n])
+        sc = (2.0 - gw * gh) * score
+        loc = (_sce(sel[:, 0], tx) + _sce(sel[:, 1], ty)
+               + jnp.abs(sel[:, 2] - tw) + jnp.abs(sel[:, 3] - th)) * sc
+        lab = gtlabel[:, t]
+        tgt = jnp.where(jnp.arange(class_num)[None, :] == lab[:, None],
+                        label_pos, label_neg)
+        cls = jnp.sum(_sce(sel[:, 5:], tgt), axis=1) * score
+        loss = loss + jnp.where(matched, loc + cls, 0.0)
+        old = obj_mask[narange, mi_c, gj, gi]
+        obj_mask = obj_mask.at[narange, mi_c, gj, gi].set(
+            jnp.where(matched, score, old))
+        match_mask = match_mask.at[:, t].set(
+            jnp.where(valid, mask_idx, -1))
+
+    obj_logit = xr[:, :, 4]
+    pos_l = jnp.where(obj_mask > 1e-5, _sce(obj_logit, 1.0) * obj_mask, 0.0)
+    neu_l = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                      _sce(obj_logit, 0.0), 0.0)
+    loss = loss + (pos_l + neu_l).sum(axis=(1, 2, 3))
+    return {"Loss": [loss], "ObjectnessMask": [obj_mask],
+            "GTMatchMask": [match_mask]}
+
+
+@register("density_prior_box", not_differentiable=True)
+def _density_prior_box(ctx, ins, attrs):
+    """reference detection/density_prior_box_op.h:40-140 (SSD-variant
+    densified anchors): per fixed_size s with density d, a d x d grid of
+    shifted centers inside each step cell, crossed with fixed_ratios."""
+    feat = ins["Input"][0]
+    img = ins["Image"][0]
+    fixed_sizes = [float(v) for v in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(v) for v in attrs.get("fixed_ratios", [])]
+    densities = [int(v) for v in attrs.get("densities", [])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(attrs.get("clip", False))
+    offset = float(attrs.get("offset", 0.5))
+    img_h, img_w = img.shape[2], img.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / fw
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / fh
+    step_avg = int(0.5 * (step_w + step_h))
+
+    cx = (jnp.arange(fw) + offset) * step_w            # (W,)
+    cy = (jnp.arange(fh) + offset) * step_h            # (H,)
+    cxg = jnp.broadcast_to(cx[None, :], (fh, fw))
+    cyg = jnp.broadcast_to(cy[:, None], (fh, fw))
+
+    boxes = []
+    for s, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for r in fixed_ratios:
+            bw = s * float(np.sqrt(r))
+            bh = s / float(np.sqrt(r))
+            d0x = cxg - step_avg / 2.0 + shift / 2.0
+            d0y = cyg - step_avg / 2.0 + shift / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    ccx = d0x + dj * shift
+                    ccy = d0y + di * shift
+                    boxes.append(jnp.stack([
+                        (ccx - bw / 2.0) / img_w, (ccy - bh / 2.0) / img_h,
+                        (ccx + bw / 2.0) / img_w, (ccy + bh / 2.0) / img_h,
+                    ], axis=-1))
+    out = jnp.stack(boxes, axis=2)                     # (H, W, P, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    if attrs.get("flatten_to_2d", False):
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register("matrix_nms", not_differentiable=True)
+def _matrix_nms(ctx, ins, attrs):
+    """reference detection/matrix_nms_op.cc:94-230 (SOLOv2 Matrix NMS):
+    soft suppression by decay = min_j f(iou_ij)/f(iou_max_j), no hard
+    sequential loop — O(n^2) tensor math, exactly what the TPU wants.
+    Fixed-capacity output (keep_top_k rows, label -1 padding)."""
+    bboxes = ins["BBoxes"][0]       # (N, M, 4)
+    scores = ins["Scores"][0]       # (N, C, M)
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    post_thresh = float(attrs.get("post_threshold", 0.0))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    background = int(attrs.get("background_label", 0))
+    use_gaussian = bool(attrs.get("use_gaussian", False))
+    sigma = float(attrs.get("gaussian_sigma", 2.0))
+    normalized = bool(attrs.get("normalized", True))
+    n, c, m = scores.shape
+    pre = m if nms_top_k <= 0 else min(nms_top_k, m)
+
+    def one_class(boxes, sc):
+        sc = jnp.where(sc > score_thresh, sc, 0.0)
+        order = jnp.argsort(-sc)[:pre]
+        s = sc[order]
+        b = boxes[order]
+        iou = _iou_matrix(b, b, normalized)
+        tri = jnp.tril(iou, k=-1)                      # j < i
+        iou_max = jnp.max(tri, axis=1)                 # per row
+        # decay_ij[i, j] = f(iou(i, j), iou_max(j)) for j < i
+        if use_gaussian:
+            decay_ij = jnp.exp((iou_max[None, :] ** 2 - tri ** 2) * sigma)
+        else:
+            decay_ij = (1.0 - tri) / jnp.maximum(1.0 - iou_max[None, :],
+                                                 1e-10)
+        # decay for i = min over j<i; mask j>=i with +inf
+        jmask = jnp.arange(pre)[:, None] > jnp.arange(pre)[None, :]
+        decay = jnp.min(jnp.where(jmask, decay_ij, jnp.inf), axis=1)
+        decay = jnp.where(jnp.isfinite(decay), decay, 1.0)
+        ds = decay * s
+        ds = jnp.where(ds > post_thresh, ds, 0.0)
+        return ds, order
+
+    out_rows, out_idx = [], []
+    for ci in range(c):
+        if ci == background:
+            continue
+        ds, order = jax.vmap(one_class)(bboxes, scores[:, ci])
+        cls = jnp.full(ds.shape, float(ci))
+        out_rows.append((cls, ds, order))
+
+    all_cls = jnp.concatenate([r[0] for r in out_rows], axis=1)
+    all_ds = jnp.concatenate([r[1] for r in out_rows], axis=1)
+    all_ord = jnp.concatenate([r[2] for r in out_rows], axis=1)
+    keep = all_ds.shape[1] if keep_top_k <= 0 else min(keep_top_k,
+                                                       all_ds.shape[1])
+    top = jnp.argsort(-all_ds, axis=1)[:, :keep]
+    sel_ds = jnp.take_along_axis(all_ds, top, axis=1)
+    sel_cls = jnp.take_along_axis(all_cls, top, axis=1)
+    sel_ord = jnp.take_along_axis(all_ord, top, axis=1)
+    sel_box = jnp.take_along_axis(bboxes, sel_ord[..., None], axis=1)
+    live = sel_ds > 0
+    out = jnp.concatenate([
+        jnp.where(live, sel_cls, -1.0)[..., None], sel_ds[..., None],
+        sel_box], axis=-1)                              # (N, keep, 6)
+    counts = live.sum(axis=1).astype(jnp.int32)
+    return {"Out": [out.reshape(-1, 6)],
+            "Index": [(sel_ord + jnp.arange(n)[:, None] * m)
+                      .reshape(-1, 1).astype(jnp.int32)],
+            "RoisNum": [counts]}
+
+
+def _tri_integral(t):
+    """Antiderivative of the bilinear triangle kernel max(0, 1-|t|):
+    g(t) = integral_{-1}^{t} max(0, 1-|s|) ds, clamped to [0, 1]."""
+    t = jnp.clip(t, -1.0, 1.0)
+    neg = 0.5 * jnp.square(t + 1.0)
+    pos = 0.5 + t - 0.5 * jnp.square(t)
+    return jnp.where(t < 0, neg, pos)
+
+
+@register("prroi_pool", no_grad_slots=("ROIs", "BatchRoINums"))
+def _prroi_pool(ctx, ins, attrs):
+    """Precise RoI pooling (reference detection/prroi_pool... operators/
+    prroi_pool_op.cc): exact integral of the bilinearly-interpolated
+    feature over each bin. The separable closed form — per-pixel weight =
+    (integral of the triangle kernel over the bin x-range) x (same in y),
+    normalized by bin area — turns the reference's per-sample CUDA loop
+    into one einsum."""
+    x = jnp.asarray(ins["X"][0])
+    rois = ins["ROIs"][0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    rois_num = ins.get("BatchRoINums", [None])[0]
+    batch_idx = _rois_batch_idx(rois_num, r)
+    ys = jnp.arange(h, dtype=x.dtype)
+    xs = jnp.arange(w, dtype=x.dtype)
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = (roi[0] * scale, roi[1] * scale,
+                          roi[2] * scale, roi[3] * scale)
+        bw = jnp.maximum((x2 - x1) / pw, 1e-6)
+        bh = jnp.maximum((y2 - y1) / ph, 1e-6)
+        bj = jnp.arange(pw, dtype=x.dtype)
+        bi_ = jnp.arange(ph, dtype=x.dtype)
+        ax = x1 + bj * bw          # (pw,) bin starts
+        ay = y1 + bi_ * bh
+        # weight of pixel p for bin starting at a: g(a+len-p) - g(a-p)
+        wx = (_tri_integral(ax[:, None] + bw - xs[None, :])
+              - _tri_integral(ax[:, None] - xs[None, :]))   # (pw, W)
+        wy = (_tri_integral(ay[:, None] + bh - ys[None, :])
+              - _tri_integral(ay[:, None] - ys[None, :]))   # (ph, H)
+        val = jnp.einsum("chw,ih,jw->cij", x[bi], wy, wx)
+        return val / (bw * bh)
 
     out = jax.vmap(one_roi)(rois, batch_idx)
     return {"Out": [out]}

@@ -1,0 +1,435 @@
+"""Image / spatial op lowerings.
+
+Analogs of paddle/fluid/operators/{interpolate_op.cc (linear/trilinear
+modes), grid_sampler_op.cc, affine_grid_op.cc, affine_channel_op.cc,
+pixel_shuffle_op.cc, space_to_depth_op.cc, shuffle_channel_op.cc,
+temporal_shift_op.cc, lrn_op.cc, crop_op.cc, crop_tensor_op.cc,
+pad_constant_like_op.cc, unfold_op.cc, unpool_op.cc,
+pool_with_index_op.cc}.
+
+The reference's hand-rolled CUDA gather/scatter kernels become static
+reshape/stack/gather emitters: everything here has static shapes so XLA can
+tile it; patch extraction (im2col, pool-with-index) uses python-unrolled
+static strided slices — unrolled at trace time, fused by XLA, no dynamic
+loop on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# interpolate: 1D / 3D variants (2D lives in nn_ops._interp)
+# ---------------------------------------------------------------------------
+
+
+def _interp_nd(name, method, spatial):
+    @register(name)
+    def _lower(ctx, ins, attrs, _m=method, _nd=spatial):
+        """reference interpolate_op.cc — N-D resize via jax.image (vjp
+        gives the adjoint resize for the gradient)."""
+        x = ins["X"][0]  # NC + spatial
+        keys = ["out_d", "out_h", "out_w"][-_nd:]
+        sizes = [int(attrs.get(k, -1) or -1) for k in keys]
+        scale = attrs.get("scale", 0.0)
+        for i in range(_nd):
+            if sizes[i] <= 0:
+                if not scale:
+                    raise ValueError(f"{name}: need out sizes or scale")
+                sizes[i] = int(x.shape[2 + i] * scale)
+        shape = x.shape[:2] + tuple(sizes)
+        return {"Out": [jax.image.resize(x, shape, method=_m)]}
+    return _lower
+
+
+_interp_nd("linear_interp", "linear", 1)
+_interp_nd("linear_interp_v2", "linear", 1)
+_interp_nd("trilinear_interp", "linear", 3)
+_interp_nd("trilinear_interp_v2", "linear", 3)
+_interp_nd("bicubic_interp", "cubic", 2)
+
+
+# ---------------------------------------------------------------------------
+# grid sampling
+# ---------------------------------------------------------------------------
+
+
+@register("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    """reference affine_grid_op.cc: Theta (N,2,3) -> flow field (N,H,W,2)."""
+    theta = ins["Theta"][0]
+    if ins.get("OutputShape", [None])[0] is not None:
+        oshape = [int(v) for v in np.asarray(ins["OutputShape"][0])]
+    else:
+        oshape = [int(v) for v in attrs.get("output_shape")]
+    n, _, h, w = oshape
+    align = bool(attrs.get("align_corners", True))
+
+    def _axis(size):
+        if align:
+            return jnp.linspace(-1.0, 1.0, size, dtype=theta.dtype)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size,
+                            dtype=theta.dtype)
+
+    xs = _axis(w)[None, :].repeat(h, 0)          # (H, W)
+    ys = _axis(h)[:, None].repeat(w, 1)
+    ones = jnp.ones_like(xs)
+    base = jnp.stack([xs, ys, ones], axis=-1)    # (H, W, 3)
+    # out[n,h,w,k] = sum_j base[h,w,j] * theta[n,k,j]
+    out = jnp.einsum("hwj,nkj->nhwk", base, theta)
+    return {"Output": [out]}
+
+
+@register("grid_sampler", no_grad_slots=())
+def _grid_sampler(ctx, ins, attrs):
+    """reference grid_sampler_op.cc: bilinear/nearest sampling of X
+    (N,C,H,W) at Grid (N,Ho,Wo,2) normalized coords."""
+    x = ins["X"][0]
+    grid = ins["Grid"][0]
+    align = bool(attrs.get("align_corners", True))
+    mode = attrs.get("mode", "bilinear")
+    pad = attrs.get("padding_mode", "zeros")
+    n, c, h, w = x.shape
+
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def _unnorm(g, size):
+        if align:
+            return (g + 1.0) / 2.0 * (size - 1)
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    fx = _unnorm(gx, w)
+    fy = _unnorm(gy, h)
+
+    def _reflect(v, lo, hi):
+        # reflect into [lo, hi] (continuous reflection, reference
+        # grid_sampler pad=reflection semantics)
+        rng = hi - lo
+        if rng <= 0:
+            return jnp.zeros_like(v)
+        v = jnp.abs(v - lo) % (2 * rng)
+        return lo + jnp.where(v > rng, 2 * rng - v, v)
+
+    if pad == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif pad == "reflection":
+        if align:
+            fx = _reflect(fx, 0.0, float(w - 1))
+            fy = _reflect(fy, 0.0, float(h - 1))
+        else:
+            fx = jnp.clip(_reflect(fx, -0.5, w - 0.5), 0, w - 1)
+            fy = jnp.clip(_reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+    def _gather(ix, iy):
+        """x[n, :, iy, ix] with zero padding out of range; ix/iy (N,Ho,Wo)."""
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        flat = x.reshape(n, c, h * w)
+        idx = (iyc * w + ixc).reshape(n, 1, -1)          # (N,1,Ho*Wo)
+        got = jnp.take_along_axis(flat, jnp.broadcast_to(
+            idx, (n, c, idx.shape[-1])), axis=2)
+        got = got.reshape(n, c, *ix.shape[1:])
+        return got * valid[:, None].astype(x.dtype)
+
+    if mode == "nearest":
+        out = _gather(jnp.round(fx).astype(jnp.int32),
+                      jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(x.dtype)[:, None]
+        wy = (fy - y0).astype(x.dtype)[:, None]
+        out = (_gather(x0, y0) * (1 - wx) * (1 - wy)
+               + _gather(x1, y0) * wx * (1 - wy)
+               + _gather(x0, y1) * (1 - wx) * wy
+               + _gather(x1, y1) * wx * wy)
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# channel rearrangement family
+# ---------------------------------------------------------------------------
+
+
+@register("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    """reference affine_channel_op.cc: per-channel scale + bias."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(-1)
+    bias = ins["Bias"][0].reshape(-1)
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NHWC":
+        return {"Out": [x * scale + bias]}
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    """reference pixel_shuffle_op.cc: (N, C*r^2, H, W)->(N, C, H*r, W*r)."""
+    x = ins["X"][0]
+    r = int(attrs.get("upscale_factor", 1))
+    layout = attrs.get("data_format", "NCHW")
+    if layout == "NHWC":
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, c // (r * r), r, r)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        out = x.reshape(n, h * r, w * r, c // (r * r))
+    else:
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        out = x.reshape(n, c // (r * r), h * r, w * r)
+    return {"Out": [out]}
+
+
+@register("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    """reference space_to_depth_op.cc: (N,C,H,W)->(N,C*b^2,H/b,W/b)."""
+    x = ins["X"][0]
+    b = int(attrs.get("blocksize", 1))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [x.reshape(n, c * b * b, h // b, w // b)]}
+
+
+@register("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    """reference shuffle_channel_op.cc: interleave channel groups."""
+    x = ins["X"][0]
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    x = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+    return {"Out": [x.reshape(n, c, h, w)]}
+
+
+@register("temporal_shift")
+def _temporal_shift(ctx, ins, attrs):
+    """reference temporal_shift_op.cc (TSM): shift a slice of channels one
+    step backward/forward along the segment axis."""
+    x = ins["X"][0]  # (N*T, C, H, W)
+    t = int(attrs.get("seg_num", 1))
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    v = x.reshape(n, t, c, h, w)
+    zeros = jnp.zeros((n, 1, c, h, w), x.dtype)
+    fwd = jnp.concatenate([v[:, 1:], zeros], axis=1)    # t <- t+1
+    bwd = jnp.concatenate([zeros, v[:, :-1]], axis=1)   # t <- t-1
+    out = jnp.concatenate(
+        [fwd[:, :, :c1], bwd[:, :, c1:c2], v[:, :, c2:]], axis=2)
+    return {"Out": [out.reshape(nt, c, h, w)]}
+
+
+@register("lrn", grad_needs_outputs=("MidOut",))
+def _lrn(ctx, ins, attrs):
+    """reference lrn_op.cc: across-channel local response normalization.
+
+    mid = k + alpha * sum_{window n} x^2 ; out = x / mid^beta
+    """
+    x = ins["X"][0]
+    n_size = int(attrs.get("n", 5))
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = x * x
+    half = n_size // 2
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (half, n_size - half - 1)
+    sqp = jnp.pad(sq, pad)
+    acc = sum(sqp[:, i:i + x.shape[1]] for i in range(n_size))
+    mid = k + alpha * acc
+    return {"Out": [x * jnp.power(mid, -beta)], "MidOut": [mid]}
+
+
+# ---------------------------------------------------------------------------
+# crop / pad
+# ---------------------------------------------------------------------------
+
+
+def _crop_impl(ctx, ins, attrs):
+    x = ins["X"][0]
+    if ins.get("Offsets", [None])[0] is not None:
+        offsets = [int(v) for v in np.asarray(ins["Offsets"][0])]
+    else:
+        offsets = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
+    if ins.get("Shape", [None])[0] is not None:
+        shape = [int(v) for v in np.asarray(ins["Shape"][0])]
+    else:
+        shape = [int(v) for v in attrs.get("shape")]
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[sl]]}
+
+
+@register("crop", no_grad_slots=("Y", "Offsets"))
+def _crop(ctx, ins, attrs):
+    """reference crop_op.cc (shape may come from a Y reference tensor)."""
+    if ins.get("Y", [None])[0] is not None and "shape" not in attrs:
+        attrs = dict(attrs, shape=list(ins["Y"][0].shape))
+    return _crop_impl(ctx, ins, attrs)
+
+
+@register("crop_tensor", no_grad_slots=("Shape", "Offsets"))
+def _crop_tensor(ctx, ins, attrs):
+    """reference crop_tensor_op.cc."""
+    return _crop_impl(ctx, ins, attrs)
+
+
+@register("pad_constant_like", no_grad_slots=("X",))
+def _pad_constant_like(ctx, ins, attrs):
+    """reference pad_constant_like_op.cc: place Y at the origin of an
+    X-shaped tensor filled with pad_value. Grad flows to Y only."""
+    x, y = ins["X"][0], ins["Y"][0]
+    val = attrs.get("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=val)]}
+
+
+# ---------------------------------------------------------------------------
+# im2col family: unfold / pool-with-index / unpool
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return [int(i) for i in v]
+        if len(v) == 2 * n:  # paddle sometimes packs begin/end pairs
+            return [int(i) for i in v[:n]]
+        return [int(v[0])] * n
+    return [int(v)] * n
+
+
+def _extract_patches(x, ksize, strides, paddings, dilations, pad_value=0.0):
+    """(N,C,H,W) -> (N, C, kh*kw, Ho, Wo) via static strided slices."""
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)],
+                 constant_values=pad_value)
+    H, W = xp.shape[2], xp.shape[3]
+    ho = (H - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (W - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            r0, c0 = i * dh, j * dw
+            cols.append(xp[:, :, r0:r0 + (ho - 1) * sh + 1:sh,
+                           c0:c0 + (wo - 1) * sw + 1:sw])
+    return jnp.stack(cols, axis=2), ho, wo
+
+
+@register("unfold")
+def _unfold(ctx, ins, attrs):
+    """reference unfold_op.cc (im2col): (N,C,H,W)->(N, C*kh*kw, Ho*Wo)."""
+    x = ins["X"][0]
+    k = _pair(attrs["kernel_sizes"])
+    s = _pair(attrs.get("strides", [1, 1]))
+    p = _pair(attrs.get("paddings", [0, 0]))
+    d = _pair(attrs.get("dilations", [1, 1]))
+    patches, ho, wo = _extract_patches(x, k, s, p, d)
+    n, c = x.shape[:2]
+    return {"Y": [patches.reshape(n, c * k[0] * k[1], ho * wo)]}
+
+
+@register("max_pool2d_with_index", nondiff_outputs=("Mask",))
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """reference pool_with_index_op.cc: max pool + flat per-plane argmax
+    index (h_in * W + w_in) used by unpool."""
+    x = ins["X"][0]
+    k = _pair(attrs["ksize"])
+    s = _pair(attrs.get("strides", [1, 1]))
+    p = _pair(attrs.get("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    if attrs.get("global_pooling", False):
+        k, p = [h, w], [0, 0]
+    if attrs.get("adaptive", False):
+        # adaptive: output k, windows h//k
+        oh, ow = k
+        k = [h // oh, w // ow]
+        s = list(k)
+        p = [0, 0]
+    neg = jnp.finfo(x.dtype).min
+    patches, ho, wo = _extract_patches(x, k, s, p, [1, 1], pad_value=neg)
+    amax = jnp.argmax(patches, axis=2)            # (N,C,Ho,Wo)
+    out = jnp.max(patches, axis=2)
+    # decode patch-local argmax to global (h_in * W + w_in), accounting
+    # for padding offsets
+    ki = amax // k[1]
+    kj = amax % k[1]
+    hi = jnp.arange(ho)[None, None, :, None] * s[0] + ki - p[0]
+    wi = jnp.arange(wo)[None, None, None, :] * s[1] + kj - p[1]
+    mask = (hi * w + wi).astype(jnp.int32)
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register("max_pool3d_with_index", nondiff_outputs=("Mask",))
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """3D variant of pool_with_index (reference pool_with_index_op.cc:215)."""
+    x = ins["X"][0]  # (N,C,D,H,W)
+    k = _pair(attrs["ksize"], 3)
+    s = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    p = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    n, c, d, h, w = x.shape
+    if attrs.get("global_pooling", False):
+        k, p = [d, h, w], [0, 0, 0]
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + [(pi, pi) for pi in p],
+                 constant_values=neg)
+    do = (xp.shape[2] - k[0]) // s[0] + 1
+    ho = (xp.shape[3] - k[1]) // s[1] + 1
+    wo = (xp.shape[4] - k[2]) // s[2] + 1
+    cols = []
+    for a in range(k[0]):
+        for b in range(k[1]):
+            for e in range(k[2]):
+                cols.append(xp[:, :, a:a + (do - 1) * s[0] + 1:s[0],
+                               b:b + (ho - 1) * s[1] + 1:s[1],
+                               e:e + (wo - 1) * s[2] + 1:s[2]])
+    patches = jnp.stack(cols, axis=2)
+    amax = jnp.argmax(patches, axis=2)
+    out = jnp.max(patches, axis=2)
+    ka = amax // (k[1] * k[2])
+    kb = (amax // k[2]) % k[1]
+    ke = amax % k[2]
+    di = jnp.arange(do)[None, None, :, None, None] * s[0] + ka - p[0]
+    hi = jnp.arange(ho)[None, None, None, :, None] * s[1] + kb - p[1]
+    wi = jnp.arange(wo)[None, None, None, None, :] * s[2] + ke - p[2]
+    mask = ((di * h + hi) * w + wi).astype(jnp.int32)
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register("unpool", no_grad_slots=("Indices",))
+def _unpool(ctx, ins, attrs):
+    """reference unpool_op.cc: max unpooling — scatter X into zeros at the
+    per-plane flat Indices from max_pool2d_with_index."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0].astype(jnp.int32)
+    k = _pair(attrs.get("ksize", [2, 2]))
+    s = _pair(attrs.get("strides", [1, 1]))
+    p = _pair(attrs.get("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    ho = (h - 1) * s[0] - 2 * p[0] + k[0]
+    wo = (w - 1) * s[1] - 2 * p[1] + k[1]
+    flat = jnp.zeros((n, c, ho * wo), x.dtype)
+    nc_idx = idx.reshape(n, c, -1)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        nc_idx].add(x.reshape(n, c, -1))
+    return {"Out": [out.reshape(n, c, ho, wo)]}

@@ -1,0 +1,246 @@
+"""Indexing / selection / misc tensor op lowerings.
+
+Analogs of paddle/fluid/operators/{masked_select_op.cc, index_sample_op.cc,
+multiplex_op.cc, reverse_op.cc, scatter_nd_add_op.cc, gather_tree_op.cc,
+conv_shift_op.cc, row_conv_op.cc, partial_concat_op.cc, partial_sum_op.cc,
+shuffle_batch_op.cc, selu_op.cc, mish_op.cc, expand_op.cc, expand_as_op.cc,
+flatten_op.cc, squeeze_op.cc, unsqueeze_op.cc, im2sequence_op.cc}.
+
+masked_select is the one dynamic-output-shape op here: it works eagerly
+(concrete sizes) and refuses under trace with guidance — the XLA-honest
+stance, same as where_index.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+@register("masked_select", no_grad_slots=("Mask",))
+def _masked_select(ctx, ins, attrs):
+    """reference masked_select_op.cc. Output length = popcount(mask), a
+    data-dependent shape: supported eagerly, error under jit trace."""
+    x, mask = ins["X"][0], ins["Mask"][0]
+    if not ctx.eager or isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "masked_select has data-dependent output shape — not "
+            "XLA-traceable. Use it in dygraph (eager) mode, or keep the "
+            "computation masked: x * mask / where(mask, x, fill).")
+    sel = np.asarray(x)[np.asarray(mask).astype(bool)]
+    return {"Y": [jnp.asarray(sel)]}
+
+
+@register("index_sample", no_grad_slots=("Index",))
+def _index_sample(ctx, ins, attrs):
+    """reference index_sample_op.cc: out[i, j] = x[i, index[i, j]]."""
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)]}
+
+
+@register("multiplex", no_grad_slots=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    """reference multiplex_op.cc: out[i] = X[ids[i]][i] (row-wise select
+    among k candidate tensors)."""
+    xs = jnp.stack(ins["X"], axis=0)          # (k, N, ...)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": [xs[ids, rows]]}
+
+
+@register("reverse")
+def _reverse(ctx, ins, attrs):
+    """reference reverse_op.cc."""
+    axes = attrs.get("axis", [0])
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    out = ins["X"][0]
+    for a in axes:
+        out = jnp.flip(out, int(a))
+    return {"Out": [out]}
+
+
+@register("scatter_nd_add", no_grad_slots=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    """reference scatter_nd_add_op.cc: out = x; out[index] += updates,
+    index (..., K) indexes the first K dims of x."""
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    k = idx.shape[-1]
+    flat_idx = idx.reshape(-1, k)
+    upd_flat = upd.reshape((flat_idx.shape[0],) + x.shape[k:])
+    out = x.at[tuple(flat_idx[:, i] for i in range(k))].add(upd_flat)
+    return {"Out": [out]}
+
+
+@register("gather_tree", not_differentiable=True)
+def _gather_tree(ctx, ins, attrs):
+    """reference gather_tree_op.cc: backtrace full beam-search sequences
+    through parent pointers, time-major (T, B, W)."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    t = ids.shape[0]
+    beams = jnp.arange(ids.shape[2], dtype=parents.dtype)
+    beams = jnp.broadcast_to(beams, ids.shape[1:])
+
+    def step(parent_sel, inputs):
+        step_ids, step_parents = inputs
+        out = jnp.take_along_axis(step_ids, parent_sel, axis=1)
+        nxt = jnp.take_along_axis(step_parents, parent_sel, axis=1)
+        return nxt, out
+
+    _, out_rev = jax.lax.scan(
+        step, beams, (jnp.flip(ids, 0), jnp.flip(parents, 0)))
+    return {"Out": [jnp.flip(out_rev, 0)]}
+
+
+@register("selu")
+def _selu(ctx, ins, attrs):
+    """reference selu_op.cc."""
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0507009873554804934193349852946)
+    alpha = attrs.get("alpha", 1.6732632423543772848170429916717)
+    out = scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    return {"Out": [out]}
+
+
+@register("mish")
+def _mish(ctx, ins, attrs):
+    """reference mish_op.cc: x * tanh(softplus(x)) with threshold."""
+    x = ins["X"][0]
+    threshold = attrs.get("threshold", 20.0)
+    sp = jnp.where(x > threshold, x, jax.nn.softplus(x))
+    return {"Out": [x * jnp.tanh(sp)]}
+
+
+@register("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """reference conv_shift_op.cc: circular correlation (NTM-style
+    shift weighting). X (B, M), Y (B, N) odd N; out (B, M)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    # out[:, i] = sum_k x[:, (i + k - half) % m] * y[:, k]
+    out = sum(jnp.roll(x, half - k, axis=1) * y[:, k:k + 1]
+              for k in range(n))
+    return {"Out": [out]}
+
+
+@register("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """reference row_conv_op.cc (lookahead convolution), dense batched
+    redesign: X (B, T, D), Filter (context, D);
+    out[b, t] = sum_c x[b, t+c] * filter[c]."""
+    x, f = ins["X"][0], ins["Filter"][0]
+    ctx_len = f.shape[0]
+    xp = jnp.pad(x, [(0, 0), (0, ctx_len - 1), (0, 0)])
+    out = sum(xp[:, c:c + x.shape[1]] * f[c] for c in range(ctx_len))
+    return {"Out": [out]}
+
+
+@register("partial_concat")
+def _partial_concat(ctx, ins, attrs):
+    """reference partial_concat_op.cc: slice [start:start+len] of dim 1
+    from each input, concat along dim 1."""
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    parts = []
+    for x in ins["X"]:
+        s = start % x.shape[1] if start < 0 else start
+        e = x.shape[1] if length == -1 else s + length
+        parts.append(x[:, s:e])
+    return {"Out": [jnp.concatenate(parts, axis=1)]}
+
+
+@register("partial_sum")
+def _partial_sum(ctx, ins, attrs):
+    """reference partial_sum_op.cc: like partial_concat but summed."""
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    parts = []
+    for x in ins["X"]:
+        s = start % x.shape[1] if start < 0 else start
+        e = x.shape[1] if length == -1 else s + length
+        parts.append(x[:, s:e])
+    return {"Out": [sum(parts)]}
+
+
+@register("shuffle_batch", no_grad_slots=("Seed",))
+def _shuffle_batch(ctx, ins, attrs):
+    """reference shuffle_batch_op.cc: random row permutation; emits the
+    permutation so embedding grads can be unshuffled."""
+    x = ins["X"][0]
+    n = x.shape[0]
+    perm = jax.random.permutation(ctx.rng(), n)
+    seed_out = (ins["Seed"][0] if ins.get("Seed", [None])[0] is not None
+                else jnp.zeros((1,), jnp.int64))
+    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype(jnp.int64)],
+            "SeedOut": [seed_out]}
+
+
+@register("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    """reference im2sequence_op.cc, dense redesign: extract conv patches
+    as a (N * Ho * Wo, C*kh*kw) sequence batch."""
+    from .image_ops import _extract_patches, _pair
+    x = ins["X"][0]
+    k = _pair(attrs["kernels"])
+    s = _pair(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    p = [int(pads[0]), int(pads[1])] if len(pads) >= 2 else _pair(pads)
+    patches, ho, wo = _extract_patches(x, k, s, p, [1, 1])
+    n, c = x.shape[:2]
+    # (N,C,khkw,Ho,Wo) -> (N,Ho,Wo,C,khkw) -> (N*Ho*Wo, C*kh*kw)
+    seq = patches.transpose(0, 3, 4, 1, 2).reshape(n * ho * wo, -1)
+    return {"Out": [seq]}
+
+
+# -- v1 aliases of v2-shaped ops (attr conventions differ) ------------------
+
+
+@register("expand")
+def _expand_v1(ctx, ins, attrs):
+    """reference expand_op.cc: tile by expand_times (NOT target shape)."""
+    x = ins["X"][0]
+    times = [int(t) for t in attrs["expand_times"]]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("expand_as")
+def _expand_as_v1(ctx, ins, attrs):
+    """reference expand_as_op.cc: tile X up to the shape of target_tensor."""
+    x = ins["X"][0]
+    y = ins.get("target_tensor", ins.get("Y", [None]))[0]
+    times = [ys // xs for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("flatten")
+def _flatten_v1(ctx, ins, attrs):
+    """reference flatten_op.cc: flatten to 2D at `axis` (no XShape)."""
+    x = ins["X"][0]
+    ax = attrs.get("axis", 1)
+    return {"Out": [x.reshape(int(np.prod(x.shape[:ax]) or 1), -1)]}
+
+
+@register("squeeze")
+def _squeeze_v1(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes)
+        shape = [d for i, d in enumerate(x.shape)
+                 if not (i in axes and d == 1)]
+    else:
+        shape = [d for d in x.shape if d != 1]
+    return {"Out": [x.reshape(shape)]}
+
+
+@register("unsqueeze")
+def _unsqueeze_v1(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x
+    for a in sorted(int(a) for a in attrs["axes"]):
+        out = jnp.expand_dims(out, a % (out.ndim + 1))
+    return {"Out": [out]}

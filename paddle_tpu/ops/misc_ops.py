@@ -1,0 +1,643 @@
+"""Miscellaneous op-tail lowerings.
+
+Analogs of paddle/fluid/operators/{allclose_op.cc, diag_op.cc, diag_v2,
+diag_embed_op.cc, histogram (bincount), is_empty_op.cc, maxout_op.cc,
+mean_iou_op.cc, pool3d (pool_op.cc), modified_huber_loss_op.cc,
+add_position_encoding_op.cc, bilinear_tensor_product_op.cc, fill_op.cc,
+fill_constant_batch_size_like_op.cc, fill_zeros_like2,
+gaussian/uniform_random_batch_size_like_op.cc, sampling_id_op.cc, seed_op.cc,
+sequence_reshape_op.cc, sequence_scatter_op.cc, spectral_norm_op.cc,
+teacher_student_sigmoid_loss_op.cc, edit_distance_op.cc, ctc_align_op.cc,
+hierarchical_sigmoid_op.cc, maxout, detection/{polygon_box_transform_op.cc,
+bipartite_match_op.cc, target_assign_op.cc, multiclass_nms2},
+fc_op.cc, shard_index}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from .nn_ops import _conv_padding
+
+
+@register("allclose", not_differentiable=True)
+def _allclose(ctx, ins, attrs):
+    x, y = ins["Input"][0], ins["Other"][0]
+    rtol = float(attrs.get("rtol", 1e-5))
+    atol = float(attrs.get("atol", 1e-8))
+    return {"Out": [jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                 equal_nan=bool(attrs.get("equal_nan",
+                                                          False)))]}
+
+
+@register("diag", not_differentiable=True)
+def _diag_v1(ctx, ins, attrs):
+    """reference diag_op.cc: vector -> diagonal matrix."""
+    return {"Out": [jnp.diag(ins["Diagonal"][0])]}
+
+
+@register("diag_v2")
+def _diag_v2(ctx, ins, attrs):
+    """reference diag_v2: 1D->matrix / 2D->diagonal, with offset."""
+    x = ins["X"][0]
+    offset = int(attrs.get("offset", 0))
+    pad = attrs.get("padding_value", 0.0)
+    out = jnp.diag(x, k=offset)
+    if x.ndim == 1 and pad:
+        n = out.shape[0]
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, pad)
+    return {"Out": [out]}
+
+
+@register("diag_embed")
+def _diag_embed(ctx, ins, attrs):
+    x = ins["Input"][0]
+    offset = int(attrs.get("offset", 0))
+    dim1 = int(attrs.get("dim1", -2))
+    dim2 = int(attrs.get("dim2", -1))
+    out = jnp.zeros(x.shape[:-1] + (x.shape[-1] + abs(offset),) * 2, x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        full = []
+        j = 0
+        for i in range(nd):
+            if i == d1:
+                full.append(nd - 2)
+            elif i == d2:
+                full.append(nd - 1)
+            else:
+                full.append(perm[j])
+                j += 1
+        out = out.transpose(full)
+    return {"Out": [out]}
+
+
+@register("histogram", not_differentiable=True)
+def _histogram(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    bins = int(attrs.get("bins", 100))
+    lo = attrs.get("min", 0)
+    hi = attrs.get("max", 0)
+    lo, hi = (jnp.min(x), jnp.max(x)) if lo == hi == 0 else (lo, hi)
+    counts, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return {"Out": [counts.astype(jnp.int64)]}
+
+
+@register("is_empty", not_differentiable=True)
+def _is_empty(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["X"][0].size == 0)]}
+
+
+@register("maxout")
+def _maxout(ctx, ins, attrs):
+    """reference maxout_op.cc: max over channel groups (NCHW)."""
+    x = ins["X"][0]
+    g = int(attrs.get("groups", 1))
+    axis = int(attrs.get("axis", 1))
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // g, g]
+    return {"Out": [x.reshape(shape).max(axis=axis + 1)]}
+
+
+@register("mean_iou", not_differentiable=True)
+def _mean_iou(ctx, ins, attrs):
+    """reference mean_iou_op.cc: streaming mean IoU from confusion counts."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    k = int(attrs["num_classes"])
+    valid = (label >= 0) & (label < k)
+    safe_l = jnp.where(valid, label, 0)
+    safe_p = jnp.where(valid, pred, 0)
+    ones = valid.astype(jnp.int32)
+    inter = jnp.zeros((k,), jnp.int32).at[safe_l].add(
+        ones * (safe_l == safe_p))
+    pred_c = jnp.zeros((k,), jnp.int32).at[safe_p].add(ones)
+    lab_c = jnp.zeros((k,), jnp.int32).at[safe_l].add(ones)
+    wrong = pred_c + lab_c - 2 * inter
+    for extra in ins.get("InWrongs", []):
+        wrong = wrong + extra
+    correct = inter
+    for extra in ins.get("InCorrects", []):
+        correct = correct + extra
+    union = wrong + correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    for extra in ins.get("InMeanIou", []):
+        miou = miou + extra
+    return {"OutMeanIou": [miou.astype(jnp.float32)],
+            "OutWrong": [wrong], "OutCorrect": [correct]}
+
+
+@register("pool3d")
+def _pool3d(ctx, ins, attrs):
+    """reference pool_op.cc 3D path (NCDHW)."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    paddings = attrs.get("paddings", [0, 0, 0])
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides, paddings = ksize, [0, 0, 0]
+    pad3 = _conv_padding(paddings, 3)
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    pad5 = ((0, 0), (0, 0)) + tuple(pad3)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                    strides5, pad5)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                       strides5, pad5)
+        if attrs.get("exclusive", True):
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                           jax.lax.add, window, strides5,
+                                           pad5)
+            out = summed / jnp.maximum(counts, 1.0)
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": [out]}
+
+
+@register("modified_huber_loss", no_grad_slots=("Y",))
+def _modified_huber_loss(ctx, ins, attrs):
+    """reference modified_huber_loss_op.cc: y in {0,1} -> {-1,1};
+    loss = max(0,1-yv)^2 if yv >= -1 else -4*yv."""
+    x = ins["X"][0]
+    y = ins["Y"][0].astype(x.dtype)
+    yv = (2.0 * y - 1.0) * x
+    inter = jnp.where(yv < -1.0, -4.0 * yv,
+                      jnp.square(jax.nn.relu(1.0 - yv)))
+    return {"Out": [inter], "IntermediateVal": [yv]}
+
+
+@register("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    """reference add_position_encoding_op.h: first-half sin / second-half
+    cos sinusoid added to x*alpha (dense (B, T, D))."""
+    x = ins["X"][0]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    denom = (jnp.power(10000.0, jnp.arange(half, dtype=x.dtype)
+                       / max(half - 1, 1)) if half > 1
+             else jnp.full((1,), 10000.0, x.dtype))
+    val = pos / denom[None, :]
+    pe = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)
+    return {"Out": [x * alpha + pe[None] * beta]}
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """reference bilinear_tensor_product_op.cc:
+    out[b,k] = x[b] @ W[k] @ y[b] + bias[k]."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register("fill", not_differentiable=True)
+def _fill(ctx, ins, attrs):
+    from .registry import np_dtype
+    shape = [int(s) for s in attrs["shape"]]
+    vals = np.asarray(attrs["value"], np.float64).reshape(shape)
+    return {"Out": [jnp.asarray(vals, np_dtype(
+        attrs.get("dtype_str", attrs.get("dtype", "float32"))
+        if isinstance(attrs.get("dtype"), str) else "float32"))]}
+
+
+@register("fill_constant_batch_size_like", not_differentiable=True)
+def _fill_constant_bsl(ctx, ins, attrs):
+    from .registry import np_dtype
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    dt = attrs.get("dtype", "float32")
+    dt = dt if isinstance(dt, str) else "float32"
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), np_dtype(dt))]}
+
+
+@register("fill_zeros_like2", not_differentiable=True)
+def _fill_zeros_like2(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register("uniform_random_batch_size_like", not_differentiable=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = \
+        ref.shape[int(attrs.get("input_dim_idx", 0))]
+    out = jax.random.uniform(ctx.rng(), shape,
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": [out]}
+
+
+@register("gaussian_random_batch_size_like", not_differentiable=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = \
+        ref.shape[int(attrs.get("input_dim_idx", 0))]
+    out = (jax.random.normal(ctx.rng(), shape) * attrs.get("std", 1.0)
+           + attrs.get("mean", 0.0))
+    return {"Out": [out]}
+
+
+@register("sampling_id", not_differentiable=True)
+def _sampling_id(ctx, ins, attrs):
+    """reference sampling_id_op.h: inverse-CDF sample per probability row."""
+    x = ins["X"][0]
+    u = jax.random.uniform(ctx.rng(), (x.shape[0],),
+                           minval=attrs.get("min", 0.0),
+                           maxval=attrs.get("max", 1.0))
+    cdf = jnp.cumsum(x, axis=1)
+    idx = jnp.sum(cdf < u[:, None], axis=1)
+    return {"Out": [jnp.clip(idx, 0, x.shape[1] - 1).astype(jnp.int64)]}
+
+
+@register("seed", not_differentiable=True)
+def _seed(ctx, ins, attrs):
+    s = int(attrs.get("seed", 0))
+    if s == 0:
+        s = int(np.random.randint(1, 2 ** 31 - 1))
+    return {"Out": [jnp.asarray([s], jnp.int32)]}
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """reference sequence_reshape_op.cc, dense redesign: redistribute the
+    feature dim, keeping batch rows (B, T*D//nd, nd)."""
+    x = ins["X"][0]
+    nd = int(attrs["new_dim"])
+    b = x.shape[0]
+    return {"Out": [x.reshape(b, -1, nd)]}
+
+
+@register("sequence_scatter", no_grad_slots=("Ids",))
+def _sequence_scatter(ctx, ins, attrs):
+    """reference sequence_scatter_op.cc, dense redesign: per-row scatter-add
+    Updates (B, L) into X (B, D) at Ids (B, L)."""
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    b = x.shape[0]
+    rows = jnp.arange(b)[:, None]
+    return {"Out": [x.at[rows, ids.astype(jnp.int32)].add(upd)]}
+
+
+@register("spectral_norm", no_grad_slots=("U", "V"))
+def _spectral_norm(ctx, ins, attrs):
+    """reference spectral_norm_op.cc: weight / sigma_max via power
+    iteration (static iteration count -> unrolled by XLA)."""
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    iters = int(attrs.get("power_iters", 1))
+    eps = attrs.get("eps", 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    mat = w.transpose(perm).reshape(w.shape[dim], -1)
+
+    def _norm(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    for _ in range(iters):
+        v = _norm(jax.lax.stop_gradient(mat).T @ u)
+        u = _norm(jax.lax.stop_gradient(mat) @ v)
+    sigma = u @ mat @ v
+    return {"Out": [w / sigma]}
+
+
+@register("teacher_student_sigmoid_loss", no_grad_slots=("Label",))
+def _teacher_student_sigmoid_loss(ctx, ins, attrs):
+    """reference teacher_student_sigmoid_loss_op.h:20-58: CTR distill loss
+    with the label encoding {-2, -1, [0,1), [1,2]}."""
+    x = ins["X"][0].reshape(-1)
+    lab = ins["Label"][0].reshape(-1).astype(x.dtype)
+
+    def sce(z):
+        return jax.nn.relu(x) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+    out = jnp.where(
+        lab < -1.0, sce(0.0),
+        jnp.where(lab < 0.0, sce(1.0),
+                  jnp.where(lab < 1.0, sce(0.0) + sce(lab),
+                            sce(1.0) + sce(lab - 1.0))))
+    return {"Y": [out.reshape(ins["X"][0].shape)]}
+
+
+@register("edit_distance", not_differentiable=True)
+def _edit_distance(ctx, ins, attrs):
+    """reference edit_distance_op.cc, dense redesign: Levenshtein DP per
+    (hyp, ref) pair. Hyps (B, L1) + HypsLength, Refs (B, L2) + RefsLength."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    b, l1 = hyp.shape
+    l2 = ref.shape[1]
+    hl = ins.get("HypsLength", [None])[0]
+    rl = ins.get("RefsLength", [None])[0]
+    hl = (jnp.full((b,), l1, jnp.int32) if hl is None
+          else hl.reshape(-1).astype(jnp.int32))
+    rl = (jnp.full((b,), l2, jnp.int32) if rl is None
+          else rl.reshape(-1).astype(jnp.int32))
+    normalized = bool(attrs.get("normalized", False))
+    big = jnp.asarray(10 ** 6, jnp.int32)
+
+    def one(h, r, hn, rn):
+        # row DP over ref; inner scan over hyp positions
+        init = jnp.where(jnp.arange(l2 + 1) <= rn,
+                         jnp.arange(l2 + 1), big)
+
+        def row(prev, hi):
+            i, hc = hi
+            active_i = i < hn
+
+            def col(carry, j_rc):
+                j, rc = j_rc
+                left = carry
+                diag = prev[j]
+                up = prev[j + 1]
+                cost = jnp.where(hc == rc, 0, 1)
+                val = jnp.minimum(jnp.minimum(up + 1, left + 1),
+                                  diag + cost)
+                val = jnp.where(j < rn, val, big)
+                return val, val
+
+            first = i + 1
+            _, rest = jax.lax.scan(col, first, (jnp.arange(l2), r))
+            new = jnp.concatenate([first[None], rest])
+            new = jnp.where(active_i, new, prev)
+            return new, None
+
+        final, _ = jax.lax.scan(row, init, (jnp.arange(l1), h))
+        return final[rn]
+
+    d = jax.vmap(one)(hyp, ref, hl, rl).astype(jnp.float32)
+    if normalized:
+        d = d / jnp.maximum(rl.astype(d.dtype), 1.0)
+    return {"Out": [d[:, None]],
+            "SequenceNum": [jnp.asarray([b], jnp.int64)]}
+
+
+@register("ctc_align", not_differentiable=True)
+def _ctc_align(ctx, ins, attrs):
+    """reference ctc_align_op.cc, dense redesign: collapse repeats then
+    drop blanks; output padded with `blank` plus OutputLength."""
+    x = ins["Input"][0].astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    b, t = x.shape
+    prev = jnp.pad(x, ((0, 0), (1, 0)), constant_values=-1)[:, :t]
+    keep = (x != blank)
+    if merge:
+        keep = keep & (x != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((b, t), blank, jnp.int32)
+    rows = jnp.arange(b)[:, None]
+    safe_pos = jnp.where(keep, pos, t - 1)
+    # scatter kept tokens to the front; dummy writes (masked) land on the
+    # last slot then get overwritten by real ones only if keep
+    out = out.at[rows, safe_pos].set(
+        jnp.where(keep, x, out[rows, safe_pos]))
+    lens = keep.sum(axis=1)
+    out = jnp.where(jnp.arange(t)[None, :] < lens[:, None], out, blank)
+    return {"Output": [out.astype(jnp.int64)],
+            "OutputLength": [lens.astype(jnp.int64)[:, None]]}
+
+
+@register("hierarchical_sigmoid",
+          no_grad_slots=("Label", "PathTable", "PathCode"))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """reference hierarchical_sigmoid_op.cc, default complete-binary-tree
+    path (custom PathTable/PathCode also honored): loss = sum over path
+    nodes of sigmoid CE between x.w_node and the branch bit."""
+    x = ins["X"][0]                                # (N, D)
+    w = ins["W"][0]                                # (num_nodes, D)
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    bias = ins.get("Bias", [None])[0]
+    num_classes = int(attrs.get("num_classes", 2))
+    n = x.shape[0]
+    path_table = ins.get("PathTable", [None])[0]
+    path_code = ins.get("PathCode", [None])[0]
+    if path_table is None:
+        # complete binary tree: internal node ids 0..C-2; leaf for class c
+        # sits at heap position C-1+c; path walks ancestors root-down.
+        depth = max(int(np.ceil(np.log2(num_classes))), 1)
+        heap = label + (num_classes - 1)
+        nodes, codes = [], []
+        cur = heap
+        for _ in range(depth):
+            parent = (cur - 1) // 2
+            nodes.append(parent)
+            codes.append(cur - (2 * parent + 1))   # 0 = left, 1 = right
+            cur = parent
+        path_table = jnp.stack(nodes[::-1], axis=1)
+        path_code = jnp.stack(codes[::-1], axis=1)
+        valid = path_table >= 0
+    else:
+        path_table = path_table.astype(jnp.int32)
+        path_code = path_code.astype(jnp.int32)
+        valid = path_table >= 0
+    safe = jnp.maximum(path_table, 0)
+    wn = w[safe]                                   # (N, depth, D)
+    logits = jnp.einsum("nd,npd->np", x, wn)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[safe]
+    z = path_code.astype(x.dtype)
+    ce = jax.nn.relu(logits) - logits * z + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    loss = jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+    return {"Out": [loss], "PreOut": [logits]}
+
+
+@register("polygon_box_transform", not_differentiable=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """reference detection/polygon_box_transform_op.cc: EAST geometry map
+    to corner coords: even channels 4*w_idx - in, odd 4*h_idx - in."""
+    x = ins["Input"][0]
+    n, c, h, w = x.shape
+    wi = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    hi = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(even, 4.0 * wi - x, 4.0 * hi - x)]}
+
+
+@register("bipartite_match", not_differentiable=True)
+def _bipartite_match(ctx, ins, attrs):
+    """reference detection/bipartite_match_op.cc: greedy bipartite matching
+    on a (rows=gt, cols=pred) distance matrix; each iteration picks the
+    global max, assigns, masks row+col. match_type=per_prediction then
+    tops up unmatched cols above overlap_threshold."""
+    dist = ins["DistMat"][0]
+    rows, cols = dist.shape
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = attrs.get("dist_threshold", 0.5)
+    neg = jnp.asarray(-1.0, dist.dtype)
+
+    def body(carry, _):
+        d, midx, mdist = carry
+        flat = jnp.argmax(d)
+        r, c = flat // cols, flat % cols
+        best = d[r, c]
+        do = best > 0
+        midx = jnp.where(do, midx.at[c].set(r.astype(jnp.int32)), midx)
+        mdist = jnp.where(do, mdist.at[c].set(best), mdist)
+        d = jnp.where(do, d.at[r, :].set(neg).at[:, c].set(neg), d)
+        return (d, midx, mdist), None
+
+    init = (dist, jnp.full((cols,), -1, jnp.int32),
+            jnp.zeros((cols,), dist.dtype))
+    (d, midx, mdist), _ = jax.lax.scan(body, init, None,
+                                       length=min(rows, cols))
+    if match_type == "per_prediction":
+        col_best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        col_best = jnp.max(dist, axis=0)
+        top_up = (midx < 0) & (col_best >= thresh)
+        midx = jnp.where(top_up, col_best_row, midx)
+        mdist = jnp.where(top_up, col_best, mdist)
+    return {"ColToRowMatchIndices": [midx[None, :]],
+            "ColToRowMatchDist": [mdist[None, :]]}
+
+
+@register("target_assign", not_differentiable=True)
+def _target_assign(ctx, ins, attrs):
+    """reference detection/target_assign_op.cc: gather per-prior targets
+    through MatchIndices; unmatched priors get mismatch_value."""
+    x = ins["X"][0]                      # (N, M, K) gt values (dense)
+    match = ins["MatchIndices"][0].astype(jnp.int32)   # (N, P)
+    mismatch = attrs.get("mismatch_value", 0)
+    n, p = match.shape
+    safe = jnp.maximum(match, 0)
+    rows = jnp.arange(n)[:, None]
+    out = x[rows, safe]                  # (N, P, K)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    wt = matched[..., 0].astype(jnp.float32)[:, :, None]
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+@register("fc")
+def _fc(ctx, ins, attrs):
+    """reference fc_op.cc: flatten to 2D at in_num_col_dims, X@W + b."""
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    ncd = int(attrs.get("in_num_col_dims", 1))
+    x2 = x.reshape(int(np.prod(x.shape[:ncd])), -1)
+    out = x2 @ w
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    if attrs.get("activation_type") == "relu":
+        out = jax.nn.relu(out)
+    return {"Out": [out.reshape(x.shape[:ncd] + (w.shape[1],))]}
+
+
+@register("shard_index", not_differentiable=True)
+def _shard_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = int(attrs.get("ignore_value", -1))
+    size = (index_num + nshards - 1) // nshards
+    mine = (x // size) == shard_id
+    return {"Out": [jnp.where(mine, x % size, ignore)]}
+
+
+@register("multiclass_nms2", not_differentiable=True)
+def _multiclass_nms2(ctx, ins, attrs):
+    """reference multiclass_nms_op.cc (v2: adds Index output)."""
+    from .registry import OPS
+    out = OPS["multiclass_nms"].lowering(ctx, ins, attrs)
+    res = out["Out"][0]
+    out["Index"] = [jnp.arange(res.shape[0], dtype=jnp.int32)[:, None]]
+    return out
+
+
+@register("random_crop", no_grad_slots=("Seed",))
+def _random_crop(ctx, ins, attrs):
+    """reference random_crop_op.cc: crop the trailing dims to `shape` at a
+    random offset (functional rng; SeedOut threads the generator)."""
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    k = len(shape)
+    lead = x.ndim - k
+    keys = jax.random.split(ctx.rng(), k)
+    idx = [slice(None)] * lead
+    for i in range(k):
+        lim = x.shape[lead + i] - shape[i]
+        off = (jax.random.randint(keys[i], (), 0, lim + 1)
+               if lim > 0 else 0)
+        x = jax.lax.dynamic_slice_in_dim(
+            x, off, shape[i], axis=lead + i)
+    del idx
+    seed = ins.get("Seed", [jnp.zeros((1,), jnp.int64)])[0]
+    return {"Out": [x], "SeedOut": [seed]}
+
+
+@register("precision_recall", not_differentiable=True)
+def _precision_recall(ctx, ins, attrs):
+    """reference metrics/precision_recall_op.h: streaming multi-class
+    precision/recall/F1 from per-class TP/FP/TN/FN state."""
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    weights = ins.get("Weights", [None])[0]
+    states = ins.get("StatesInfo", [None])[0]
+    c = int(attrs["class_number"])
+    w = (jnp.ones(idx.shape, jnp.float32) if weights is None
+         else weights.reshape(-1).astype(jnp.float32))
+    correct = (idx == label)
+    tp = jnp.zeros((c,), jnp.float32).at[label].add(w * correct)
+    fn = jnp.zeros((c,), jnp.float32).at[label].add(w * (~correct))
+    fp = jnp.zeros((c,), jnp.float32).at[idx].add(w * (~correct))
+    total = jnp.sum(w)
+    tn = total - tp - fn - fp
+
+    def metrics(tp_, fp_, tn_, fn_):
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                         0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                        0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mp = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12),
+                       0.0)
+        mr = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12),
+                       0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr,
+                                                              1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    batch = metrics(tp, fp, tn, fn)
+    if states is not None:
+        tp = tp + states[:, 0]
+        fp = fp + states[:, 1]
+        tn = tn + states[:, 2]
+        fn = fn + states[:, 3]
+    accum = metrics(tp, fp, tn, fn)
+    out_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    return {"BatchMetrics": [batch], "AccumMetrics": [accum],
+            "AccumStatesInfo": [out_states]}

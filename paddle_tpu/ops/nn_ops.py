@@ -60,6 +60,20 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
+def _transpose_pad(pad, kdims, dil):
+    """jax.lax.conv_transpose pads the stride-dilated input directly and
+    runs a VALID conv, so its padding relates to the paddle/torch
+    conv-transpose padding p as  p_jax = dilation*(k-1) - p  per side
+    (verified numerically vs torch; with k=3, p=1 the two coincide, which
+    is how the old pass-through survived the original sweep). String
+    paddings (SAME/VALID) pass through untouched — jax resolves those
+    itself."""
+    if isinstance(pad, str):
+        return pad
+    return [(d * (k - 1) - lo, d * (k - 1) - hi)
+            for (lo, hi), k, d in zip(pad, kdims, dil)]
+
+
 @register("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]  # w: [in, out/groups, kh, kw]
@@ -69,6 +83,7 @@ def _conv2d_transpose(ctx, ins, attrs):
     groups = int(attrs.get("groups", 1))
     if groups != 1:
         raise NotImplementedError("grouped conv2d_transpose")
+    pad = _transpose_pad(pad, w.shape[2:], dil)
     # paddle filter layout [in, out, kh, kw] -> [kh, kw, out, in]:
     # with transpose_kernel=True jax flips the spatial dims and swaps
     # I<->O internally, so the HWIO slots must carry (O=out, I=in)

@@ -124,3 +124,125 @@ def _moving_avg_scale_observer(ctx, ins, attrs):
         outs["OutState"] = [new_state]
         outs["OutAccum"] = [new_accum]
     return outs
+
+
+def _q(x, scale, qmax):
+    """Quantize only (no dequant): round(x / scale * qmax), clipped."""
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+
+
+@register("fake_quantize_abs_max", custom_grad_maker=_ste_grad_maker)
+def _fake_q_abs_max(ctx, ins, attrs):
+    """reference fake_quantize_op.cc FakeQuantizeAbsMax: emit quantized
+    levels (stored in float, like the reference) + the scale."""
+    x = ins["X"][0]
+    qmax = float(2 ** (int(attrs.get("bit_length", 8)) - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_q(x, scale, qmax)], "OutScale": [scale]}
+
+
+@register("fake_channel_wise_quantize_abs_max",
+          custom_grad_maker=_ste_grad_maker)
+def _fake_q_channel_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = int(attrs.get("quant_axis", 0))
+    qmax = float(2 ** (int(attrs.get("bit_length", 8)) - 1) - 1)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=reduce_axes, keepdims=True)
+    return {"Out": [_q(x, scale, qmax)], "OutScale": [scale.reshape(-1)]}
+
+
+@register("fake_quantize_range_abs_max",
+          no_grad_slots=("InScale", "Iter"),
+          custom_grad_maker=_ste_grad_maker)
+def _fake_q_range_abs_max(ctx, ins, attrs):
+    """reference FakeQuantizeRangeAbsMax: scale = max of a sliding window
+    of per-step abs-maxes (window_size); collapsed to the running max,
+    which is what the reference converges to within a window."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    qmax = float(2 ** (int(attrs.get("bit_length", 8)) - 1) - 1)
+    outs = {}
+    if attrs.get("is_test"):
+        scale = in_scale
+    else:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), in_scale)
+        outs["OutScales"] = [scale.reshape(1)]
+    outs["OutScale"] = [scale]
+    outs["Out"] = [_q(x, scale, qmax)]
+    return outs
+
+
+@register("fake_quantize_moving_average_abs_max",
+          no_grad_slots=("InScale", "InAccum", "InState"),
+          custom_grad_maker=_ste_grad_maker)
+def _fake_q_moving_avg(ctx, ins, attrs):
+    """Quantize-only twin of fake_quantize_dequantize_moving_average_abs_max."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    rate = float(attrs.get("moving_rate", 0.9))
+    qmax = float(2 ** (int(attrs.get("bit_length", 8)) - 1) - 1)
+    outs = {}
+    if attrs.get("is_test"):
+        scale = in_scale
+        outs["OutScale"] = [scale]
+    else:
+        cur = jnp.max(jnp.abs(x))
+        state = ins.get("InState", [jnp.ones(())])[0].reshape(())
+        accum = ins.get("InAccum", [in_scale])[0].reshape(())
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+        outs["OutScale"] = [scale]
+        outs["OutState"] = [new_state]
+        outs["OutAccum"] = [new_accum]
+    outs["Out"] = [_q(x, scale, qmax)]
+    return outs
+
+
+@register("fake_dequantize_max_abs", no_grad_slots=("Scale",))
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    """reference fake_dequantize_op.cc: x * scale / max_range."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x.astype(scale.dtype) * scale / max_range]}
+
+
+@register("fake_channel_wise_dequantize_max_abs", no_grad_slots=("Scales",))
+def _fake_channel_wise_dequantize_max_abs(ctx, ins, attrs):
+    """reference fake_dequantize_op.cc channel-wise path: one or two scale
+    tensors (weight-scale per channel x optional activation scale)."""
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    axis = int(attrs.get("quant_axis", 0))
+    bits = attrs.get("quant_bits", [8, 8])
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    qmax0 = float(2 ** (int(bits[0]) - 1) - 1)
+    out = x.astype(scales[0].dtype) * scales[0].reshape(shape) / qmax0
+    if len(scales) > 1 and scales[1] is not None:
+        qmax1 = float(2 ** (int(bits[1]) - 1) - 1)
+        out = out * scales[1].reshape(()) / qmax1
+    return {"Out": [out]}
+
+
+@register("dequantize_abs_max", no_grad_slots=("Scale",))
+def _dequantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x.astype(jnp.float32) * scale / max_range]}
+
+
+@register("dequantize_log", no_grad_slots=("Dict",))
+def _dequantize_log(ctx, ins, attrs):
+    """reference dequantize_log_op.cc: codebook lookup — negative codes
+    mirror to the negative of dict[code+128]."""
+    x = ins["X"][0].astype(jnp.int32)
+    table = ins["Dict"][0]
+    neg = x < 0
+    idx = jnp.where(neg, x + 128, x)
+    val = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+    return {"Out": [jnp.where(neg, -val, val)]}

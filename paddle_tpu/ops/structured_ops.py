@@ -1,0 +1,472 @@
+"""Structured-prediction + legacy recurrent op lowerings.
+
+Analogs of paddle/fluid/operators/{gru_op.cc, gru_unit_op.cc, lstm_op.cc,
+lstm_unit_op.cc, lstmp_op.cc, warpctc_op.cc, linear_chain_crf_op.cc,
+conv3d_transpose (conv_transpose_op.cc), depthwise_conv2d_transpose,
+deformable_conv_op.cc, deformable_conv_v1_op.cc, fsp_op.cc}.
+
+Recurrences lower to lax.scan (one compiled step, no per-timestep launch);
+CTC and CRF run their forward algorithms in log space — the reference
+exponentiates into fp32 scratch (linear_chain_crf_op.h:54), which bf16 TPU
+arithmetic can't afford — and get gradients from vjp through the scan,
+replacing the reference's hand-written backward kernels.
+
+The LoD-sequence inputs of the reference become dense (B, T, ...) batches
+with explicit lengths, per the repo-wide ragged redesign (SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from .nn_ops import _conv_padding
+
+
+def _act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda x: x}[name]
+
+
+# ---------------------------------------------------------------------------
+# GRU family (pre-projected inputs, reference gru_unit_op.h:53-120)
+# ---------------------------------------------------------------------------
+
+
+def _gru_step(x_t, h_prev, weight, bias, act_gate, act_node, origin_mode):
+    """x_t: (B, 3D) pre-projected input; weight: (D, 3D) laid out as the
+    reference's [W_u | W_r] (D,2D) + flat candidate W_c (D,D) tail."""
+    d = h_prev.shape[1]
+    w_ur = weight[:, :2 * d]
+    w_c = weight.reshape(-1)[2 * d * d:].reshape(d, d)
+    g = x_t + (bias if bias is not None else 0.0)
+    g_ur = g[:, :2 * d] + h_prev @ w_ur
+    u = act_gate(g_ur[:, :d])
+    r = act_gate(g_ur[:, d:])
+    rhp = r * h_prev
+    c = act_node(g[:, 2 * d:] + rhp @ w_c)
+    if origin_mode:
+        h = (1.0 - u) * c + u * h_prev
+    else:
+        h = u * c + (1.0 - u) * h_prev
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return h, gate, rhp
+
+
+@register("gru_unit", no_grad_slots=())
+def _gru_unit(ctx, ins, attrs):
+    """reference gru_unit_op.h:53-120: one GRU step on pre-projected x."""
+    x = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    weight = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    acts = ["identity", "sigmoid", "tanh", "relu"]
+    act_gate = _act(acts[int(attrs.get("gate_activation", 1))])
+    act_node = _act(acts[int(attrs.get("activation", 2))])
+    h, gate, rhp = _gru_step(x, h_prev, weight, bias, act_gate, act_node,
+                             bool(attrs.get("origin_mode", False)))
+    return {"Hidden": [h], "Gate": [gate], "ResetHiddenPrev": [rhp]}
+
+
+@register("gru", no_grad_slots=())
+def _gru(ctx, ins, attrs):
+    """reference gru_op.cc, dense redesign: Input (B, T, 3D) pre-projected,
+    scanned with the gru_unit cell."""
+    x = ins["Input"][0]
+    weight = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    h0 = ins.get("H0", [None])[0]
+    acts = ["identity", "sigmoid", "tanh", "relu"]
+    act_gate = _act(attrs.get("gate_activation", "sigmoid")
+                    if isinstance(attrs.get("gate_activation"), str)
+                    else acts[int(attrs.get("gate_activation", 1))])
+    act_node = _act(attrs.get("activation", "tanh")
+                    if isinstance(attrs.get("activation"), str)
+                    else acts[int(attrs.get("activation", 2))])
+    origin = bool(attrs.get("origin_mode", False))
+    reverse = bool(attrs.get("is_reverse", False))
+    b, t, _ = x.shape
+    d = weight.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((b, d), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)                    # (T, B, 3D)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+
+    def step(h, x_t):
+        h_new, gate, rhp = _gru_step(x_t, h, weight, bias, act_gate,
+                                     act_node, origin)
+        return h_new, (h_new, gate, rhp)
+
+    _, (hs, gates, rhps) = jax.lax.scan(step, h0, xs)
+    if reverse:
+        hs, gates, rhps = (jnp.flip(v, 0) for v in (hs, gates, rhps))
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+            "BatchGate": [jnp.swapaxes(gates, 0, 1)],
+            "BatchResetHiddenPrev": [jnp.swapaxes(rhps, 0, 1)],
+            "BatchHidden": [jnp.swapaxes(hs, 0, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# LSTM family
+# ---------------------------------------------------------------------------
+
+
+@register("lstm_unit", no_grad_slots=())
+def _lstm_unit(ctx, ins, attrs):
+    """reference lstm_unit_op.h:61-77: gates packed (i, f, o, g)."""
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    fb = attrs.get("forget_bias", 0.0)
+    d = c_prev.shape[1]
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * d:3 * d])
+    g = jnp.tanh(x[:, 3 * d:])
+    c = f * c_prev + i * g
+    return {"C": [c], "H": [o * jnp.tanh(c)]}
+
+
+def _lstm_step(x_t, h_prev, c_prev, weight, bias, checks, acts, proj=None):
+    """reference math/detail/lstm_kernel.h:30-51: gates (c~, i, f, o) with
+    peephole checks; optional recurrent projection (lstmp_op.cc)."""
+    act_node, act_gate, act_state = acts
+    d = c_prev.shape[1]
+    g = x_t + h_prev @ weight
+    if bias is not None:
+        g = g + bias
+    cand = act_node(g[:, :d])
+    ci, cf, co = checks
+    i = act_gate(g[:, d:2 * d] + (c_prev * ci if ci is not None else 0.0))
+    f = act_gate(g[:, 2 * d:3 * d] + (c_prev * cf if cf is not None else 0.0))
+    c = cand * i + c_prev * f
+    o = act_gate(g[:, 3 * d:] + (c * co if co is not None else 0.0))
+    h = o * act_state(c)
+    if proj is not None:
+        h = h @ proj
+    return h, c, g
+
+
+def _lstm_common(ctx, ins, attrs, projected):
+    x = ins["Input"][0]                           # (B, T, 4D)
+    weight = ins["Weight"][0]                     # (D or P, 4D)
+    bias = ins.get("Bias", [None])[0]
+    proj = ins["ProjWeight"][0] if projected else None  # (D, P)
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    peephole = bool(attrs.get("use_peepholes", True))
+    reverse = bool(attrs.get("is_reverse", False))
+    acts = (_act(attrs.get("candidate_activation", "tanh")),
+            _act(attrs.get("gate_activation", "sigmoid")),
+            _act(attrs.get("cell_activation", "tanh")))
+    b, t, fourd = x.shape
+    d = fourd // 4
+    checks = (None, None, None)
+    if bias is not None:
+        bias = bias.reshape(-1)
+        if peephole and bias.shape[0] == 7 * d:
+            checks = (bias[4 * d:5 * d], bias[5 * d:6 * d], bias[6 * d:])
+        bias = bias[:4 * d]
+    psize = proj.shape[1] if projected else d
+    if h0 is None:
+        h0 = jnp.zeros((b, psize), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, d), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+
+    def step(carry, x_t):
+        h, c = carry
+        h2, c2, g = _lstm_step(x_t, h, c, weight, bias, checks, acts, proj)
+        return (h2, c2), (h2, c2, g)
+
+    _, (hs, cs, gs) = jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        hs, cs, gs = (jnp.flip(v, 0) for v in (hs, cs, gs))
+    out = {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+           "Cell": [jnp.swapaxes(cs, 0, 1)],
+           "BatchGate": [jnp.swapaxes(gs, 0, 1)],
+           "BatchCellPreAct": [jnp.swapaxes(cs, 0, 1)]}
+    if projected:
+        out["Projection"] = out.pop("Hidden")
+        out["BatchHidden"] = [jnp.swapaxes(hs, 0, 1)]
+    return out
+
+
+@register("lstm", no_grad_slots=())
+def _lstm(ctx, ins, attrs):
+    """reference lstm_op.cc, dense redesign: Input (B,T,4D) pre-projected."""
+    return _lstm_common(ctx, ins, attrs, projected=False)
+
+
+@register("lstmp", no_grad_slots=())
+def _lstmp(ctx, ins, attrs):
+    """reference lstmp_op.cc: LSTM with recurrent projection layer."""
+    return _lstm_common(ctx, ins, attrs, projected=True)
+
+
+# ---------------------------------------------------------------------------
+# CTC (warpctc) — log-space forward algorithm under lax.scan
+# ---------------------------------------------------------------------------
+
+_NEG = -1e30
+
+
+@register("warpctc", no_grad_slots=("Label", "LogitsLength", "LabelLength"),
+          nondiff_outputs=("WarpCTCGrad",))
+def _warpctc(ctx, ins, attrs):
+    """reference warpctc_op.cc (wraps baidu warp-ctc): CTC loss.
+
+    Dense redesign: Logits (B, T, C) raw activations, Label (B, L) padded
+    with `blank`, LogitsLength (B,), LabelLength (B,). Loss is the standard
+    CTC alpha recursion in log space; gradient comes from vjp through the
+    recursion instead of warp-ctc's hand-fused backward.
+    """
+    logits = ins["Logits"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+    b, t, c = logits.shape
+    l = label.shape[1]
+    logit_len = (ins.get("LogitsLength", [None])[0])
+    label_len = (ins.get("LabelLength", [None])[0])
+    logit_len = (jnp.full((b,), t, jnp.int32) if logit_len is None
+                 else logit_len.reshape(-1).astype(jnp.int32))
+    label_len = (jnp.full((b,), l, jnp.int32) if label_len is None
+                 else label_len.reshape(-1).astype(jnp.int32))
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended label: blank, l1, blank, l2, ... blank  (length 2L+1)
+    s = 2 * l + 1
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    # can-skip mask: alpha[s] may come from alpha[s-2] when ext[s] != blank
+    # and ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    # init: alpha_0 = logp[0, blank], alpha_1 = logp[0, l1]
+    a0 = jnp.full((b, s), _NEG)
+    a0 = a0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(logp[:, 0], ext[:, 1:2], axis=1)[:, 0]
+    a0 = a0.at[:, 1].set(jnp.where(label_len > 0, first_lab, _NEG))
+
+    lp_t = jnp.swapaxes(logp, 0, 1)               # (T, B, C)
+    tidx = jnp.arange(1, t)
+
+    def step(alpha, inp):
+        lp, ti = inp
+        shift1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                         constant_values=_NEG)[:, :s]
+        shift2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                         constant_values=_NEG)[:, :s]
+        shift2 = jnp.where(can_skip, shift2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        emit = jnp.take_along_axis(lp, ext, axis=1)
+        new = merged + emit
+        # freeze alphas past each sequence's logit length
+        new = jnp.where((ti < logit_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, a0, (lp_t[1:], tidx))
+    # final: logaddexp of alpha at S-1 and S-2 where S = 2*label_len+1
+    send = 2 * label_len  # index of final blank
+    a_last = jnp.take_along_axis(alpha, send[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_len > 0, a_prev, _NEG)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    if norm_by_times:
+        loss = loss / logit_len.astype(loss.dtype)
+    return {"Loss": [loss[:, None]],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+# ---------------------------------------------------------------------------
+# Linear-chain CRF — log-space
+# ---------------------------------------------------------------------------
+
+
+@register("linear_chain_crf", no_grad_slots=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    """reference linear_chain_crf_op.h:54-220.
+
+    Dense redesign: Emission (B, T, K), Transition (K+2, K) with row 0 the
+    start weights and row 1 the end weights, Label (B, T), Length (B,).
+    LogLikelihood = logZ - gold_score (the negative log likelihood the
+    reference emits). Alpha is returned in log space (the reference's is
+    exp-space scratch for its hand-written backward; vjp needs no scratch).
+    """
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    b, t, k = emission.shape
+    length = ins.get("Length", [None])[0]
+    length = (jnp.full((b,), t, jnp.int32) if length is None
+              else length.reshape(-1).astype(jnp.int32))
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+
+    em_t = jnp.swapaxes(emission, 0, 1)           # (T, B, K)
+    a0 = start_w[None, :] + em_t[0]
+    tidx = jnp.arange(1, t)
+
+    def step(alpha, inp):
+        em, ti = inp
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i, j]) + em[j]
+        new = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + em
+        new = jnp.where((ti < length)[:, None], new, alpha)
+        return new, new
+
+    alpha_last, alphas = jax.lax.scan(step, a0, (em_t[1:], tidx))
+    logz = jax.nn.logsumexp(alpha_last + end_w[None, :], axis=1)
+
+    # gold score: start + sum emissions + sum transitions + end
+    t_range = jnp.arange(t)
+    valid = (t_range[None, :] < length[:, None])
+    em_gold = jnp.take_along_axis(emission, label[:, :, None],
+                                  axis=2)[:, :, 0]
+    em_score = jnp.sum(em_gold * valid, axis=1)
+    prev_lab = label[:, :-1]
+    next_lab = label[:, 1:]
+    tr_gold = trans[prev_lab, next_lab]
+    tr_valid = (t_range[None, 1:] < length[:, None])
+    tr_score = jnp.sum(tr_gold * tr_valid, axis=1)
+    first = label[:, 0]
+    last = jnp.take_along_axis(label, (length - 1)[:, None], axis=1)[:, 0]
+    gold = em_score + tr_score + start_w[first] + end_w[last]
+
+    ll = (logz - gold)[:, None]
+    full_alpha = jnp.concatenate([a0[None], alphas], axis=0)
+    return {"LogLikelihood": [ll],
+            "Alpha": [jnp.swapaxes(full_alpha, 0, 1)],
+            "EmissionExps": [jnp.exp(emission)],
+            "TransitionExps": [jnp.exp(transition)]}
+
+
+# ---------------------------------------------------------------------------
+# conv transpose variants + deformable conv
+# ---------------------------------------------------------------------------
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """reference conv_transpose_op.cc 3D path."""
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [in, out, kd, kh, kw]
+    strides = [int(s) for s in attrs.get("strides", [1, 1, 1])]
+    pad = _conv_padding(attrs.get("paddings", [0, 0, 0]), 3)
+    dil = [int(d) for d in attrs.get("dilations", [1, 1, 1])]
+    if int(attrs.get("groups", 1)) != 1:
+        raise NotImplementedError("grouped conv3d_transpose")
+    from .nn_ops import _transpose_pad
+    pad = _transpose_pad(pad, w.shape[2:], dil)
+    out = jax.lax.conv_transpose(
+        x, jnp.transpose(w, (2, 3, 4, 1, 0)),
+        strides=strides, padding=pad, rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "DHWIO", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": [out]}
+
+
+@register("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    """reference conv_transpose_op.cc depthwise path: transpose conv as
+    lhs-dilated regular conv with flipped kernel, feature_group_count=C."""
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [C, 1, kh, kw]
+    s = [int(v) for v in attrs.get("strides", [1, 1])]
+    pads = _conv_padding(attrs.get("paddings", [0, 0]), 2)
+    dil = [int(v) for v in attrs.get("dilations", [1, 1])]
+    c = x.shape[1]
+    kh, kw = w.shape[2], w.shape[3]
+    wf = jnp.flip(w, (2, 3)).transpose(1, 0, 2, 3)  # OIHW w/ O=1 per group
+    eh = (kh - 1) * dil[0]
+    ew = (kw - 1) * dil[1]
+    pad = [(eh - pads[0][0], eh - pads[0][1]),
+           (ew - pads[1][0], ew - pads[1][1])]
+    out = jax.lax.conv_general_dilated(
+        x, wf.reshape(c, 1, kh, kw), window_strides=[1, 1], padding=pad,
+        lhs_dilation=s, rhs_dilation=dil, feature_group_count=c,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": [out]}
+
+
+def _deform_sample(x, py, px):
+    """Bilinear sample x (N,C,H,W) at float coords (N,G?,Ho,Wo) shaped
+    (N,K,Ho,Wo); zero outside."""
+    n, c, h, w = x.shape
+    x0 = jnp.floor(px).astype(jnp.int32)
+    y0 = jnp.floor(py).astype(jnp.int32)
+
+    def g(iy, ix):
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        iyc = jnp.clip(iy, 0, h - 1)
+        ixc = jnp.clip(ix, 0, w - 1)
+        flat = x.reshape(n, c, h * w)
+        idx = (iyc * w + ixc).reshape(n, 1, -1)
+        got = jnp.take_along_axis(
+            flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        got = got.reshape((n, c) + iy.shape[1:])
+        return got * valid[:, None].astype(x.dtype)
+
+    wy = (py - y0).astype(x.dtype)[:, None]
+    wx = (px - x0).astype(x.dtype)[:, None]
+    return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x0 + 1) * (1 - wy) * wx
+            + g(y0 + 1, x0) * wy * (1 - wx) + g(y0 + 1, x0 + 1) * wy * wx)
+
+
+def _deformable_conv_impl(ctx, ins, attrs, modulated):
+    x = ins["Input"][0]
+    offset = ins["Offset"][0]                     # (N, 2*G*kh*kw, Ho, Wo)
+    w = ins["Filter"][0]                          # (out, in/g, kh, kw)
+    mask = ins["Mask"][0] if modulated else None  # (N, G*kh*kw, Ho, Wo)
+    s = [int(v) for v in attrs.get("strides", [1, 1])]
+    p = [int(v) for v in attrs.get("paddings", [0, 0])]
+    d = [int(v) for v in attrs.get("dilations", [1, 1])]
+    dg = int(attrs.get("deformable_groups", 1))
+    if int(attrs.get("groups", 1)) != 1 or dg != 1:
+        raise NotImplementedError("grouped/multi-group deformable_conv")
+    n, c, h, wd = x.shape
+    co, ci, kh, kw = w.shape
+    ho = (h + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+    wo = (wd + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+    base_y = (jnp.arange(ho) * s[0] - p[0])[None, :, None]
+    base_x = (jnp.arange(wo) * s[1] - p[1])[None, None, :]
+    off = offset.reshape(n, kh * kw, 2, ho, wo)
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            kidx = i * kw + j
+            py = base_y + i * d[0] + off[:, kidx, 0]
+            px = base_x + j * d[1] + off[:, kidx, 1]
+            samp = _deform_sample(x, py, px)      # (N,C,Ho,Wo)
+            if mask is not None:
+                samp = samp * mask[:, kidx][:, None]
+            cols.append(samp)
+    patches = jnp.stack(cols, axis=2)             # (N,C,khkw,Ho,Wo)
+    out = jnp.einsum("nckhw,ock->nohw",
+                     patches, w.reshape(co, ci, kh * kw))
+    return {"Output": [out]}
+
+
+@register("deformable_conv", no_grad_slots=())
+def _deformable_conv(ctx, ins, attrs):
+    """reference deformable_conv_op.cc (DCNv2, modulated)."""
+    return _deformable_conv_impl(ctx, ins, attrs, modulated=True)
+
+
+@register("deformable_conv_v1", no_grad_slots=())
+def _deformable_conv_v1(ctx, ins, attrs):
+    """reference deformable_conv_v1_op.cc (DCNv1, no mask)."""
+    return _deformable_conv_impl(ctx, ins, attrs, modulated=False)
+
+
+@register("fsp")
+def _fsp(ctx, ins, attrs):
+    """reference fsp_op.cc: flow-of-solution-procedure matrix (distill):
+    out[n,i,j] = mean_hw X[n,i,h,w] * Y[n,j,h,w]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    hw = x.shape[2] * x.shape[3]
+    return {"Out": [jnp.einsum("nihw,njhw->nij", x, y) / hw]}

@@ -1,0 +1,335 @@
+"""OpTests for the round-4 loss + linalg op tail (loss_ops.py,
+linalg_ops.py). References computed with numpy/torch, gradients checked
+numerically via the OpTest harness — mirroring the reference's
+tests/unittests/test_{bce_loss,nll_loss,bmm,kron,...}_op.py."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(11)
+
+
+class TestBceLoss(OpTest):
+    op_type = "bce_loss"
+
+    def test(self):
+        x = RNG.uniform(0.1, 0.9, (4, 5)).astype(np.float64)
+        lab = RNG.randint(0, 2, (4, 5)).astype(np.float64)
+        exp = -(lab * np.log(x) + (1 - lab) * np.log(1 - x))
+        self.inputs = {"X": x, "Label": lab}
+        self.outputs = {"Out": exp}
+        self.check_output()
+        self.check_grad(["X_0"], "Out_0")
+
+
+class TestNllLoss(OpTest):
+    op_type = "nll_loss"
+
+    def test(self):
+        import torch
+        x = np.log(RNG.uniform(0.05, 1.0, (5, 4))).astype(np.float64)
+        lab = RNG.randint(0, 4, (5,)).astype(np.int64)
+        w = RNG.uniform(0.5, 1.5, (4,)).astype(np.float64)
+        exp = torch.nn.functional.nll_loss(
+            torch.from_numpy(x), torch.from_numpy(lab),
+            torch.from_numpy(w)).numpy()
+        tw = w[lab].sum()
+        self.inputs = {"X": x, "Label": lab, "Weight": w}
+        self.outputs = {"Out": exp, "Total_weight": np.float64(tw)}
+        self.attrs = {"reduction": "mean", "ignore_index": -100}
+        self.check_output()
+        self.check_grad(["X_0"], "Out_0")
+
+    def test_none_reduction(self):
+        x = np.log(RNG.uniform(0.05, 1.0, (5, 4))).astype(np.float64)
+        lab = RNG.randint(0, 4, (5,)).astype(np.int64)
+        lab[2] = 3
+        exp = -x[np.arange(5), lab]
+        exp[lab == 3] = 0.0  # ignore_index
+        self.inputs = {"X": x, "Label": lab}
+        self.outputs = {"Out": exp,
+                        "Total_weight": np.float64((lab != 3).sum())}
+        self.attrs = {"reduction": "none", "ignore_index": 3}
+        self.check_output()
+
+
+class TestLogLoss(OpTest):
+    op_type = "log_loss"
+
+    def test(self):
+        p = RNG.uniform(0.1, 0.9, (6, 1)).astype(np.float64)
+        lab = RNG.randint(0, 2, (6, 1)).astype(np.float64)
+        eps = 1e-4
+        exp = -lab * np.log(p + eps) - (1 - lab) * np.log(1 - p + eps)
+        self.inputs = {"Predicted": p, "Labels": lab}
+        self.outputs = {"Loss": exp}
+        self.attrs = {"epsilon": eps}
+        self.check_output()
+        self.check_grad(["Predicted_0"], "Loss_0")
+
+
+class TestRankLoss(OpTest):
+    op_type = "rank_loss"
+
+    def test(self):
+        left = RNG.randn(5, 1)
+        right = RNG.randn(5, 1)
+        lab = RNG.randint(0, 2, (5, 1)).astype(np.float64)
+        d = left - right
+        exp = np.log1p(np.exp(d)) - lab * d
+        self.inputs = {"Label": lab, "Left": left, "Right": right}
+        self.outputs = {"Out": exp}
+        self.check_output()
+        self.check_grad(["Left_0", "Right_0"], "Out_0")
+
+
+class TestMarginRankLoss(OpTest):
+    op_type = "margin_rank_loss"
+
+    def test(self):
+        x1, x2 = RNG.randn(5, 1), RNG.randn(5, 1)
+        lab = np.where(RNG.rand(5, 1) > 0.5, 1.0, -1.0)
+        raw = 0.1 - lab * (x1 - x2)
+        self.inputs = {"X1": x1, "X2": x2, "Label": lab}
+        self.outputs = {"Out": np.maximum(raw, 0),
+                        "Activated": (raw > 0).astype(np.float64)}
+        self.attrs = {"margin": 0.1}
+        self.check_output()
+
+
+class TestHingeLoss(OpTest):
+    op_type = "hinge_loss"
+
+    def test(self):
+        logits = RNG.randn(6, 1)
+        lab = RNG.randint(0, 2, (6, 1)).astype(np.float64)
+        exp = np.maximum(0, 1 - (2 * lab - 1) * logits)
+        self.inputs = {"Logits": logits, "Labels": lab}
+        self.outputs = {"Loss": exp}
+        self.check_output()
+
+
+class TestSigmoidFocalLoss(OpTest):
+    op_type = "sigmoid_focal_loss"
+
+    def test(self):
+        n, c = 4, 3
+        x = RNG.randn(n, c)
+        lab = RNG.randint(0, c + 1, (n, 1)).astype(np.int64)
+        fg = np.array([2], np.int64)
+        gamma, alpha = 2.0, 0.25
+        p = 1 / (1 + np.exp(-x))
+        exp = np.zeros_like(x)
+        for i in range(n):
+            for d in range(c):
+                g = lab[i, 0]
+                cp = float(g == d + 1)
+                cn = float((g != -1) and (g != d + 1))
+                fgn = max(fg[0], 1)
+                tp = (1 - p[i, d]) ** gamma * np.log(max(p[i, d], 1e-12))
+                xx = x[i, d]
+                tn = p[i, d] ** gamma * (
+                    -xx * (xx >= 0) - np.log1p(np.exp(xx - 2 * xx * (xx >= 0))))
+                exp[i, d] = (-cp * tp * alpha / fgn
+                             - cn * tn * (1 - alpha) / fgn)
+        self.inputs = {"X": x, "Label": lab, "FgNum": fg}
+        self.outputs = {"Out": exp}
+        self.attrs = {"gamma": gamma, "alpha": alpha}
+        self.check_output()
+        self.check_grad(["X_0"], "Out_0")
+
+
+class TestBprLoss(OpTest):
+    op_type = "bpr_loss"
+
+    def test(self):
+        n, c = 4, 5
+        x = RNG.randn(n, c)
+        lab = RNG.randint(0, c, (n, 1)).astype(np.int64)
+        exp = np.zeros((n, 1))
+        for i in range(n):
+            pos = x[i, lab[i, 0]]
+            s = 0.0
+            for j in range(c):
+                if j == lab[i, 0]:
+                    continue
+                s += np.log1p(np.exp(x[i, j] - pos))
+            exp[i, 0] = s / (c - 1)
+        self.inputs = {"X": x, "Label": lab}
+        self.outputs = {"Y": exp}
+        self.check_output()
+        self.check_grad(["X_0"], "Y_0")
+
+
+class TestCenterLoss(OpTest):
+    op_type = "center_loss"
+
+    def test(self):
+        n, d, k = 5, 3, 4
+        x = RNG.randn(n, d)
+        lab = RNG.randint(0, k, (n,)).astype(np.int64)
+        centers = RNG.randn(k, d)
+        rate = np.array([0.1])
+        diff = x - centers[lab]
+        loss = 0.5 * (diff * diff).sum(1, keepdims=True)
+        acc = np.zeros_like(centers)
+        count = np.ones(k)
+        for i in range(n):
+            acc[lab[i]] += diff[i]
+            count[lab[i]] += 1
+        centers_out = centers + 0.1 * acc / count[:, None]
+        self.inputs = {"X": x, "Label": lab, "Centers": centers,
+                       "CenterUpdateRate": rate}
+        self.outputs = {"Loss": loss, "SampleCenterDiff": diff,
+                        "CentersOut": centers_out}
+        self.attrs = {"cluster_num": k, "need_update": True}
+        self.check_output()
+
+
+class TestCosSim(OpTest):
+    op_type = "cos_sim"
+
+    def test(self):
+        x, y = RNG.randn(4, 6), RNG.randn(4, 6)
+        xn = np.sqrt((x * x).sum(-1, keepdims=True))
+        yn = np.sqrt((y * y).sum(-1, keepdims=True))
+        out = (x * y).sum(-1, keepdims=True) / (xn * yn + 1e-12)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out, "XNorm": xn, "YNorm": yn}
+        self.check_output()
+        self.check_grad(["X_0", "Y_0"], "Out_0")
+
+
+class TestDistMinusNorms(OpTest):
+    def test_dist(self):
+        self.op_type = "dist"
+        x, y = RNG.randn(3, 4), RNG.randn(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.float64(
+            np.power(np.sum(np.abs(x - y) ** 3), 1 / 3))}
+        self.attrs = {"p": 3.0}
+        self.check_output()
+
+    def test_minus(self):
+        self.op_type = "minus"
+        x, y = RNG.randn(3, 4), RNG.randn(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["X_0", "Y_0"], "Out_0")
+
+    def test_l1_norm(self):
+        self.op_type = "l1_norm"
+        x = RNG.randn(3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.float64(np.abs(x).sum())}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["X_0"], "Out_0")
+
+    def test_frobenius_norm(self):
+        self.op_type = "frobenius_norm"
+        x = RNG.randn(2, 3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.sqrt((x * x).sum(axis=(1, 2)))}
+        self.attrs = {"dim": [1, 2], "keep_dim": False}
+        self.check_output()
+        self.check_grad(["X_0"], "Out_0")
+
+
+class TestCrossEntropy2(OpTest):
+    op_type = "cross_entropy2"
+
+    def test(self):
+        probs = RNG.uniform(0.1, 1.0, (4, 5))
+        probs /= probs.sum(-1, keepdims=True)
+        lab = RNG.randint(0, 5, (4, 1)).astype(np.int64)
+        match = probs[np.arange(4), lab[:, 0]][:, None]
+        self.inputs = {"X": probs, "Label": lab}
+        self.outputs = {"Y": -np.log(match), "MatchX": match}
+        self.check_output()
+
+
+# ---------------------------------------------------------------- linalg
+
+
+class TestBmm(OpTest):
+    op_type = "bmm"
+
+    def test(self):
+        x = RNG.randn(3, 2, 4)
+        y = RNG.randn(3, 4, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output()
+        self.check_grad(["X_0", "Y_0"], "Out_0")
+
+
+class TestCholesky(OpTest):
+    op_type = "cholesky"
+
+    def test(self):
+        a = RNG.randn(3, 3)
+        spd = a @ a.T + 3 * np.eye(3)
+        self.inputs = {"X": spd}
+        self.outputs = {"Out": np.linalg.cholesky(spd)}
+        self.check_output()
+        self.check_grad(["X_0"], "Out_0", max_relative_error=0.02)
+
+    def test_upper(self):
+        a = RNG.randn(3, 3)
+        spd = a @ a.T + 3 * np.eye(3)
+        self.inputs = {"X": spd}
+        self.outputs = {"Out": np.linalg.cholesky(spd).T}
+        self.attrs = {"upper": True}
+        self.check_output()
+
+
+class TestInverse(OpTest):
+    op_type = "inverse"
+
+    def test(self):
+        a = RNG.randn(4, 4) + 4 * np.eye(4)
+        self.inputs = {"Input": a}
+        self.outputs = {"Output": np.linalg.inv(a)}
+        self.check_output()
+        self.check_grad(["Input_0"], "Output_0", max_relative_error=0.02)
+
+
+class TestKron(OpTest):
+    op_type = "kron"
+
+    def test(self):
+        x = RNG.randn(2, 3)
+        y = RNG.randn(4, 2)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.kron(x, y)}
+        self.check_output()
+        self.check_grad(["X_0", "Y_0"], "Out_0")
+
+
+class TestCrossOp(OpTest):
+    op_type = "cross"
+
+    def test(self):
+        x = RNG.randn(5, 3)
+        y = RNG.randn(5, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.cross(x, y)}
+        self.attrs = {"dim": 1}
+        self.check_output()
+        self.check_grad(["X_0", "Y_0"], "Out_0")
+
+
+class TestTrace(OpTest):
+    op_type = "trace"
+
+    def test(self):
+        x = RNG.randn(2, 4, 4)
+        self.inputs = {"Input": x}
+        self.outputs = {"Out": np.trace(x, offset=1, axis1=1, axis2=2)}
+        self.attrs = {"offset": 1, "dim1": 1, "dim2": 2}
+        self.check_output()
+        self.check_grad(["Input_0"], "Out_0")

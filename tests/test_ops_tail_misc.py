@@ -1,0 +1,234 @@
+"""Tests for the round-4 misc op tail (misc_ops.py, quant additions,
+detection extras: density_prior_box, matrix_nms, prroi_pool) plus the
+coverage gate itself."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import registry
+
+RNG = np.random.RandomState(23)
+
+
+def run(op, ins, attrs=None):
+    ctx = registry.LoweringContext(eager=True)
+    return registry.execute(ctx, op, ins, attrs or {})
+
+
+class TestMiscOps:
+    def test_maxout(self):
+        x = RNG.randn(2, 6, 3, 3).astype(np.float32)
+        out = np.asarray(run("maxout", {"X": [x]}, {"groups": 2})["Out"][0])
+        exp = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+        np.testing.assert_allclose(out, exp)
+
+    def test_pool3d_avg(self):
+        import torch
+        x = RNG.randn(1, 2, 4, 4, 4).astype(np.float32)
+        out = np.asarray(run("pool3d", {"X": [x]},
+                             {"ksize": [2, 2, 2],
+                              "pooling_type": "avg"})["Out"][0])
+        ref = torch.nn.functional.avg_pool3d(
+            torch.from_numpy(x), 2).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_diag_family(self):
+        out = np.asarray(run("diag_v2", {"X": [np.arange(3.0)]})["Out"][0])
+        np.testing.assert_allclose(out, np.diag(np.arange(3.0)))
+        x = RNG.randn(2, 3).astype(np.float32)
+        out = np.asarray(run("diag_embed", {"Input": [x]})["Out"][0])
+        assert out.shape == (2, 3, 3)
+        np.testing.assert_allclose(out[0], np.diag(x[0]), atol=1e-6)
+
+    def test_histogram_allclose_isempty(self):
+        x = np.array([0.1, 0.4, 0.6, 0.9], np.float32)
+        h = np.asarray(run("histogram", {"X": [x]},
+                           {"bins": 2, "min": 0.0, "max": 1.0})["Out"][0])
+        np.testing.assert_array_equal(h, [2, 2])
+        r = run("allclose", {"Input": [x], "Other": [x + 1e-9]})
+        assert bool(np.asarray(r["Out"][0]))
+        assert not bool(np.asarray(run("is_empty", {"X": [x]})["Out"][0]))
+
+    def test_mean_iou(self):
+        r = run("mean_iou", {"Predictions": [np.array([0, 1, 1])],
+                             "Labels": [np.array([0, 1, 0])]},
+                {"num_classes": 2})
+        # class 0: inter 1, union 2 -> 0.5 ; class 1: inter 1, union 2 -> 0.5
+        assert abs(float(np.asarray(r["OutMeanIou"][0])) - 0.5) < 1e-6
+
+    def test_modified_huber(self):
+        x = np.array([0.5, -2.0], np.float32)
+        y = np.array([1.0, 1.0], np.float32)
+        out = np.asarray(run("modified_huber_loss",
+                             {"X": [x], "Y": [y]})["Out"][0])
+        np.testing.assert_allclose(out, [0.25, 8.0], atol=1e-6)
+
+    def test_add_position_encoding(self):
+        x = np.zeros((2, 3, 4), np.float32)
+        out = np.asarray(run("add_position_encoding", {"X": [x]},
+                             {"alpha": 1.0, "beta": 1.0})["Out"][0])
+        assert abs(out[0, 0, 2] - 1.0) < 1e-6      # cos(0)
+        assert abs(out[0, 0, 0]) < 1e-6            # sin(0)
+
+    def test_bilinear_tensor_product(self):
+        x = RNG.randn(2, 3).astype(np.float32)
+        y = RNG.randn(2, 4).astype(np.float32)
+        w = RNG.randn(5, 3, 4).astype(np.float32)
+        out = np.asarray(run("bilinear_tensor_product",
+                             {"X": [x], "Y": [y], "Weight": [w]})["Out"][0])
+        exp = np.einsum("bi,kij,bj->bk", x, w, y)
+        np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+    def test_spectral_norm(self):
+        w = RNG.randn(4, 5).astype(np.float32)
+        out = np.asarray(run("spectral_norm", {
+            "Weight": [w], "U": [RNG.randn(4).astype(np.float32)],
+            "V": [RNG.randn(5).astype(np.float32)]},
+            {"power_iters": 20})["Out"][0])
+        assert abs(np.linalg.svd(out, compute_uv=False)[0] - 1.0) < 1e-3
+
+    def test_edit_distance(self):
+        r = run("edit_distance", {
+            "Hyps": [np.array([[1, 2, 3]])],
+            "Refs": [np.array([[1, 3, 3, 4]])],
+            "HypsLength": [np.array([3])],
+            "RefsLength": [np.array([4])]})
+        assert float(np.asarray(r["Out"][0])[0, 0]) == 2.0
+
+    def test_ctc_align(self):
+        r = run("ctc_align", {"Input": [np.array([[1, 1, 0, 2, 2, 0, 3]])]},
+                {"blank": 0})
+        out = np.asarray(r["Output"][0])[0]
+        assert list(out[:3]) == [1, 2, 3]
+        assert int(np.asarray(r["OutputLength"][0])[0, 0]) == 3
+
+    def test_hierarchical_sigmoid(self):
+        x = RNG.randn(3, 4).astype(np.float32)
+        w = RNG.randn(7, 4).astype(np.float32)
+        r = run("hierarchical_sigmoid", {
+            "X": [x], "W": [w], "Label": [np.array([0, 3, 7])]},
+            {"num_classes": 8})
+        out = np.asarray(r["Out"][0])
+        assert out.shape == (3, 1) and np.isfinite(out).all()
+        assert (out > 0).all()
+
+    def test_teacher_student_loss(self):
+        x = RNG.randn(4, 1).astype(np.float32)
+        lab = np.array([[-2.0], [-1.0], [0.5], [1.5]], np.float32)
+        r = run("teacher_student_sigmoid_loss", {"X": [x], "Label": [lab]})
+        assert np.isfinite(np.asarray(r["Y"][0])).all()
+
+    def test_sampling_id_fc_shard_index(self):
+        r = run("sampling_id",
+                {"X": [np.array([[0.0, 1.0], [1.0, 0.0]], np.float32)]})
+        assert list(np.asarray(r["Out"][0])) == [1, 0]
+        x = RNG.randn(3, 4).astype(np.float32)
+        w = RNG.randn(4, 2).astype(np.float32)
+        r = run("fc", {"Input": [x], "W": [w]})
+        np.testing.assert_allclose(np.asarray(r["Out"][0]), x @ w,
+                                   rtol=1e-5)
+        r = run("shard_index", {"X": [np.array([0, 7, 15])]},
+                {"index_num": 16, "nshards": 2, "shard_id": 0})
+        np.testing.assert_array_equal(np.asarray(r["Out"][0]), [0, 7, -1])
+
+    def test_random_crop(self):
+        x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+        r = run("random_crop", {"X": [x]}, {"shape": [5, 5]})
+        assert r["Out"][0].shape == (2, 3, 5, 5)
+
+    def test_precision_recall(self):
+        r = run("precision_recall", {
+            "Indices": [np.array([0, 1, 1])],
+            "Labels": [np.array([0, 1, 0])]}, {"class_number": 2})
+        batch = np.asarray(r["BatchMetrics"][0])
+        # micro precision = 2/3
+        assert abs(batch[3] - 2 / 3) < 1e-6
+        states = np.asarray(r["AccumStatesInfo"][0])
+        assert states.shape == (2, 4)
+
+
+class TestQuantFamily:
+    def test_fake_quantize_abs_max(self):
+        x = RNG.randn(3, 3).astype(np.float32)
+        r = run("fake_quantize_abs_max", {"X": [x]}, {"bit_length": 8})
+        q = np.asarray(r["Out"][0])
+        s = float(np.asarray(r["OutScale"][0]))
+        assert np.abs(q).max() <= 127
+        np.testing.assert_allclose(q * s / 127, x, atol=s / 127 + 1e-6)
+
+    def test_dequantize_roundtrip(self):
+        x = RNG.randn(4, 4).astype(np.float32)
+        r = run("fake_quantize_abs_max", {"X": [x]}, {"bit_length": 8})
+        q = np.asarray(r["Out"][0])
+        s = np.asarray(r["OutScale"][0])
+        d = run("fake_dequantize_max_abs", {"X": [q], "Scale": [s]},
+                {"max_range": 127.0})
+        np.testing.assert_allclose(np.asarray(d["Out"][0]), x,
+                                   atol=float(s) / 127 + 1e-6)
+
+    def test_channel_wise(self):
+        x = RNG.randn(4, 3).astype(np.float32)
+        r = run("fake_channel_wise_quantize_abs_max", {"X": [x]},
+                {"bit_length": 8, "quant_axis": 0})
+        assert np.asarray(r["OutScale"][0]).shape == (4,)
+
+    def test_dequantize_log(self):
+        table = np.linspace(0.1, 1.0, 128).astype(np.float32)
+        x = np.array([[3, -5]], np.int8)
+        r = run("dequantize_log", {"X": [x], "Dict": [table]})
+        out = np.asarray(r["Out"][0])
+        assert out[0, 0] == table[3]
+        assert out[0, 1] == -table[123]
+
+
+class TestDetectionExtras:
+    def test_density_prior_box(self):
+        r = run("density_prior_box", {
+            "Input": [np.zeros((1, 1, 2, 2), np.float32)],
+            "Image": [np.zeros((1, 3, 8, 8), np.float32)]},
+            {"fixed_sizes": [4.0], "fixed_ratios": [1.0],
+             "densities": [2], "clip": True})
+        boxes = np.asarray(r["Boxes"][0])
+        assert boxes.shape == (2, 2, 4, 4)
+        assert (boxes >= 0).all() and (boxes <= 1).all()
+
+    def test_matrix_nms(self):
+        # two overlapping high-score boxes + one distant: the overlapped
+        # one decays below post_threshold with linear decay
+        bboxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 9.5],
+                            [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        r = run("matrix_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+                {"score_threshold": 0.1, "post_threshold": 0.5,
+                 "nms_top_k": 3, "keep_top_k": 3, "background_label": 0,
+                 "use_gaussian": False, "normalized": True})
+        out = np.asarray(r["Out"][0])
+        live = out[out[:, 0] >= 0]
+        assert len(live) == 2                      # overlapped one decayed
+        np.testing.assert_allclose(sorted(live[:, 1])[::-1], [0.9, 0.7],
+                                   atol=1e-5)
+
+    def test_prroi_pool_constant(self):
+        r = run("prroi_pool", {
+            "X": [np.full((1, 1, 6, 6), 2.0, np.float32)],
+            "ROIs": [np.array([[1.0, 1.0, 4.0, 4.0]], np.float32)]},
+            {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0})
+        np.testing.assert_allclose(np.asarray(r["Out"][0]), 2.0, atol=1e-5)
+
+
+class TestOpCoverageGate:
+    def test_coverage_at_least_80(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "op_coverage", os.path.join(os.path.dirname(__file__), "..",
+                                        "tools", "op_coverage.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if not os.path.isdir("/root/reference"):
+            pytest.skip("reference tree not present")
+        r = mod.classify("/root/reference")
+        ncov = len(r["covered"]) + len(r["aliased"])
+        pct = 100.0 * ncov / max(ncov + len(r["missing"]), 1)
+        assert pct >= 80.0, r["missing"]

@@ -1,0 +1,465 @@
+"""OpTests for the round-4 CTR + structured op tail (ctr_ops.py,
+structured_ops.py, detection extras). CTC is verified against torch's
+reference implementation; CRF against brute-force enumeration over all
+tag paths; recurrent cells against numpy unrolls of the reference
+formulas (gru_unit_op.h:53, lstm_kernel.h:30, lstm_unit_op.h:61)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(17)
+
+
+def _sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+class TestCvm(OpTest):
+    op_type = "cvm"
+
+    def test_use_cvm(self):
+        x = RNG.uniform(0.5, 5.0, (4, 6))
+        show = np.log(x[:, 0:1] + 1)
+        click = np.log(x[:, 1:2] + 1) - show
+        exp = np.concatenate([show, click, x[:, 2:]], 1)
+        self.inputs = {"X": x, "CVM": x[:, :2].copy()}
+        self.outputs = {"Y": exp}
+        self.attrs = {"use_cvm": True}
+        self.check_output()
+
+    def test_no_cvm(self):
+        x = RNG.uniform(0.5, 5.0, (4, 6))
+        self.inputs = {"X": x, "CVM": x[:, :2].copy()}
+        self.outputs = {"Y": x[:, 2:]}
+        self.attrs = {"use_cvm": False}
+        self.check_output()
+
+
+class TestDataNorm(OpTest):
+    op_type = "data_norm"
+
+    def test(self):
+        n, c = 5, 4
+        x = RNG.randn(n, c)
+        bsize = np.full(c, 10.0)
+        bsum = RNG.randn(c) * 10
+        bsq = np.full(c, 12.0) + RNG.rand(c)
+        means = bsum / bsize
+        scales = np.sqrt(bsize / bsq)
+        exp = (x - means) * scales
+        self.inputs = {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+                       "BatchSquareSum": bsq}
+        self.outputs = {"Y": exp, "Means": means, "Scales": scales}
+        self.attrs = {"slot_dim": -1}
+        self.check_output()
+        self.check_grad(["X_0"], "Y_0")
+
+
+class TestNce(OpTest):
+    op_type = "nce"
+
+    def test_shapes_and_finiteness(self):
+        n, d, c, s = 4, 3, 8, 5
+        x = RNG.randn(n, d) * 0.1
+        lab = RNG.randint(0, c, (n, 1)).astype(np.int64)
+        w = RNG.randn(c, d) * 0.1
+        b = RNG.randn(c) * 0.1
+        from paddle_tpu.ops import registry
+        ctx = registry.LoweringContext(eager=True)
+        out = registry.execute(ctx, "nce", {
+            "Input": [x], "Label": [lab], "Weight": [w], "Bias": [b]},
+            {"num_total_classes": c, "num_neg_samples": s, "sampler": 0})
+        cost = np.asarray(out["Cost"][0])
+        samples = np.asarray(out["SampleLabels"][0])
+        assert cost.shape == (n, 1) and np.isfinite(cost).all()
+        assert (cost > 0).all()  # NCE loss is positive
+        assert samples.shape == (n, 1 + s)
+        assert (samples[:, 0] == lab[:, 0]).all()
+        assert (samples >= 0).all() and (samples < c).all()
+
+
+class TestSampleLogits(OpTest):
+    op_type = "sample_logits"
+
+    def test_customized(self):
+        n, c, s = 3, 10, 4
+        logits = RNG.randn(n, c)
+        lab = RNG.randint(0, c, (n, 1)).astype(np.int64)
+        samples = np.concatenate(
+            [lab, RNG.randint(0, c, (n, s))], axis=1).astype(np.int64)
+        probs = RNG.uniform(0.05, 0.5, (n, 1 + s))
+        picked = np.take_along_axis(logits, samples, axis=1)
+        exp = picked - np.log(probs)
+        # accidental hits among negatives get suppressed
+        for i in range(n):
+            for j in range(1, 1 + s):
+                if samples[i, j] == lab[i, 0]:
+                    exp[i, j] -= 1e20
+        self.inputs = {"Logits": logits, "Labels": lab,
+                       "CustomizedSamples": samples,
+                       "CustomizedProbabilities": probs}
+        self.outputs = {"SampledLogits": exp,
+                        "Samples": samples,
+                        "Probabilities": probs,
+                        "SampledLabels": np.zeros((n, 1), np.int64)}
+        self.attrs = {"num_samples": s, "remove_accidental_hits": True}
+        self.check_output()
+
+
+def _gru_ref(x, h_prev, weight, bias, origin=False):
+    d = h_prev.shape[1]
+    w_ur = weight[:, :2 * d]
+    w_c = weight.reshape(-1)[2 * d * d:].reshape(d, d)
+    g = x + (bias if bias is not None else 0)
+    g_ur = g[:, :2 * d] + h_prev @ w_ur
+    u = _sigmoid(g_ur[:, :d])
+    r = _sigmoid(g_ur[:, d:])
+    rhp = r * h_prev
+    c = np.tanh(g[:, 2 * d:] + rhp @ w_c)
+    h = (1 - u) * c + u * h_prev if origin else u * c + (1 - u) * h_prev
+    return h, np.concatenate([u, r, c], 1), rhp
+
+
+class TestGruUnit(OpTest):
+    op_type = "gru_unit"
+
+    def test(self):
+        b, d = 4, 3
+        x = RNG.randn(b, 3 * d)
+        h_prev = RNG.randn(b, d)
+        w = RNG.randn(d, 3 * d) * 0.5
+        bias = RNG.randn(1, 3 * d) * 0.1
+        h, gate, rhp = _gru_ref(x, h_prev, w, bias)
+        self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w,
+                       "Bias": bias}
+        self.outputs = {"Hidden": h, "Gate": gate, "ResetHiddenPrev": rhp}
+        self.attrs = {"gate_activation": 1, "activation": 2}
+        self.check_output()
+        self.check_grad(["Input_0", "HiddenPrev_0", "Weight_0"], "Hidden_0",
+                        max_relative_error=0.01)
+
+
+class TestGru(OpTest):
+    op_type = "gru"
+
+    def test(self):
+        b, t, d = 2, 4, 3
+        x = RNG.randn(b, t, 3 * d)
+        w = RNG.randn(d, 3 * d) * 0.5
+        h = np.zeros((b, d))
+        hs = []
+        for step in range(t):
+            h, _, _ = _gru_ref(x[:, step], h, w, None)
+            hs.append(h)
+        exp = np.stack(hs, axis=1)
+        self.inputs = {"Input": x, "Weight": w}
+        self.outputs = {"Hidden": exp}
+        self.attrs = {"gate_activation": "sigmoid", "activation": "tanh"}
+        self.check_output(no_check_set=("BatchGate", "BatchResetHiddenPrev",
+                                        "BatchHidden"))
+        self.check_grad(["Input_0", "Weight_0"], "Hidden_0",
+                        max_relative_error=0.01)
+
+
+class TestLstmUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def test(self):
+        b, d = 3, 4
+        x = RNG.randn(b, 4 * d)
+        c_prev = RNG.randn(b, d)
+        fb = 1.0
+        i = _sigmoid(x[:, :d])
+        f = _sigmoid(x[:, d:2 * d] + fb)
+        o = _sigmoid(x[:, 2 * d:3 * d])
+        g = np.tanh(x[:, 3 * d:])
+        c = f * c_prev + i * g
+        h = o * np.tanh(c)
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.outputs = {"C": c, "H": h}
+        self.attrs = {"forget_bias": fb}
+        self.check_output()
+        self.check_grad(["X_0", "C_prev_0"], "H_0")
+
+
+def _lstm_ref_step(x, h, c, w, bias, checks):
+    d = c.shape[1]
+    g = x + h @ w + (bias if bias is not None else 0)
+    cand = np.tanh(g[:, :d])
+    ci, cf, co = checks
+    i = _sigmoid(g[:, d:2 * d] + (c * ci if ci is not None else 0))
+    f = _sigmoid(g[:, 2 * d:3 * d] + (c * cf if cf is not None else 0))
+    c2 = cand * i + c * f
+    o = _sigmoid(g[:, 3 * d:] + (c2 * co if co is not None else 0))
+    return o * np.tanh(c2), c2
+
+
+class TestLstm(OpTest):
+    op_type = "lstm"
+
+    def test_peephole(self):
+        b, t, d = 2, 3, 4
+        x = RNG.randn(b, t, 4 * d) * 0.5
+        w = RNG.randn(d, 4 * d) * 0.5
+        bias = RNG.randn(1, 7 * d) * 0.1
+        checks = (bias[0, 4 * d:5 * d], bias[0, 5 * d:6 * d],
+                  bias[0, 6 * d:])
+        h, c = np.zeros((b, d)), np.zeros((b, d))
+        hs, cs = [], []
+        for step in range(t):
+            h, c = _lstm_ref_step(x[:, step], h, c, w, bias[:, :4 * d],
+                                  checks)
+            hs.append(h)
+            cs.append(c)
+        self.inputs = {"Input": x, "Weight": w, "Bias": bias}
+        self.outputs = {"Hidden": np.stack(hs, 1), "Cell": np.stack(cs, 1)}
+        self.attrs = {"use_peepholes": True}
+        self.check_output(no_check_set=("BatchGate", "BatchCellPreAct"))
+        self.check_grad(["Input_0", "Weight_0"], "Hidden_0",
+                        max_relative_error=0.01)
+
+
+class TestWarpCtc(OpTest):
+    op_type = "warpctc"
+
+    def test_vs_torch(self):
+        import torch
+        b, t, c, l = 3, 6, 5, 2
+        logits = RNG.randn(b, t, c)
+        label = RNG.randint(1, c, (b, l)).astype(np.int64)
+        logit_len = np.array([6, 5, 4], np.int64)
+        label_len = np.array([2, 2, 1], np.int64)
+        lp = torch.from_numpy(logits).permute(1, 0, 2).log_softmax(-1)
+        ref = torch.nn.functional.ctc_loss(
+            lp, torch.from_numpy(label), torch.from_numpy(logit_len),
+            torch.from_numpy(label_len), blank=0,
+            reduction="none").numpy()
+        self.inputs = {"Logits": logits, "Label": label,
+                       "LogitsLength": logit_len, "LabelLength": label_len}
+        self.outputs = {"Loss": ref[:, None]}
+        self.attrs = {"blank": 0}
+        self.check_output(no_check_set=("WarpCTCGrad",))
+        self.check_grad(["Logits_0"], "Loss_0", max_relative_error=0.01)
+
+
+class TestLinearChainCrf(OpTest):
+    op_type = "linear_chain_crf"
+
+    def test_brute_force(self):
+        b, t, k = 2, 3, 3
+        emission = RNG.randn(b, t, k)
+        transition = RNG.randn(k + 2, k) * 0.5
+        label = RNG.randint(0, k, (b, t)).astype(np.int64)
+        length = np.array([3, 2], np.int64)
+        start_w, end_w, trans = (transition[0], transition[1],
+                                 transition[2:])
+
+        import itertools
+        exp = np.zeros((b, 1))
+        for i in range(b):
+            L = length[i]
+            scores = []
+            for path in itertools.product(range(k), repeat=int(L)):
+                s = start_w[path[0]] + end_w[path[-1]]
+                for step in range(L):
+                    s += emission[i, step, path[step]]
+                for step in range(1, L):
+                    s += trans[path[step - 1], path[step]]
+                scores.append(s)
+            logz = np.logaddexp.reduce(scores)
+            gold = start_w[label[i, 0]] + end_w[label[i, L - 1]]
+            for step in range(L):
+                gold += emission[i, step, label[i, step]]
+            for step in range(1, L):
+                gold += trans[label[i, step - 1], label[i, step]]
+            exp[i, 0] = logz - gold
+        self.inputs = {"Emission": emission, "Transition": transition,
+                       "Label": label, "Length": length}
+        self.outputs = {"LogLikelihood": exp}
+        self.check_output(no_check_set=("Alpha", "EmissionExps",
+                                        "TransitionExps"))
+        self.check_grad(["Emission_0", "Transition_0"], "LogLikelihood_0",
+                        max_relative_error=0.01)
+
+
+class TestConv3dTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def test(self):
+        import torch
+        x = RNG.randn(1, 2, 3, 3, 3)
+        w = RNG.randn(2, 3, 2, 2, 2)  # [in, out, kd, kh, kw]
+        ref = torch.nn.functional.conv_transpose3d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": ref}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        self.check_output(atol=1e-8)
+        self.check_grad(["Input_0", "Filter_0"], "Output_0",
+                        max_relative_error=0.01)
+
+
+class TestConv2dTransposePad0Regression(OpTest):
+    """p=0 exposed the conv_transpose padding-semantics bug (p_jax =
+    d*(k-1) - p); the original sweep only covered k=3, p=1 where the wrong
+    pass-through happens to coincide."""
+    op_type = "conv2d_transpose"
+
+    def test(self):
+        import torch
+        x = RNG.randn(1, 2, 4, 4)
+        w = RNG.randn(2, 3, 3, 3)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=1).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": ref}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0]}
+        self.check_output(atol=1e-8)
+
+
+class TestDepthwiseConv2dTranspose(OpTest):
+    op_type = "depthwise_conv2d_transpose"
+
+    def test(self):
+        import torch
+        c = 3
+        x = RNG.randn(2, c, 4, 4)
+        w = RNG.randn(c, 1, 3, 3)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1,
+            groups=c).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": ref}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1], "groups": c}
+        self.check_output(atol=1e-8)
+        self.check_grad(["Input_0", "Filter_0"], "Output_0",
+                        max_relative_error=0.01)
+
+
+class TestDeformableConv(OpTest):
+    op_type = "deformable_conv"
+
+    def test_zero_offset_equals_conv(self):
+        import torch
+        n, c, h, w_, co, kh, kw = 1, 2, 5, 5, 3, 3, 3
+        x = RNG.randn(n, c, h, w_)
+        filt = RNG.randn(co, c, kh, kw)
+        ref = torch.nn.functional.conv2d(
+            torch.from_numpy(x), torch.from_numpy(filt), padding=1).numpy()
+        offset = np.zeros((n, 2 * kh * kw, h, w_))
+        mask = np.ones((n, kh * kw, h, w_))
+        self.inputs = {"Input": x, "Offset": offset, "Mask": mask,
+                       "Filter": filt}
+        self.outputs = {"Output": ref}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "deformable_groups": 1}
+        self.check_output(atol=1e-8)
+        self.check_grad(["Input_0", "Filter_0"], "Output_0",
+                        max_relative_error=0.01)
+
+
+class TestFsp(OpTest):
+    op_type = "fsp"
+
+    def test(self):
+        x = RNG.randn(2, 3, 4, 4)
+        y = RNG.randn(2, 5, 4, 4)
+        exp = np.einsum("nihw,njhw->nij", x, y) / 16
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": exp}
+        self.check_output()
+        self.check_grad(["X_0", "Y_0"], "Out_0")
+
+
+class TestRoiPool(OpTest):
+    op_type = "roi_pool"
+
+    def test_manual(self):
+        x = np.arange(16, dtype=np.float64).reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]])
+        # 2x2 pooling of the full 4x4: bin maxima
+        exp = np.array([[[[5.0, 7.0], [13.0, 15.0]]]])
+        self.inputs = {"X": x, "ROIs": rois}
+        self.outputs = {"Out": exp}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        self.check_output(no_check_set=("Argmax",))
+        self.check_grad(["X_0"], "Out_0")
+
+
+class TestPsroiPool(OpTest):
+    op_type = "psroi_pool"
+
+    def test_manual(self):
+        # 4 channels -> 1 output channel with 2x2 grid; each bin reads its
+        # own channel. Constant-per-channel input makes expectations exact.
+        x = np.stack([np.full((4, 4), v) for v in [1.0, 2.0, 3.0, 4.0]])
+        x = x[None]  # (1, 4, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]])
+        exp = np.array([[[[1.0, 2.0], [3.0, 4.0]]]])
+        self.inputs = {"X": x, "ROIs": rois}
+        self.outputs = {"Out": exp}
+        self.attrs = {"output_channels": 1, "pooled_height": 2,
+                      "pooled_width": 2, "spatial_scale": 1.0}
+        self.check_output()
+
+
+class TestYolov3Loss(OpTest):
+    op_type = "yolov3_loss"
+
+    def test_structural(self):
+        from paddle_tpu.ops import registry
+        n, h, w, cls = 2, 4, 4, 3
+        anchors = [10, 13, 16, 30, 33, 23]
+        anchor_mask = [0, 1, 2]
+        mask_num = len(anchor_mask)
+        x = RNG.randn(n, mask_num * (5 + cls), h, w) * 0.1
+        gtbox = np.array([
+            [[0.3, 0.3, 0.2, 0.2], [0.6, 0.6, 0.3, 0.4],
+             [0.0, 0.0, 0.0, 0.0]],
+            [[0.5, 0.5, 0.25, 0.25], [0.0, 0.0, 0.0, 0.0],
+             [0.0, 0.0, 0.0, 0.0]]])
+        gtlabel = RNG.randint(0, cls, (n, 3)).astype(np.int64)
+        ctx = registry.LoweringContext(eager=True)
+        out = registry.execute(ctx, "yolov3_loss", {
+            "X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+            {"anchors": anchors, "anchor_mask": anchor_mask,
+             "class_num": cls, "ignore_thresh": 0.7,
+             "downsample_ratio": 32, "use_label_smooth": True})
+        loss = np.asarray(out["Loss"][0])
+        obj = np.asarray(out["ObjectnessMask"][0])
+        match = np.asarray(out["GTMatchMask"][0])
+        assert loss.shape == (n,) and np.isfinite(loss).all()
+        assert (loss > 0).all()
+        assert obj.shape == (n, mask_num, h, w)
+        assert match.shape == (n, 3)
+        # invalid gt boxes (zero w/h) must not match
+        assert match[0, 2] == -1 and match[1, 1] == -1 and match[1, 2] == -1
+        # valid gts matched some anchor in the mask
+        assert match[0, 0] >= 0 and match[1, 0] >= 0
+
+    def test_grad_flows(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops import registry
+        n, h, w, cls = 1, 4, 4, 2
+        anchors = [10, 13, 16, 30]
+        x = RNG.randn(n, 2 * (5 + cls), h, w) * 0.1
+        gtbox = np.array([[[0.4, 0.4, 0.3, 0.3]]])
+        gtlabel = np.array([[1]], np.int64)
+        ctx = registry.LoweringContext(eager=True)
+
+        def f(xv):
+            out = registry.execute(ctx, "yolov3_loss", {
+                "X": [xv], "GTBox": [jnp.asarray(gtbox)],
+                "GTLabel": [jnp.asarray(gtlabel)]},
+                {"anchors": anchors, "anchor_mask": [0, 1],
+                 "class_num": cls, "ignore_thresh": 0.7,
+                 "downsample_ratio": 32, "use_label_smooth": False})
+            return out["Loss"][0].sum()
+
+        g = jax.grad(f)(jnp.asarray(x))
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
