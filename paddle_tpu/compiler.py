@@ -118,9 +118,8 @@ class _ParallelRunner:
         from .distributed import env as dist_env
         mesh = dist_env.current_mesh()
         if mesh is None:
-            import jax
-            from jax.sharding import Mesh
-            mesh = Mesh(np.asarray(jax.devices()), (self.c._data_axis,))
+            from .distributed.env import build_mesh
+            mesh = build_mesh((self.c._data_axis,))
             dist_env.set_mesh(mesh)
             dist_env.register_ring(0, self.c._data_axis)
         self.c._mesh = mesh

@@ -40,3 +40,56 @@ def set_mesh(mesh):
 
 def current_mesh():
     return _mesh
+
+
+def build_mesh(axis_names, shape=None, *, devices=None):
+    """Topology-aware mesh construction (SURVEY step 1's ICI/DCN
+    discovery; analog of the reference's device-topology probing in
+    platform/device_context + collective_helper ring setup).
+
+    axis_names: tuple of logical axis names, e.g. ("dp", "mp").
+    shape: per-axis sizes; -1 (at most one) infers from device count.
+           Defaults to putting ALL devices on the last axis.
+
+    On TPU the *last* axis is laid out over ICI-adjacent chips: devices
+    expose 3-D torus coordinates (`device.coords`) and we sort
+    lexicographically by (slice, z, y, x, core) so consecutive devices in
+    the mesh's fastest-varying dimension are physical neighbors — tensor-
+    parallel collectives then ride single-hop ICI links while the outer
+    (dp/pp) axes span farther hops or DCN. On CPU/GPU backends there are
+    no coords and enumeration order is used (pure reshape fallback).
+    """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    axis_names = tuple(axis_names)
+    if shape is None:
+        shape = (1,) * (len(axis_names) - 1) + (n,)
+    shape = list(int(s) for s in shape)
+    if shape.count(-1) > 1:
+        raise ValueError("at most one -1 axis size")
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        shape[shape.index(-1)] = n // known
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {tuple(shape)} != {n} devices")
+    if len(shape) != len(axis_names):
+        raise ValueError("shape/axis_names length mismatch")
+
+    def sort_key(d):
+        coords = getattr(d, "coords", None)
+        core = getattr(d, "core_on_chip", 0)
+        slice_idx = getattr(d, "slice_index", 0) or 0
+        if coords is None:
+            return (slice_idx, d.id)
+        x, y, z = (tuple(coords) + (0, 0, 0))[:3]
+        return (slice_idx, z, y, x, core)
+
+    devs = sorted(devs, key=sort_key)
+    arr = np.array(devs, dtype=object).reshape(shape)
+    return Mesh(arr, axis_names)

@@ -59,13 +59,12 @@ def init_parallel_env(data_axis: str = "dp",
     from jax.sharding import Mesh
     from . import env as dist_env
 
-    devices = np.asarray(jax.devices())
+    from .env import build_mesh
     if mesh_shape:
-        names = tuple(mesh_shape.keys())
-        sizes = tuple(mesh_shape.values())
-        mesh = Mesh(devices.reshape(sizes), names)
+        mesh = build_mesh(tuple(mesh_shape.keys()),
+                          tuple(mesh_shape.values()))
     else:
-        mesh = Mesh(devices, (data_axis,))
+        mesh = build_mesh((data_axis,))
     dist_env.set_mesh(mesh)
     dist_env.set_data_axis(data_axis if data_axis in mesh.axis_names else None)
     dist_env.register_ring(0, data_axis)

@@ -240,6 +240,12 @@ class PSServer:
                 gen = self._barrier_gen
                 while gen == self._barrier_gen:
                     if not self._barrier_cv.wait(timeout=60):
+                        # roll back this waiter's arrival so a later
+                        # barrier round can't release early with fewer
+                        # than `expected` real participants
+                        if gen == self._barrier_gen and \
+                                self._barrier_count > 0:
+                            self._barrier_count -= 1
                         return struct.pack("<B", 0)
             return struct.pack("<B", 1)
         if op == OP_HEARTBEAT:

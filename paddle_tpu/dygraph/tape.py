@@ -270,12 +270,18 @@ def default_tracer() -> Tracer:
     return _tracer
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=True,
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False):
     """paddle.grad parity — grads of ``outputs`` w.r.t. ``inputs``
     WITHOUT touching ``.grad`` (the PartialGradEngine capability,
     imperative/partial_grad_engine.cc). Returns a list aligned with
-    ``inputs`` (None where unused, if allow_unused)."""
+    ``inputs`` (None where unused, if allow_unused).
+
+    ``retain_graph=None`` follows ``create_graph`` (the reference's
+    default): eager loops calling grad() each step free the walked node
+    graph instead of silently accumulating it."""
+    if retain_graph is None:
+        retain_graph = create_graph
     if create_graph:
         raise NotImplementedError(
             "create_graph=True (higher-order grad) is not supported; "

@@ -247,6 +247,39 @@ class Tensor:
     def __len__(self):
         return self.value.shape[0] if self.value.ndim else 0
 
+    # -- python scalar protocol ---------------------------------------
+    # Eagerly these behave like the reference's VarBase scalar coercions.
+    # Under a to_static trace the value is a jax tracer and coercion
+    # would silently bake ONE branch of data-dependent python control
+    # flow into the compiled graph (the miscompile the reference's AST
+    # transformer dygraph_to_static/program_translator.py:667 exists to
+    # prevent) — so raise with guidance instead.
+
+    def _concrete(self, what):
+        import jax as _jax
+        if isinstance(self.value, _jax.core.Tracer):
+            raise TypeError(
+                f"cannot convert a traced Tensor to a python {what} inside "
+                "jit.to_static: data-dependent `if`/`while` on tensor "
+                "values would silently compile only the branch taken "
+                "during tracing. Use paddle_tpu.layers.cond / "
+                "layers.while_loop (lax.cond/while_loop) for traced "
+                "control flow, or compute this value outside the "
+                "to_static function.")
+        return self.value
+
+    def __bool__(self):
+        return bool(self._concrete("bool"))
+
+    def __int__(self):
+        return int(self._concrete("int"))
+
+    def __float__(self):
+        return float(self._concrete("float"))
+
+    def __index__(self):
+        return int(self._concrete("index"))
+
     def __repr__(self):
         grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
         return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_txt},\n"

@@ -314,7 +314,15 @@ class DeleteDropoutPass(Pass):
                 impl = node.op.attr("dropout_implementation",
                                     "downgrade_in_infer")
                 if impl == "upscale_in_train":
-                    graph.remove_op_rewire(node, {out: x})
+                    # emit assign rather than a pure rewire: `out` may be
+                    # a fetch target with no in-graph reader, and a rewire
+                    # would erase the name (XLA elides the copy anyway)
+                    ident = graph.new_op("assign", {"X": [x]},
+                                         {"Out": [out]}, {})
+                    graph.replace_ops(
+                        [node], ident,
+                        drop_vars=[n for n in node.output_names()
+                                   if n != out])
                 else:
                     keep = 1.0 - float(node.op.attr("dropout_prob", 0.5))
                     scale = graph.new_op(

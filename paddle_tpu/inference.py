@@ -2,13 +2,15 @@
 
 Capability analog of the reference's AnalysisPredictor front door
 (paddle/fluid/inference/api/analysis_predictor.cc,
-paddle_analysis_config.h). The reference's 125-pass analysis/fusion
-pipeline and TensorRT subgraph engines collapse by design: the loaded
-Program compiles through the trace-once executor into ONE XLA
-computation (XLA performs the fusions the ir passes hand-coded), cached
-per input-shape signature. The Predictor owns a private Scope (the
-reference's per-predictor scope) so params load once and concurrent
-predictors don't collide.
+paddle_analysis_config.h). The reference runs an ordered IR-pass pipeline
+inside the predictor (inference/analysis/ir_pass_manager.cc, pass list
+from api/paddle_pass_builder.cc); here the structural passes that still
+matter on TPU (dropout deletion, BN folding, add+act fusion) run through
+the same framework/ir.py PassManager at predictor build, and everything
+XLA already does (elementwise fusion, layout, memory planning) collapses
+into the trace-once executor's single compiled computation. The Predictor
+owns a private Scope (the reference's per-predictor scope) so params load
+once and concurrent predictors don't collide.
 """
 
 from __future__ import annotations
@@ -17,22 +19,61 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+# Ordered inference pass pipeline — the TPU-relevant subset of the
+# reference's CpuPassStrategy (api/paddle_pass_builder.cc:141): passes
+# that change the op graph structurally. Purely-computational fusions are
+# left to XLA.
+INFERENCE_PASSES = [
+    "delete_dropout_op_pass",
+    "fuse_bn_act_pass",
+    "fuse_elewise_add_act_pass",
+]
+
+
+def apply_inference_passes(program, passes: Optional[Sequence[str]] = None):
+    """Run the inference pass pipeline over a loaded program, skipping
+    passes that are not registered (mirrors ir_pass_manager.cc's
+    tolerance for absent passes)."""
+    from .framework.ir import PassManager, registered_passes
+    wanted = INFERENCE_PASSES if passes is None else passes
+    names = [p for p in wanted if p in registered_passes()]
+    return PassManager(names).apply(program)
+
 
 class Config:
-    """paddle.inference.Config parity surface (model dir + knobs; the
-    accelerator-selection knobs are no-ops — XLA owns placement)."""
+    """paddle.inference.Config parity surface. `switch_ir_optim` gates the
+    IR pass pipeline (on by default, like the reference);
+    `enable_memory_optim` maps to executor buffer donation. Accelerator-
+    selection knobs remain no-ops — XLA owns placement."""
 
     def __init__(self, model_dir: str):
         self.model_dir = model_dir
+        self._ir_optim = True
+        self._memory_optim = False
+        self._glog_info = True
+        self._passes: Optional[List[str]] = None
 
     def enable_memory_optim(self, flag: bool = True):
-        pass  # XLA owns buffer reuse/donation
+        self._memory_optim = bool(flag)
+
+    def memory_optim_enabled(self) -> bool:
+        return self._memory_optim
 
     def switch_ir_optim(self, flag: bool = True):
-        pass  # XLA does the graph optimization
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def pass_builder(self) -> List[str]:
+        """Mutable pass list (analog of paddle_pass_builder.h); edits
+        apply to predictors created afterwards."""
+        if self._passes is None:
+            self._passes = list(INFERENCE_PASSES)
+        return self._passes
 
     def disable_glog_info(self):
-        pass
+        self._glog_info = False
 
 
 class Predictor:
@@ -46,16 +87,25 @@ class Predictor:
         from .framework import Executor, Scope
         from .framework_io import load_inference_model
         self._scope = Scope()
-        self._exe = Executor()
+        self._exe = Executor(
+            donate_state=config.memory_optim_enabled())
         self._program, self._feed_names, self._fetch_names = \
             load_inference_model(config.model_dir, self._exe,
                                  scope=self._scope)
+        if config.ir_optim():
+            self._program = apply_inference_passes(
+                self._program, config._passes)
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)
 
     def get_output_names(self) -> List[str]:
         return list(self._fetch_names)
+
+    @property
+    def program(self):
+        """The (possibly pass-optimized) program this predictor runs."""
+        return self._program
 
     def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
         if len(inputs) != len(self._feed_names):

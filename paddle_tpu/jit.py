@@ -370,6 +370,11 @@ def load(path: str) -> TranslatedLayer:
     with open(path + ".pdmodel") as f:
         meta = json.load(f)
     prog = Program.from_dict(meta["program"])
+    # same structural cleanup the inference Predictor applies on load
+    # (ir_pass_manager.cc analog): saved programs are is_test traces, so
+    # dropout deletion / BN folding are always valid here
+    from .inference import apply_inference_passes
+    prog = apply_inference_passes(prog)
     data = np.load(path + ".pdiparams.npz")
     state = {k: data[k] for k in data.files}
     return TranslatedLayer(prog, meta["feed_names"], meta["fetch_names"],

@@ -3,7 +3,7 @@
 Capability analog of the reference's beam-search machinery
 (operators/beam_search_op.cc, beam_search_decode_op.cc and fluid
 layers/rnn.py BeamSearchDecoder) — redesigned without LoD: the beam is a
-dense [batch*beam] axis, KV caches ride along it, and每 step is ordinary
+dense [batch*beam] axis, KV caches ride along it, and each step is ordinary
 top-k over [batch, beam*vocab] scores. Decoding loops on the host (the
 per-step compiled model is the hot path, as in any autoregressive
 serving stack).

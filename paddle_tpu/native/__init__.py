@@ -3,14 +3,19 @@
 The reference implements its data/runtime plane in C++ (data_feed.cc,
 executor.cc, distributed/ RPC); this package holds the TPU build's native
 equivalents. Binding is ctypes over a C ABI (pybind11 is unavailable in
-this image). Each component compiles lazily with g++ on first use and
-caches the .so next to the source keyed by source mtime; a pure-Python
-fallback keeps every feature functional where no toolchain exists.
+this image). Each component compiles lazily with g++ on first use; the
+built .so is keyed by a content hash of the source (embedded in the
+filename), so a source edit always rebuilds — mtimes are useless after
+git checkout, which stamps source and any committed binary identically.
+A pure-Python fallback keeps every feature functional where no toolchain
+exists.
 """
 
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,21 +26,34 @@ _libs = {}
 
 
 def build_and_load(name: str) -> Optional[ctypes.CDLL]:
-    """Compile native/<name>.cpp -> _<name>.so (if stale) and dlopen it.
-    Returns None when no g++ toolchain is available."""
+    """Compile native/<name>.cpp -> _<name>-<srchash>.so (if absent) and
+    dlopen it. Returns None when no g++ toolchain is available."""
     with _lock:
         if name in _libs:
             return _libs[name]
         here = os.path.dirname(os.path.abspath(__file__))
         src = os.path.join(here, f"{name}.cpp")
-        so = os.path.join(here, f"_{name}.so")
         try:
-            if (not os.path.exists(so)
-                    or os.path.getmtime(so) < os.path.getmtime(src)):
+            with open(src, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            so = os.path.join(here, f"_{name}-{digest}.so")
+            if not os.path.exists(so):
+                # compile to a temp path and rename: a killed g++ must
+                # not leave a truncated .so at the final name (rename is
+                # atomic on the same filesystem)
+                tmp = so + f".tmp{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", src, "-o", so],
+                     "-pthread", src, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+                # drop stale builds of the same component
+                for old in glob.glob(os.path.join(here, f"_{name}-*.so")):
+                    if old != so:
+                        try:
+                            os.unlink(old)
+                        except OSError:
+                            pass
             lib = ctypes.CDLL(so)
         except (OSError, subprocess.SubprocessError):
             lib = None

@@ -387,6 +387,12 @@ struct Server {
         bool ok = barrier_cv.wait_for(
             g, std::chrono::seconds(60),
             [&] { return gen != barrier_gen; });
+        // timed-out waiter rolls back its arrival so a later round
+        // can't release early with fewer real participants (wire
+        // parity with rpc.py's python server)
+        if (!ok && gen == barrier_gen && barrier_count > 0) {
+          barrier_count--;
+        }
         w.scalar<uint8_t>(ok ? 1 : 0);
         return true;
       }

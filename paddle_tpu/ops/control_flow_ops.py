@@ -79,11 +79,21 @@ def _while(ctx, ins, attrs):
         def step(carry, _):
             cond_val, xs, rng = carry
             rng, sub_rng = jax.random.split(rng)
-            new_cond, new_xs = run_body(cond_val, xs, sub_rng)
             live = _scalar_bool(cond_val)
-            sel = lambda a, b: jnp.where(live, a, b)  # noqa: E731
-            xs = tuple(sel(a, b) for a, b in zip(new_xs, xs))
-            cond_val = sel(new_cond, cond_val)
+
+            # guard dead iterations with lax.cond rather than a masked
+            # select: a select still EXECUTES the body on the stale
+            # carry, and value-sensitive ops (div/gather/log) can emit
+            # non-finite intermediates whose cotangents leak NaN through
+            # the where in the backward (the classic where-grad trap);
+            # cond's vjp only differentiates the taken branch
+            def take(_):
+                return run_body(cond_val, xs, sub_rng)
+
+            def skip(_):
+                return cond_val, xs
+
+            cond_val, xs = jax.lax.cond(live, take, skip, None)
             return (cond_val, xs, rng), None
 
         (cond_f, xs, _), _ = jax.lax.scan(

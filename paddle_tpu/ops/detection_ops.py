@@ -344,10 +344,18 @@ def _multiclass_nms(ctx, ins, attrs):
             [jnp.where(valid, labels, -1.0)[:, None],
              jnp.where(valid, top_vals, 0.0)[:, None],
              jnp.where(valid[:, None], sel, 0.0)], axis=1)
-        return rows, valid.sum().astype(jnp.int64)
+        return rows, valid.sum().astype(jnp.int64), \
+            jnp.where(valid, box_idx, -1).astype(jnp.int32)
 
-    out, num = jax.vmap(one_image)(bboxes, scores)
-    return {"Out": [out], "NumDetected": [num]}
+    out, num, box_indices = jax.vmap(one_image)(bboxes, scores)
+    outs = {"Out": [out], "NumDetected": [num]}
+    # stashed for multiclass_nms2's Index output: index of each kept
+    # detection into the ORIGINAL input boxes (flat across the batch)
+    offs = jnp.arange(out.shape[0], dtype=jnp.int32)[:, None] * m
+    outs["__flat_index__"] = [
+        jnp.where(box_indices >= 0, box_indices + offs, -1)
+        .reshape(-1, 1)]
+    return outs
 
 
 # ------------------------------------------------------------- roi_align
@@ -744,6 +752,9 @@ def _matrix_nms(ctx, ins, attrs):
         jmask = jnp.arange(pre)[:, None] > jnp.arange(pre)[None, :]
         decay = jnp.min(jnp.where(jmask, decay_ij, jnp.inf), axis=1)
         decay = jnp.where(jnp.isfinite(decay), decay, 1.0)
+        # reference matrix_nms_op.cc:150 starts min_decay at 1.0 — decay
+        # only ever suppresses, never boosts
+        decay = jnp.minimum(decay, 1.0)
         ds = decay * s
         ds = jnp.where(ds > post_thresh, ds, 0.0)
         return ds, order

@@ -567,11 +567,12 @@ def _shard_index(ctx, ins, attrs):
 
 @register("multiclass_nms2", not_differentiable=True)
 def _multiclass_nms2(ctx, ins, attrs):
-    """reference multiclass_nms_op.cc (v2: adds Index output)."""
+    """reference multiclass_nms_op.cc (v2: adds Index — each kept
+    detection's index into the ORIGINAL input boxes, flat across the
+    batch; -1 on padding rows)."""
     from .registry import OPS
     out = OPS["multiclass_nms"].lowering(ctx, ins, attrs)
-    res = out["Out"][0]
-    out["Index"] = [jnp.arange(res.shape[0], dtype=jnp.int32)[:, None]]
+    out["Index"] = out.pop("__flat_index__")
     return out
 
 

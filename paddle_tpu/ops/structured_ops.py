@@ -36,11 +36,15 @@ def _act(name):
 
 
 def _gru_step(x_t, h_prev, weight, bias, act_gate, act_node, origin_mode):
-    """x_t: (B, 3D) pre-projected input; weight: (D, 3D) laid out as the
-    reference's [W_u | W_r] (D,2D) + flat candidate W_c (D,D) tail."""
+    """x_t: (B, 3D) pre-projected input; weight: flat (D*3D) buffer laid
+    out as the reference's GEMMs read it (gru_unit_op.h:90,104): first
+    2*D*D elements are the update/reset weights viewed (D, 2D) with
+    leading dimension 2D, the remaining D*D the candidate weight (D, D).
+    NOTE this is NOT a column slice of a (D, 3D) matrix view."""
     d = h_prev.shape[1]
-    w_ur = weight[:, :2 * d]
-    w_c = weight.reshape(-1)[2 * d * d:].reshape(d, d)
+    flat = weight.reshape(-1)
+    w_ur = flat[:2 * d * d].reshape(d, 2 * d)
+    w_c = flat[2 * d * d:].reshape(d, d)
     g = x_t + (bias if bias is not None else 0.0)
     g_ur = g[:, :2 * d] + h_prev @ w_ur
     u = act_gate(g_ur[:, :d])

@@ -94,3 +94,71 @@ def test_op_bench_cli():
     result = json.loads(line)
     assert result["op"] == "matmul_v2"
     assert result["min_ms"] > 0 and result["gflops"] > 0.0
+
+
+def test_predictor_ir_optim_pass_pipeline(tmp_path):
+    """switch_ir_optim runs the inference pass pipeline at build: the
+    loaded program shrinks (dropout gone, BN folded) and outputs match
+    the unoptimized predictor (ir_pass_manager.cc analog)."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 7
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        h = layers.fc(x, 8)
+        h = layers.dropout(h, dropout_prob=0.3)
+        h = layers.batch_norm(h)
+        pred = layers.relu(h)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    d = str(tmp_path / "model_ir")
+    pt.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+
+    from paddle_tpu.inference import Config, create_predictor
+
+    cfg_plain = Config(d)
+    cfg_plain.switch_ir_optim(False)
+    plain = create_predictor(cfg_plain)
+
+    cfg_opt = Config(d)
+    cfg_opt.switch_ir_optim(True)
+    cfg_opt.enable_memory_optim(True)
+    opt = create_predictor(cfg_opt)
+
+    plain_types = [op.type for op in plain.program.global_block().ops]
+    opt_types = [op.type for op in opt.program.global_block().ops]
+    assert "dropout" in plain_types and "batch_norm" in plain_types
+    # dropout deleted (inference scale), BN folded to primitive math,
+    # BN+relu fused — the black-box ops are gone from the optimized program
+    assert "dropout" not in opt_types
+    assert "batch_norm" not in opt_types
+    assert "fused_scale_bias_relu" in opt_types
+
+    (ref,) = plain.run([xv])
+    (got,) = opt.run([xv])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_jit_load_applies_passes(tmp_path):
+    """jit.load runs the same structural cleanup as the Predictor."""
+    import paddle_tpu.nn as nn
+
+    class M(pt.dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(self.fc(x))
+
+    m = M()
+    m.eval()   # jit.save traces inference semantics
+    xv = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+    eager = m(pt.dygraph.to_tensor(xv)).numpy()
+    path = str(tmp_path / "jitm")
+    pt.jit.save(m, path, input_spec=[xv])
+    loaded = pt.jit.load(path)
+    types = [op.type for op in loaded.program.global_block().ops]
+    assert "dropout" not in types, types
+    np.testing.assert_allclose(loaded(xv).numpy(), eager, rtol=1e-5)

@@ -82,3 +82,36 @@ def test_multi_output_and_example_tensor_spec(tmp_path):
                                np.asarray(ra.value), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(ob.value),
                                np.asarray(rb.value), rtol=1e-5)
+
+
+def test_to_static_rejects_data_dependent_branch():
+    """Before this guard, `if t.sum() > 0:` under to_static silently
+    compiled the traced branch (python object-truthiness on the wrapper);
+    now it raises pointing at layers.cond (analog of the reference's
+    dygraph_to_static program_translator guard)."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as pt
+
+    @pt.jit.to_static
+    def f(x):
+        if x.sum() > 0:        # data-dependent python branch
+            return x * 2
+        return x - 1
+
+    with pytest.raises(TypeError, match="cond"):
+        f(np.ones((2, 2), np.float32))
+
+
+def test_tensor_scalar_coercion_eager_still_works():
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    t = pt.dygraph.to_tensor(np.asarray(3.5, np.float32))
+    assert float(t) == 3.5
+    assert int(t) == 3
+    assert bool(pt.dygraph.to_tensor(np.asarray(1)))
+    arr = np.zeros((4,))
+    assert float(arr[int(pt.dygraph.to_tensor(np.asarray(2)))]) == 0.0

@@ -232,3 +232,22 @@ class TestOpCoverageGate:
         ncov = len(r["covered"]) + len(r["aliased"])
         pct = 100.0 * ncov / max(ncov + len(r["missing"]), 1)
         assert pct >= 80.0, r["missing"]
+
+
+def test_multiclass_nms2_index_points_into_input():
+    """Index must be the kept detection's row in the ORIGINAL input boxes
+    (reference multiclass_nms2), not an output-row counter."""
+    bboxes = np.array([[[0, 0, 1, 1], [5, 5, 6, 6], [10, 10, 11, 11]]],
+                      np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.2, 0.9, 0.6]      # best box is input row 1
+    r = run("multiclass_nms2", {"BBoxes": [bboxes], "Scores": [scores]},
+            {"background_label": 0, "score_threshold": 0.1,
+             "nms_threshold": 0.5, "nms_top_k": 3, "keep_top_k": 3})
+    out = np.asarray(r["Out"][0])[0]
+    idx = np.asarray(r["Index"][0]).reshape(-1)
+    live = out[:, 0] >= 0
+    # kept rows ordered by score: input rows 1, 2, 0
+    np.testing.assert_array_equal(idx[live], [1, 2, 0])
+    for row, i in zip(out[live], idx[live]):
+        np.testing.assert_allclose(row[2:], bboxes[0, i])

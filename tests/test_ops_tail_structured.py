@@ -109,9 +109,12 @@ class TestSampleLogits(OpTest):
 
 
 def _gru_ref(x, h_prev, weight, bias, origin=False):
+    # reference flat-buffer layout (gru_unit_op.h GEMMs): gates = first
+    # 2*D*D elements viewed (D, 2D), candidate = last D*D viewed (D, D)
     d = h_prev.shape[1]
-    w_ur = weight[:, :2 * d]
-    w_c = weight.reshape(-1)[2 * d * d:].reshape(d, d)
+    flat = weight.reshape(-1)
+    w_ur = flat[:2 * d * d].reshape(d, 2 * d)
+    w_c = flat[2 * d * d:].reshape(d, d)
     g = x + (bias if bias is not None else 0)
     g_ur = g[:, :2 * d] + h_prev @ w_ur
     u = _sigmoid(g_ur[:, :d])
