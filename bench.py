@@ -72,7 +72,17 @@ def build_steps(model_name: str):
 
     cfg = GPT_CONFIGS[model_name]
     model = GPTForCausalLM(cfg)
-    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    moment_dtype = ("bfloat16" if os.environ.get("BENCH_BF16_MOMENTS")
+                    else None)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                moment_dtype=moment_dtype)
+    if "BENCH_FLASH_BQ" in os.environ or "BENCH_FLASH_BK" in os.environ:
+        from paddle_tpu import flags as _flags
+        _flags.set_flags({
+            "pallas_flash_block_q": int(os.environ.get(
+                "BENCH_FLASH_BQ", 512)),
+            "pallas_flash_block_k": int(os.environ.get(
+                "BENCH_FLASH_BK", 512))})
 
     def train_step(ids, labels):
         with amp.auto_cast(level="O2"):
@@ -86,6 +96,76 @@ def build_steps(model_name: str):
     multi = jit.to_static_multi_step(train_step, layers=[model],
                                      optimizers=[opt])
     return cfg, step, multi
+
+
+# ResNet-50 fwd FLOPs per image at 224x224 (the standard 4.1 GFLOP
+# figure, He et al. accounting); scales with spatial area.
+RESNET50_FWD_FLOPS_224 = 4.089e9
+
+
+def child_main_resnet(batch: int, img: int, steps: int) -> int:
+    """BENCH_MODEL=resnet50: image-classification train-step config
+    (BASELINE.md's ResNet-50 DP row, single chip)."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import amp, jit
+    from paddle_tpu.vision import resnet50
+
+    dev = jax.devices()[0]
+    peak = detect_peak_flops(dev)
+    try:
+        model = resnet50(num_classes=1000)
+        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+        ce = pt.nn.CrossEntropyLoss()
+
+        def train_step(img_b, lab_b):
+            with amp.auto_cast(level="O2"):
+                logits = model(pt.dygraph.to_tensor(img_b))
+                loss = ce(logits, pt.dygraph.to_tensor(lab_b))
+            model.clear_gradients()
+            loss.backward()
+            opt.step()
+            return loss
+
+        step = jit.to_static(train_step, layers=[model], optimizers=[opt])
+        multi = jit.to_static_multi_step(train_step, layers=[model],
+                                         optimizers=[opt])
+        rng = np.random.RandomState(0)
+        x1 = rng.randn(batch, 3, img, img).astype(np.float32)
+        l1 = rng.randint(0, 1000, (batch,)).astype(np.int64)
+        for _ in range(2):
+            np.asarray(step(x1, l1).value)
+        xs = rng.randn(steps, batch, 3, img, img).astype(np.float32)
+        ls = rng.randint(0, 1000, (steps, batch)).astype(np.int64)
+        np.asarray(multi(xs, ls).value)
+        t0 = time.perf_counter()
+        losses = np.asarray(multi(xs, ls).value)
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            sys.stderr.write("OOM: " + msg[:300] + "\n")
+            return OOM_RC
+        raise
+
+    imgs_per_sec = batch / dt
+    fwd = RESNET50_FWD_FLOPS_224 * (img / 224.0) ** 2
+    mfu = 3.0 * fwd * imgs_per_sec / peak
+    if mfu > 1.0:
+        sys.stderr.write(f"implausible MFU {mfu*100:.1f}% — refusing\n")
+        return 3
+    print(json.dumps({
+        "metric": "resnet50_mfu", "value": round(mfu * 100, 2),
+        "unit": "%", "vs_baseline": round(mfu / 0.40, 4),
+        "images_per_sec_per_chip": round(imgs_per_sec, 1),
+        "step_time_ms": round(dt * 1000, 2), "batch": batch, "img": img,
+        "loss": round(float(losses[-1]), 4),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "peak_flops": peak,
+    }))
+    return 0
 
 
 def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
@@ -155,7 +235,10 @@ def main() -> int:
     model_name = os.environ.get("BENCH_MODEL", "gpt2-medium")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    default_batch = "64" if model_name == "resnet50" else "8"
+    batch = int(os.environ.get("BENCH_BATCH", default_batch))
+    if model_name == "resnet50":
+        seq = int(os.environ.get("BENCH_IMG", "224"))
 
     here = os.path.abspath(__file__)
     last_err = ""
@@ -185,6 +268,11 @@ def main() -> int:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         i = sys.argv.index("--child")
-        sys.exit(child_main(sys.argv[i + 1], int(sys.argv[i + 2]),
+        name = sys.argv[i + 1]
+        if name == "resnet50":
+            sys.exit(child_main_resnet(int(sys.argv[i + 2]),
+                                       int(sys.argv[i + 3]),
+                                       int(sys.argv[i + 4])))
+        sys.exit(child_main(name, int(sys.argv[i + 2]),
                             int(sys.argv[i + 3]), int(sys.argv[i + 4])))
     sys.exit(main())

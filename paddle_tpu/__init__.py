@@ -95,3 +95,25 @@ def set_device(device: str):
 def get_device() -> str:
     import jax
     return jax.default_backend()
+
+
+# -- fluid-era compatibility surface ------------------------------------
+from .fluid_compat import (CPUPlace, CUDAPlace, DataFeeder, batch,  # noqa
+                           dataset as _compat_dataset, reader)
+
+# `paddle.dataset.*` in classic programs is the functional reader plane;
+# graft the synthetic reader fixtures onto the slot-Dataset module so
+# `paddle.dataset.uci_housing.train()` resolves like the reference.
+dataset_compat = _compat_dataset
+from . import dataset as _ds_mod  # noqa: E402
+_ds_mod.uci_housing = _compat_dataset.uci_housing
+_ds_mod.mnist = _compat_dataset.mnist
+
+
+def __getattr__(name):
+    if name == "fluid":
+        from .fluid_compat import build_fluid_module
+        mod = build_fluid_module()
+        globals()["fluid"] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

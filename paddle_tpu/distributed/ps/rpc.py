@@ -240,11 +240,15 @@ class PSServer:
                 gen = self._barrier_gen
                 while gen == self._barrier_gen:
                     if not self._barrier_cv.wait(timeout=60):
+                        # timed out — but the release may have raced the
+                        # timeout (C++ twin's predicated wait_for sees
+                        # the gen change; mirror it for wire parity)
+                        if gen != self._barrier_gen:
+                            break
                         # roll back this waiter's arrival so a later
                         # barrier round can't release early with fewer
                         # than `expected` real participants
-                        if gen == self._barrier_gen and \
-                                self._barrier_count > 0:
+                        if self._barrier_count > 0:
                             self._barrier_count -= 1
                         return struct.pack("<B", 0)
             return struct.pack("<B", 1)

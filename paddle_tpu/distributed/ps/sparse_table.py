@@ -41,12 +41,34 @@ class SparseTable:
         # per-row optimizer state (adagrad accumulators)
         self._accum: List[Dict[int, np.ndarray]] = [
             {} for _ in range(shard_num)]
+        # rows staged by a PullPrefetcher (ps/prefetch.py), keyed by the
+        # exact ids payload; consumed once by the next matching pull.
+        # Staging is only honored while a prefetcher is actively scoped
+        # (_stage_active > 0) — an abandoned loop's leftovers must never
+        # serve a later unrelated pull with pre-push values.
+        self._staged: Dict[bytes, np.ndarray] = {}
+        self._stage_lock = threading.Lock()
+        self._stage_active = 0
 
     def _shard(self, key: int) -> int:
         return int(key) % self.shard_num
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
-        """Gather rows (init-on-miss, like the reference's prefetch)."""
+        """Gather rows (init-on-miss). Rows prefetched for this exact ids
+        array by ps/prefetch.PullPrefetcher are consumed without touching
+        the shards (the DownpourWorker overlap path); a miss falls
+        through to a normal gather."""
+        if self._staged and self._stage_active > 0:
+            from .prefetch import _stage_key
+            key = _stage_key(ids)
+            with self._stage_lock:
+                rows = self._staged.pop(key, None)
+            if rows is not None:
+                return rows.reshape(
+                    tuple(np.asarray(ids).shape) + (self.value_dim,))
+        return self._pull_now(ids)
+
+    def _pull_now(self, ids: np.ndarray) -> np.ndarray:
         flat = np.asarray(ids).reshape(-1)
         out = np.empty((flat.size, self.value_dim), np.float32)
         for i, k in enumerate(flat):

@@ -103,3 +103,8 @@ define_flag("use_pallas_layer_norm", False,
 define_flag("pallas_min_seq", 1024,
             "Minimum sequence length before attention switches from the "
             "XLA-composed form to the Pallas flash kernel.")
+define_flag("pallas_flash_block_q", 512,
+            "Flash-attention q-block size (tuning knob; clipped to the "
+            "largest power-of-two divisor of seq).")
+define_flag("pallas_flash_block_k", 512,
+            "Flash-attention k-block size (tuning knob).")

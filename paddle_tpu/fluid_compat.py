@@ -1,0 +1,280 @@
+"""`fluid` compatibility namespace + classic reader combinators.
+
+Lets reference-era programs — e.g. the book tests under
+python/paddle/fluid/tests/book/ (test_fit_a_line.py,
+test_recognize_digits.py) — run against this framework with only the
+import lines changed: ``import paddle_tpu as paddle;
+fluid = paddle.fluid``. Provides the fluid module surface (layers,
+optimizer, Executor(place), places, DataFeeder, io, program accessors)
+and the classic functional reader pipeline (paddle.batch /
+paddle.reader.shuffle / paddle.dataset.*), whose datasets here are
+deterministic synthetic fixtures — this image has no network egress, and
+the book tests only need the training dynamics, not the real rows.
+"""
+
+from __future__ import annotations
+
+import random as _random
+import types as _types
+
+import numpy as np
+
+
+# -- places (placement belongs to XLA; these are accepted and ignored) --
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class CUDAPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"CUDAPlace({self.device_id})"
+
+
+class TPUPlace:
+    def __repr__(self):
+        return "TPUPlace"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+class DataFeeder:
+    """fluid.DataFeeder parity: list-of-sample-tuples -> feed dict.
+
+    Each sample is a tuple aligned with feed_list; samples are stacked
+    along a new batch axis (the reference converts through LoDTensor;
+    dense batching is the redesign)."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_list = feed_list
+        self._names = [getattr(v, "name", v) for v in feed_list]
+        self._dtypes = [getattr(v, "dtype", "float32") for v in feed_list]
+        self._shapes = [list(getattr(v, "shape", []) or [])
+                        for v in feed_list]
+
+    def feed(self, iterable):
+        cols = list(zip(*iterable))
+        out = {}
+        for name, dtype, shape, col in zip(self._names, self._dtypes,
+                                           self._shapes, cols):
+            arr = np.asarray(col)
+            if arr.dtype == np.float64 and str(dtype) == "float32":
+                arr = arr.astype(np.float32)
+            # reshape flat samples to the var's per-sample shape (the
+            # reference DataFeeder's LoDTensor shape coercion)
+            per = [d for d in shape[1:] if d is not None]
+            if per and all(d > 0 for d in per):
+                want = int(np.prod(per))
+                got = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+                if got == want:
+                    arr = arr.reshape([arr.shape[0]] + per)
+                elif arr.ndim == 1 and want == 1:
+                    arr = arr[:, None]
+            elif arr.ndim == 1:
+                arr = arr[:, None]
+            out[name] = arr
+        return out
+
+
+class _FluidExecutor:
+    """fluid.Executor(place) shim over the framework Executor (the place
+    argument is accepted for parity; XLA owns placement)."""
+
+    def __init__(self, place=None):
+        from .framework import Executor, global_scope
+        self._exe = Executor()
+        self._scope = global_scope()
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        from .framework import default_main_program
+        program = program if program is not None else default_main_program()
+        return self._exe.run(program, feed=feed or {},
+                             fetch_list=[getattr(v, "name", v)
+                                         for v in (fetch_list or [])],
+                             scope=scope or self._scope)
+
+    def close(self):
+        pass
+
+
+# -- classic functional readers ----------------------------------------
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch parity (reader decorator)."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def _shuffle(reader, buf_size):
+    """paddle.reader.shuffle parity (buffered shuffle decorator)."""
+
+    def shuffled():
+        rng = _random.Random(0)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                for s in buf:
+                    yield s
+                buf = []
+        rng.shuffle(buf)
+        for s in buf:
+            yield s
+
+    return shuffled
+
+
+reader = _types.ModuleType("paddle_tpu.reader_compat")
+reader.shuffle = _shuffle
+reader.buffered = lambda r, size: r
+
+
+# -- synthetic dataset fixtures (zero-egress stand-ins) -----------------
+
+
+def _uci_housing_rows(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 13).astype(np.float32)
+    true_w = np.random.RandomState(7).randn(13, 1).astype(np.float32) * 4.0
+    y = x @ true_w + 2.0 + rng.randn(n, 1).astype(np.float32) * 0.1
+    return [(x[i], y[i]) for i in range(n)]
+
+
+def _make_uci_housing():
+    mod = _types.ModuleType("paddle_tpu.dataset.uci_housing")
+
+    def train():
+        def r():
+            for s in _uci_housing_rows(400, seed=0):
+                yield s
+        return r
+
+    def test():
+        def r():
+            for s in _uci_housing_rows(100, seed=1):
+                yield s
+        return r
+
+    mod.train = train
+    mod.test = test
+    return mod
+
+
+def _mnist_rows(n, seed):
+    # class-separable synthetic digits: class k lights up block k
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        label = int(rng.randint(0, 10))
+        img = rng.rand(784).astype(np.float32) * 0.1
+        img[label * 70:(label + 1) * 70] += 0.9
+        out.append((img * 2 - 1, label))  # reference normalizes to [-1,1]
+    return out
+
+
+def _make_mnist():
+    mod = _types.ModuleType("paddle_tpu.dataset.mnist")
+
+    def train():
+        def r():
+            for s in _mnist_rows(2000, seed=0):
+                yield s
+        return r
+
+    def test():
+        def r():
+            for s in _mnist_rows(400, seed=1):
+                yield s
+        return r
+
+    mod.train = train
+    mod.test = test
+    return mod
+
+
+dataset = _types.ModuleType("paddle_tpu.dataset_compat")
+dataset.uci_housing = _make_uci_housing()
+dataset.mnist = _make_mnist()
+
+
+def build_fluid_module():
+    """Assemble the `fluid` namespace lazily (avoids import cycles)."""
+    import paddle_tpu as _pt
+    from . import framework_io as _io
+    from .framework import (default_main_program, default_startup_program,
+                            global_scope, program_guard, unique_name)
+
+    fluid = _types.ModuleType("paddle_tpu.fluid")
+    fluid.layers = _pt.layers
+    fluid.optimizer = _pt.optimizer
+    fluid.initializer = _pt.initializer
+    fluid.ParamAttr = _pt.ParamAttr
+    fluid.Executor = _FluidExecutor
+    fluid.CPUPlace = CPUPlace
+    fluid.CUDAPlace = CUDAPlace
+    fluid.default_main_program = default_main_program
+    fluid.default_startup_program = default_startup_program
+    fluid.program_guard = program_guard
+    fluid.global_scope = global_scope
+    fluid.unique_name = unique_name
+    fluid.Program = _pt.framework.Program
+    fluid.DataFeeder = DataFeeder
+    fluid.is_compiled_with_cuda = is_compiled_with_cuda
+
+    io = _types.ModuleType("paddle_tpu.fluid.io")
+
+    def save_inference_model(dirname, feeded_var_names, target_vars,
+                             executor, main_program=None, **kw):
+        return _io.save_inference_model(
+            dirname, feeded_var_names, target_vars,
+            getattr(executor, "_exe", executor), main_program,
+            scope=getattr(executor, "_scope", None))
+
+    def load_inference_model(dirname, executor, **kw):
+        return _io.load_inference_model(
+            dirname, getattr(executor, "_exe", executor),
+            scope=getattr(executor, "_scope", None))
+
+    io.save_inference_model = save_inference_model
+    io.load_inference_model = load_inference_model
+    fluid.io = io
+
+    nets = _types.ModuleType("paddle_tpu.fluid.nets")
+
+    def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                             pool_stride, pool_padding=0, pool_type="max",
+                             act=None, **kw):
+        """fluid.nets.simple_img_conv_pool parity (nets.py:31)."""
+        conv = _pt.layers.conv2d(input, num_filters=num_filters,
+                                 filter_size=filter_size, act=act)
+        return _pt.layers.pool2d(conv, pool_size=pool_size,
+                                 pool_type=pool_type,
+                                 pool_stride=pool_stride,
+                                 pool_padding=pool_padding)
+
+    nets.simple_img_conv_pool = simple_img_conv_pool
+    fluid.nets = nets
+    fluid.core = _types.ModuleType("paddle_tpu.fluid.core")
+    fluid.core.CPUPlace = CPUPlace
+    fluid.core.CUDAPlace = CUDAPlace
+    return fluid

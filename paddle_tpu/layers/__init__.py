@@ -15,6 +15,20 @@ from .control_flow import (While, case, cond, equal, greater_equal,
                            greater_than, less_equal, less_than, logical_and,
                            logical_not, logical_or, not_equal, switch_case,
                            while_loop)
+from .nn_extra import (add_position_encoding, affine_channel, affine_grid,
+                       bilinear_tensor_product, bpr_loss, center_loss,
+                       continuous_value_model, cos_sim, crop_tensor,
+                       ctc_greedy_decoder, data_norm, edit_distance,
+                       gather_tree, grid_sampler, hinge_loss, hsigmoid,
+                       huber_loss, image_resize, index_sample,
+                       linear_chain_crf, log_loss, lrn, margin_rank_loss,
+                       masked_select, maxout, mean_iou, mish, multiplex,
+                       nce, pad_constant_like, pixel_shuffle, rank_loss,
+                       resize_bilinear, resize_linear, resize_nearest,
+                       resize_trilinear, reverse, row_conv, sampling_id,
+                       scatter_nd_add, selu, shuffle_channel,
+                       space_to_depth, spectral_norm, teacher_student_sigmoid_loss,
+                       temporal_shift, unfold, warpctc)
 from . import detection
 from .sequence_lod import (sequence_concat, sequence_conv,
                            sequence_enumerate, sequence_expand,

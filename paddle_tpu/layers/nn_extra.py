@@ -1,0 +1,431 @@
+"""Second tranche of fluid.layers wrappers over the round-4 op tail.
+
+Analog of python/paddle/fluid/layers/nn.py's long tail (lrn, multiplex,
+image resamplers, pixel_shuffle, grid ops, losses, CTR ops, structured
+ops...) — thin builders that append the new lowerings to the current
+program. Split from layers/nn.py to keep both files reviewable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..layer_helper import LayerHelper
+
+
+def _one_out(op, inputs, attrs=None, out_slot="Out", name=None, dtype=None,
+             extra_outputs=()):
+    helper = LayerHelper(op, name=name)
+    first = next(iter(inputs.values()))
+    ref = first[0] if isinstance(first, (list, tuple)) else first
+    out = helper.create_variable_for_type_inference(
+        dtype or getattr(ref, "dtype", "float32"))
+    outputs = {out_slot: out}
+    extras = []
+    for slot in extra_outputs:
+        v = helper.create_variable_for_type_inference(
+            dtype or getattr(ref, "dtype", "float32"))
+        outputs[slot] = v
+        extras.append(v)
+    helper.append_op(op, inputs=inputs, outputs=outputs, attrs=attrs or {})
+    return (out, *extras) if extras else out
+
+
+# -- normalization / image ---------------------------------------------
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """fluid.layers.lrn parity (lrn_op.cc)."""
+    out, _ = _one_out("lrn", {"X": input},
+                      {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                      name=name, extra_outputs=("MidOut",))
+    return out
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _one_out("pixel_shuffle", {"X": x},
+                    {"upscale_factor": upscale_factor,
+                     "data_format": data_format}, name=name)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _one_out("space_to_depth", {"X": x},
+                    {"blocksize": blocksize}, name=name)
+
+
+def shuffle_channel(x, group, name=None):
+    return _one_out("shuffle_channel", {"X": x}, {"group": group},
+                    name=name)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _one_out("temporal_shift", {"X": x},
+                    {"seg_num": seg_num, "shift_ratio": shift_ratio},
+                    name=name)
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    return _one_out("affine_channel",
+                    {"X": x, "Scale": scale, "Bias": bias},
+                    {"data_layout": data_layout}, name=name)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    inputs = {"Theta": theta}
+    attrs = {"align_corners": align_corners}
+    if isinstance(out_shape, (list, tuple)):
+        attrs["output_shape"] = [int(s) for s in out_shape]
+    else:
+        inputs["OutputShape"] = out_shape
+    return _one_out("affine_grid", inputs, attrs, out_slot="Output",
+                    name=name)
+
+
+def grid_sampler(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True, name=None):
+    return _one_out("grid_sampler", {"X": x, "Grid": grid},
+                    {"mode": mode, "padding_mode": padding_mode,
+                     "align_corners": align_corners},
+                    out_slot="Output", name=name)
+
+
+def _resize(op, input, out_shape, scale, name, extra=None):
+    attrs = dict(extra or {})
+    if out_shape is not None:
+        keys = ["out_w"] if op.startswith("linear") else (
+            ["out_d", "out_h", "out_w"] if op.startswith("trilinear")
+            else ["out_h", "out_w"])
+        for k_, v in zip(keys, out_shape):
+            attrs[k_] = int(v)
+    if scale:
+        attrs["scale"] = float(scale)
+    return _one_out(op, {"X": input}, attrs, name=name)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None):
+    return _resize("linear_interp_v2", input, out_shape, scale, name)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return _resize("bilinear_interp_v2", input, out_shape, scale, name)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None):
+    return _resize("trilinear_interp_v2", input, out_shape, scale, name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    return _resize("nearest_interp_v2", input, out_shape, scale, name)
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None):
+    op = {"BILINEAR": "bilinear_interp_v2",
+          "NEAREST": "nearest_interp_v2",
+          "BICUBIC": "bicubic_interp_v2",
+          "TRILINEAR": "trilinear_interp_v2",
+          "LINEAR": "linear_interp_v2"}[resample.upper()]
+    return _resize(op, input, out_shape, scale, name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    attrs = {}
+    inputs = {"X": x}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = [int(s) for s in shape]
+    elif shape is not None:
+        inputs["Shape"] = shape
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = [int(o) for o in offsets]
+    elif offsets is not None:
+        inputs["Offsets"] = offsets
+    return _one_out("crop_tensor", inputs, attrs, name=name)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _one_out("pad_constant_like", {"X": x, "Y": y},
+                    {"pad_value": pad_value}, name=name)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return _one_out("unfold", {"X": x},
+                    {"kernel_sizes": _pair(kernel_sizes),
+                     "strides": _pair(strides),
+                     "paddings": _pair(paddings),
+                     "dilations": _pair(dilations)},
+                    out_slot="Y", name=name)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _one_out("maxout", {"X": x}, {"groups": groups, "axis": axis},
+                    name=name)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _one_out("add_position_encoding", {"X": input},
+                    {"alpha": alpha, "beta": beta}, name=name)
+
+
+# -- selection ----------------------------------------------------------
+
+
+def multiplex(inputs, index, name=None):
+    return _one_out("multiplex", {"X": list(inputs), "Ids": index},
+                    name=name)
+
+
+def index_sample(x, index, name=None):
+    return _one_out("index_sample", {"X": x, "Index": index}, name=name)
+
+
+def masked_select(x, mask, name=None):
+    """Eager-only (data-dependent output shape; the lowering raises under
+    trace with guidance)."""
+    return _one_out("masked_select", {"X": x, "Mask": mask},
+                    out_slot="Y", name=name)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _one_out("scatter_nd_add",
+                    {"X": ref, "Index": index, "Updates": updates},
+                    name=name)
+
+
+def gather_tree(ids, parents):
+    return _one_out("gather_tree", {"Ids": ids, "Parents": parents})
+
+
+def reverse(x, axis, name=None):
+    return _one_out("reverse", {"X": x},
+                    {"axis": axis if isinstance(axis, (list, tuple))
+                     else [axis]}, name=name)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    return _one_out("sampling_id", {"X": x},
+                    {"min": min, "max": max, "seed": seed}, name=name,
+                    dtype=dtype)
+
+
+# -- activations --------------------------------------------------------
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    return _one_out("selu", {"X": x}, attrs, name=name)
+
+
+def mish(x, threshold=20.0, name=None):
+    return _one_out("mish", {"X": x}, {"threshold": threshold}, name=name)
+
+
+# -- losses -------------------------------------------------------------
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _one_out("log_loss", {"Predicted": input, "Labels": label},
+                    {"epsilon": epsilon}, out_slot="Loss", name=name)
+
+
+def rank_loss(label, left, right, name=None):
+    return _one_out("rank_loss",
+                    {"Label": label, "Left": left, "Right": right},
+                    name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    out, _ = _one_out("margin_rank_loss",
+                      {"X1": left, "X2": right, "Label": label},
+                      {"margin": margin}, name=name,
+                      extra_outputs=("Activated",))
+    return out
+
+
+def hinge_loss(input, label, name=None):
+    return _one_out("hinge_loss", {"Logits": input, "Labels": label},
+                    out_slot="Loss", name=name)
+
+
+def bpr_loss(input, label, name=None):
+    return _one_out("bpr_loss", {"X": input, "Label": label},
+                    out_slot="Y", name=name)
+
+
+def center_loss(input, label, centers, update_rate, num_classes,
+                update_center=True, name=None):
+    loss, diff, centers_out = _one_out(
+        "center_loss",
+        {"X": input, "Label": label, "Centers": centers,
+         "CenterUpdateRate": update_rate},
+        {"cluster_num": num_classes, "need_update": update_center},
+        out_slot="Loss", name=name,
+        extra_outputs=("SampleCenterDiff", "CentersOut"))
+    return loss, centers_out
+
+
+def cos_sim(X, Y, name=None):
+    out, _, _ = _one_out("cos_sim", {"X": X, "Y": Y}, name=name,
+                         extra_outputs=("XNorm", "YNorm"))
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    raise NotImplementedError(
+        "npair_loss: compose from matmul + softmax_with_cross_entropy")
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _one_out("teacher_student_sigmoid_loss",
+                    {"X": input, "Label": label}, out_slot="Y")
+
+
+def huber_loss(input, label, delta, name=None):
+    return _one_out("huber_loss", {"X": input, "Y": label},
+                    {"delta": delta}, name=name)
+
+
+# -- CTR / structured ---------------------------------------------------
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _one_out("cvm", {"X": input, "CVM": cvm},
+                    {"use_cvm": use_cvm}, out_slot="Y")
+
+
+def data_norm(input, batch_size, batch_sum, batch_square_sum, slot_dim=-1,
+              name=None):
+    out, _, _ = _one_out(
+        "data_norm",
+        {"X": input, "BatchSize": batch_size, "BatchSum": batch_sum,
+         "BatchSquareSum": batch_square_sum},
+        {"slot_dim": slot_dim}, out_slot="Y", name=name,
+        extra_outputs=("Means", "Scales"))
+    return out
+
+
+def nce(input, label, weight, bias=None, num_total_classes=None,
+        num_neg_samples=10, sampler="uniform", name=None):
+    sampler_id = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler]
+    inputs = {"Input": input, "Label": label, "Weight": weight}
+    if bias is not None:
+        inputs["Bias"] = bias
+    cost, _, _ = _one_out(
+        inputs=inputs, op="nce",
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples, "sampler": sampler_id},
+        out_slot="Cost", name=name,
+        extra_outputs=("SampleLogits", "SampleLabels"))
+    return cost
+
+
+def hsigmoid(input, label, num_classes, weight, bias=None, name=None):
+    inputs = {"X": input, "Label": label, "W": weight}
+    if bias is not None:
+        inputs["Bias"] = bias
+    out, _ = _one_out("hierarchical_sigmoid", inputs,
+                      {"num_classes": num_classes}, name=name,
+                      extra_outputs=("PreOut",))
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """Returns the per-sequence negative log likelihood; the Transition
+    parameter must be created by the caller (shape (num_tags+2, num_tags))
+    and passed via param_attr as an existing Variable."""
+    inputs = {"Emission": input, "Label": label, "Transition": param_attr}
+    if length is not None:
+        inputs["Length"] = length
+    ll, _, _, _ = _one_out(
+        "linear_chain_crf", inputs, out_slot="LogLikelihood", name=name,
+        extra_outputs=("Alpha", "EmissionExps", "TransitionExps"))
+    return ll
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    inputs = {"Logits": input, "Label": label}
+    if input_length is not None:
+        inputs["LogitsLength"] = input_length
+    if label_length is not None:
+        inputs["LabelLength"] = label_length
+    loss, _ = _one_out("warpctc", inputs,
+                       {"blank": blank, "norm_by_times": norm_by_times},
+                       out_slot="Loss", extra_outputs=("WarpCTCGrad",))
+    return loss
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None):
+    inputs = {"Hyps": input, "Refs": label}
+    if input_length is not None:
+        inputs["HypsLength"] = input_length
+    if label_length is not None:
+        inputs["RefsLength"] = label_length
+    dist, seq_num = _one_out("edit_distance", inputs,
+                             {"normalized": normalized},
+                             extra_outputs=("SequenceNum",))
+    return dist, seq_num
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Dense redesign: argmax over classes then ctc_align collapse."""
+    from . import nn as _nn
+    idx = _nn.topk(input, 1)[1]
+    idx2 = _nn.reshape(idx, [0, -1])
+    inputs = {"Input": idx2}
+    out, lens = _one_out("ctc_align", inputs, {"blank": blank,
+                                               "merge_repeated": True},
+                         out_slot="Output", name=name,
+                         extra_outputs=("OutputLength",))
+    return out, lens
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Creates the lookahead filter parameter internally."""
+    helper = LayerHelper("row_conv")
+    d = input.shape[-1]
+    filt = helper.create_parameter(
+        [future_context_size + 1, d], dtype=input.dtype, attr=param_attr)
+    return _one_out("row_conv", {"X": input, "Filter": filt})
+
+
+def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
+                            act=None, name=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = helper.create_parameter([size, dx, dy], dtype=x.dtype,
+                                attr=param_attr)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter([1, size], dtype=x.dtype,
+                                    attr=bias_attr, is_bias=True)
+        inputs["Bias"] = b
+    return _one_out("bilinear_tensor_product", inputs, name=name)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    import numpy as _np
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w = int(_np.prod(weight.shape)) // h
+    u = helper.create_parameter([h], dtype=weight.dtype)
+    v = helper.create_parameter([w], dtype=weight.dtype)
+    return _one_out("spectral_norm", {"Weight": weight, "U": u, "V": v},
+                    {"dim": dim, "power_iters": power_iters, "eps": eps},
+                    name=name)
+
+
+def mean_iou(input, label, num_classes):
+    miou, wrong, correct = _one_out(
+        "mean_iou", {"Predictions": input, "Labels": label},
+        {"num_classes": num_classes}, out_slot="OutMeanIou",
+        extra_outputs=("OutWrong", "OutCorrect"))
+    return miou, wrong, correct

@@ -77,8 +77,10 @@ def _fused_attention_qkv(ctx, ins, attrs):
     if use_pallas:
         try:
             from .pallas.flash_attention import flash_attention
-            return {"Out": [flash_attention(q, k, v, causal=causal,
-                                            scale=scale)]}
+            return {"Out": [flash_attention(
+                q, k, v, causal=causal, scale=scale,
+                block_q=flags.get_flag("pallas_flash_block_q"),
+                block_k=flags.get_flag("pallas_flash_block_k"))]}
         except (ValueError, ImportError) as e:
             # untileable shapes, or a jax without pallas/Mosaic —
             # fall back to the XLA-composed form, loudly (once)

@@ -349,12 +349,13 @@ def _multiclass_nms(ctx, ins, attrs):
 
     out, num, box_indices = jax.vmap(one_image)(bboxes, scores)
     outs = {"Out": [out], "NumDetected": [num]}
-    # stashed for multiclass_nms2's Index output: index of each kept
-    # detection into the ORIGINAL input boxes (flat across the batch)
-    offs = jnp.arange(out.shape[0], dtype=jnp.int32)[:, None] * m
-    outs["__flat_index__"] = [
-        jnp.where(box_indices >= 0, box_indices + offs, -1)
-        .reshape(-1, 1)]
+    if attrs.get("__want_index__"):
+        # multiclass_nms2's Index: each kept detection's index into the
+        # ORIGINAL input boxes (flat across the batch, -1 on padding)
+        offs = jnp.arange(out.shape[0], dtype=jnp.int32)[:, None] * m
+        outs["Index"] = [
+            jnp.where(box_indices >= 0, box_indices + offs, -1)
+            .reshape(-1, 1)]
     return outs
 
 

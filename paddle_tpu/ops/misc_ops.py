@@ -571,9 +571,8 @@ def _multiclass_nms2(ctx, ins, attrs):
     detection's index into the ORIGINAL input boxes, flat across the
     batch; -1 on padding rows)."""
     from .registry import OPS
-    out = OPS["multiclass_nms"].lowering(ctx, ins, attrs)
-    out["Index"] = out.pop("__flat_index__")
-    return out
+    return OPS["multiclass_nms"].lowering(
+        ctx, ins, dict(attrs, __want_index__=True))
 
 
 @register("random_crop", no_grad_slots=("Seed",))

@@ -402,11 +402,16 @@ class Optimizer:
             lr_arr = jnp.asarray([lr * getattr(p, "lr_scale", 1.0)],
                                  jnp.float32)
             ins = {"Param": [p.value], "Grad": [g], "LearningRate": [lr_arr]}
+            moment_dtype = getattr(self, "_moment_dtype", None)
             for slot, key, init, shape in spec["accums"]:
                 skey = (id(p), key)
                 if skey not in self._eager_state:
+                    dt = p.value.dtype
+                    if moment_dtype is not None and shape is None \
+                            and key in ("m1", "m2", "moment", "mom"):
+                        dt = jnp.dtype(moment_dtype)
                     self._eager_state[skey] = jnp.full(
-                        shape or p.value.shape, init, p.value.dtype)
+                        shape or p.value.shape, init, dt)
                 ins[slot] = [self._eager_state[skey]]
             outs = _reg.execute(ctx, op_type, ins, self._eager_attrs())
             for oslot, target in spec["outs"].items():
@@ -414,6 +419,9 @@ class Optimizer:
                 if target == "param":
                     p.value = val
                 else:
+                    prev = self._eager_state.get((id(p), target))
+                    if prev is not None and val.dtype != prev.dtype:
+                        val = val.astype(prev.dtype)  # keep bf16 storage
                     self._eager_state[(id(p), target)] = val
     def clear_grad(self):
         for p in self._parameters_or_raise:
@@ -559,9 +567,13 @@ class AdamOptimizer(Optimizer):
     _eager_op = "adam"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_mode=False, **kw):
+                 epsilon=1e-8, lazy_mode=False, moment_dtype=None, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # moment_dtype="bfloat16" stores m/v in bf16 — halves optimizer
+        # state HBM (the factored/low-precision-moment trade; update math
+        # still runs in the promoted dtype, storage rounds back)
+        self._moment_dtype = moment_dtype
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:

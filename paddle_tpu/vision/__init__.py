@@ -9,9 +9,11 @@ numpy-HWC pipelines; datasets read local files (no downloads).
 from . import datasets
 from . import models
 from . import transforms
-from .models import (LeNet, ResNet, resnet18, resnet34, resnet50,
-                     resnet101, vgg11, vgg16, VGG)
+from .models import (LeNet, MobileNetV1, MobileNetV2, ResNet,
+                     mobilenet_v1, mobilenet_v2, resnet18, resnet34,
+                     resnet50, resnet101, vgg11, vgg16, VGG)
 
 __all__ = ["datasets", "models", "transforms", "LeNet", "ResNet",
            "resnet18", "resnet34", "resnet50", "resnet101", "VGG",
-           "vgg11", "vgg16"]
+           "vgg11", "vgg16", "MobileNetV1", "MobileNetV2",
+           "mobilenet_v1", "mobilenet_v2"]

@@ -173,3 +173,142 @@ def vgg11(num_classes=1000, **kw):
 
 def vgg16(num_classes=1000, **kw):
     return VGG(16, num_classes, **kw)
+
+
+class _ConvBNReLU(Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0, groups=1,
+                 act="relu6"):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride,
+                           padding=padding, groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self._act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        if self._act == "relu6":
+            return F.relu6(x)
+        if self._act == "relu":
+            return F.relu(x)
+        return x
+
+
+class MobileNetV1(Layer):
+    """vision/models/mobilenetv1.py parity: depthwise-separable stack.
+    Depthwise 3x3 (groups=C) + pointwise 1x1 pairs, width multiplier
+    `scale`."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (in, out, stride of the depthwise conv)
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1),
+            (512, 1024, 2), (1024, 1024, 1)]
+        blocks = [_ConvBNReLU(3, c(32), 3, stride=2, padding=1, act="relu")]
+        for in_c, out_c, s in cfg:
+            blocks.append(_ConvBNReLU(c(in_c), c(in_c), 3, stride=s,
+                                      padding=1, groups=c(in_c),
+                                      act="relu"))
+            blocks.append(_ConvBNReLU(c(in_c), c(out_c), 1, act="relu"))
+        self.features = Sequential(*blocks)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([0, -1])
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(Layer):
+    """MobileNetV2 block: 1x1 expand -> 3x3 depthwise -> 1x1 project,
+    residual when stride 1 and shapes match."""
+
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = (stride == 1 and in_c == out_c)
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(in_c, hidden, 1))
+        layers.extend([
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden),
+            _ConvBNReLU(hidden, out_c, 1, act="none"),
+        ])
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class MobileNetV2(Layer):
+    """vision/models/mobilenetv2.py parity (inverted residuals)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t (expand), c (out), n (repeat), s (stride)
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        blocks = [_ConvBNReLU(3, in_c, 3, stride=2, padding=1)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                blocks.append(InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        blocks.append(_ConvBNReLU(in_c, last_c, 1))
+        self.features = Sequential(*blocks)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([0, -1])
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(scale=1.0, num_classes=1000, **kw):
+    return MobileNetV1(scale=scale, num_classes=num_classes, **kw)
+
+
+def mobilenet_v2(scale=1.0, num_classes=1000, **kw):
+    return MobileNetV2(scale=scale, num_classes=num_classes, **kw)

@@ -1,0 +1,218 @@
+"""Book-style end-to-end tests — transcriptions of the reference's
+python/paddle/fluid/tests/book/{test_fit_a_line.py,
+test_recognize_digits.py} train+infer bodies, changed ONLY in the import
+lines (paddle -> paddle_tpu), the removed distributed else-branch, and
+reduced pass counts. Everything else — the fluid.layers program builders,
+optimizer.minimize, DataFeeder, reader pipeline, save/load_inference_model
+round trip — runs through the compatibility surface exactly as written in
+2018-era fluid."""
+
+import math
+import sys
+import tempfile
+
+import numpy
+
+import paddle_tpu as paddle
+
+fluid = paddle.fluid
+
+
+# ---------------------------------------------------------------------
+# test_fit_a_line.py transcription
+# ---------------------------------------------------------------------
+
+
+def fit_a_line_train(save_dirname):
+    from paddle_tpu.framework import Program, program_guard, unique_name
+
+    with program_guard(Program(), Program()), unique_name.guard():
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+
+        sgd_optimizer = fluid.optimizer.SGD(learning_rate=0.001)
+        sgd_optimizer.minimize(avg_cost)
+
+        BATCH_SIZE = 20
+
+        train_reader = paddle.batch(
+            paddle.reader.shuffle(
+                paddle.dataset.uci_housing.train(), buf_size=500),
+            batch_size=BATCH_SIZE)
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+
+        feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+        exe.run(fluid.default_startup_program())
+
+        PASS_NUM = 100
+        for pass_id in range(PASS_NUM):
+            for data in train_reader():
+                avg_loss_value, = exe.run(fluid.default_main_program(),
+                                          feed=feeder.feed(data),
+                                          fetch_list=[avg_cost])
+                if avg_loss_value[()] < 10.0:
+                    if save_dirname is not None:
+                        fluid.io.save_inference_model(save_dirname, ['x'],
+                                                      [y_predict], exe)
+                    return
+                if math.isnan(float(avg_loss_value)):
+                    sys.exit("got NaN loss, training failed.")
+        raise AssertionError(
+            "Fit a line cost is too large, {0:2.2}".format(
+                avg_loss_value[()]))
+
+
+def fit_a_line_infer(save_dirname):
+    from paddle_tpu.framework import Program, Scope, program_guard
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    [inference_program, feed_target_names,
+     fetch_targets] = fluid.io.load_inference_model(save_dirname, exe)
+
+    batch_size = 10
+    test_reader = paddle.batch(
+        paddle.dataset.uci_housing.test(), batch_size=batch_size)
+
+    test_data = next(test_reader())
+    test_feat = numpy.array(
+        [data[0] for data in test_data]).astype("float32")
+
+    results = exe.run(inference_program,
+                      feed={feed_target_names[0]: numpy.array(test_feat)},
+                      fetch_list=fetch_targets)
+    assert results[0].shape == (batch_size, 1)
+    assert numpy.isfinite(results[0]).all()
+
+
+def test_book_fit_a_line(tmp_path):
+    d = str(tmp_path / "fit_a_line.inference.model")
+    fit_a_line_train(d)
+    fit_a_line_infer(d)
+
+
+# ---------------------------------------------------------------------
+# test_recognize_digits.py transcription (conv variant)
+# ---------------------------------------------------------------------
+
+BATCH_SIZE = 64
+
+
+def loss_net(hidden, label):
+    prediction = fluid.layers.fc(input=hidden, size=10, act='softmax')
+    loss = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_loss, acc
+
+
+def mlp(img, label):
+    hidden = fluid.layers.fc(input=img, size=200, act='tanh')
+    hidden = fluid.layers.fc(input=hidden, size=200, act='tanh')
+    return loss_net(hidden, label)
+
+
+def conv_net(img, label):
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img,
+        filter_size=5,
+        num_filters=20,
+        pool_size=2,
+        pool_stride=2,
+        act="relu")
+    conv_pool_1 = fluid.layers.batch_norm(conv_pool_1)
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1,
+        filter_size=5,
+        num_filters=50,
+        pool_size=2,
+        pool_stride=2,
+        act="relu")
+    return loss_net(conv_pool_2, label)
+
+
+def recognize_digits_train(nn_type, save_dirname):
+    from paddle_tpu.framework import Program, program_guard, unique_name
+
+    with program_guard(Program(), Program()), unique_name.guard():
+        img = fluid.layers.data(
+            name='img', shape=[1, 28, 28], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+
+        if nn_type == 'mlp':
+            net_conf = mlp
+        else:
+            net_conf = conv_net
+
+        prediction, avg_loss, acc = net_conf(img, label)
+
+        test_program = fluid.default_main_program().clone(for_test=True)
+
+        optimizer = fluid.optimizer.Adam(learning_rate=0.001)
+        optimizer.minimize(avg_loss)
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+
+        train_reader = paddle.batch(
+            paddle.reader.shuffle(
+                paddle.dataset.mnist.train(), buf_size=500),
+            batch_size=BATCH_SIZE, drop_last=True)
+        test_reader = paddle.batch(
+            paddle.dataset.mnist.test(), batch_size=BATCH_SIZE,
+            drop_last=True)
+        feeder = fluid.DataFeeder(feed_list=[img, label], place=place)
+
+        exe.run(fluid.default_startup_program())
+
+        PASS_NUM = 3
+        for pass_id in range(PASS_NUM):
+            for batch_id, data in enumerate(train_reader()):
+                exe.run(fluid.default_main_program(),
+                        feed=feeder.feed(data))
+            acc_set = []
+            avg_loss_set = []
+            for test_data in test_reader():
+                acc_np, avg_loss_np = exe.run(
+                    program=test_program,
+                    feed=feeder.feed(test_data),
+                    fetch_list=[acc, avg_loss])
+                acc_set.append(float(acc_np))
+                avg_loss_set.append(float(avg_loss_np))
+            acc_val = numpy.array(acc_set).mean()
+            if float(acc_val) > 0.85:
+                if save_dirname is not None:
+                    fluid.io.save_inference_model(
+                        save_dirname, ["img"], [prediction], exe)
+                return
+        raise AssertionError(
+            "Recognize digits accuracy too low: {0:2.2}".format(
+                float(acc_val)))
+
+
+def recognize_digits_infer(save_dirname):
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    [inference_program, feed_target_names,
+     fetch_targets] = fluid.io.load_inference_model(save_dirname, exe)
+    batch = numpy.random.RandomState(0).uniform(
+        -1.0, 1.0, (BATCH_SIZE, 1, 28, 28)).astype("float32")
+    results = exe.run(inference_program,
+                      feed={feed_target_names[0]: batch},
+                      fetch_list=fetch_targets)
+    assert results[0].shape == (BATCH_SIZE, 10)
+    numpy.testing.assert_allclose(results[0].sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_book_recognize_digits_conv(tmp_path):
+    d = str(tmp_path / "recognize_digits_conv.inference.model")
+    recognize_digits_train('conv', d)
+    recognize_digits_infer(d)

@@ -124,3 +124,94 @@ def test_model_fit_evaluate_predict_save_load(tmp_path):
     model2.load(path)
     again = model2.evaluate(ds, batch_size=64, verbose=0)
     np.testing.assert_allclose(again["acc"], final["acc"], rtol=1e-3)
+
+
+def test_mobilenet_v1_v2_forward_and_train():
+    """MobileNetV1/V2 (vision/models/mobilenetv{1,2}.py parity): forward
+    shapes + one to_static train step moves the loss."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.vision import mobilenet_v1, mobilenet_v2
+
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    lab = np.array([1, 3], np.int64)
+
+    for ctor in (mobilenet_v1, mobilenet_v2):
+        m = ctor(scale=0.25, num_classes=10)
+        out = m(pt.dygraph.to_tensor(x))
+        assert tuple(out.shape) == (2, 10)
+
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+        ce = pt.nn.CrossEntropyLoss()
+
+        @pt.jit.to_static(layers=[m], optimizers=[opt])
+        def step(xb, yb):
+            loss = ce(m(pt.dygraph.to_tensor(xb)),
+                      pt.dygraph.to_tensor(yb))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        l0 = float(step(x, lab).numpy())
+        for _ in range(4):
+            l1 = float(step(x, lab).numpy())
+        assert l1 < l0, (ctor.__name__, l0, l1)
+
+
+def test_layers_extra_wrappers_static():
+    """Spot-check the nn_extra wrapper tranche through a static program:
+    lrn, pixel_shuffle, multiplex, index_sample, selu, log_loss,
+    image_resize, maxout all build and run."""
+    import numpy as np
+
+    from paddle_tpu import layers
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        img = layers.data("img", [4, 8, 8])
+        a = layers.data("a", [3])
+        b = layers.data("b", [3])
+        ids = layers.data("ids", [1], dtype="int32")
+        idx = layers.data("idx", [2], dtype="int64")
+        prob = layers.data("prob", [1])
+        lab = layers.data("lab", [1])
+
+        o1 = layers.lrn(img)
+        o2 = layers.pixel_shuffle(img, 2)
+        o3 = layers.multiplex([a, b], ids)
+        o4 = layers.index_sample(a, idx)
+        o5 = layers.selu(a)
+        o6 = layers.log_loss(prob, lab)
+        o7 = layers.image_resize(img, out_shape=[16, 16])
+        o8 = layers.maxout(img, groups=2)
+        o9 = layers.space_to_depth(img, 2)
+        o10 = layers.mish(a)
+    n = 2
+    feed = {
+        "img": np.random.rand(n, 4, 8, 8).astype(np.float32),
+        "a": np.random.rand(n, 3).astype(np.float32),
+        "b": np.random.rand(n, 3).astype(np.float32),
+        "ids": np.array([[0], [1]], np.int32),
+        "idx": np.array([[0, 2], [1, 1]], np.int64),
+        "prob": np.random.uniform(0.1, 0.9, (n, 1)).astype(np.float32),
+        "lab": np.array([[1.0], [0.0]], np.float32),
+    }
+    exe = Executor()
+    outs = exe.run(prog, feed=feed,
+                   fetch_list=[o.name for o in
+                               (o1, o2, o3, o4, o5, o6, o7, o8, o9, o10)],
+                   scope=Scope())
+    assert outs[0].shape == (n, 4, 8, 8)
+    assert outs[1].shape == (n, 1, 16, 16)
+    assert outs[2].shape == (n, 3)
+    assert outs[3].shape == (n, 2)
+    assert outs[6].shape == (n, 4, 16, 16)
+    assert outs[7].shape == (n, 2, 8, 8)
+    assert outs[8].shape == (n, 16, 4, 4)
+    for o in outs:
+        assert np.isfinite(np.asarray(o)).all()

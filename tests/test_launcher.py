@@ -94,3 +94,48 @@ def test_launch_collective_env_plane(tmp_path):
     proc = _run_launch(tmp_path, COLLECTIVE_SCRIPT, [])
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert (tmp_path / "collective_ok").exists()
+
+
+def test_http_kv_rendezvous():
+    """KVServer/KVClient (fleet/utils/http_server.py parity): scoped
+    put/get/keys/delete plus a multi-threaded all-gather rendezvous of
+    role endpoints (the gloo HTTP-rendezvous analog)."""
+    import threading
+
+    from paddle_tpu.distributed.fleet.utils.http_server import (KVClient,
+                                                                KVServer)
+
+    srv = KVServer(0, size={"job": 3})
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        c = KVClient(ep)
+        assert c.kv_put("s", "a", "hello")
+        assert c.kv_get("s", "a") == b"hello"
+        assert c.kv_get("s", "missing") is None
+        c.kv_put("s", "b", "world")
+        assert sorted(c.kv_keys("s")) == ["a", "b"]
+
+        results = {}
+
+        def role(rank):
+            cl = KVClient(ep)
+            results[rank] = cl.rendezvous(
+                "job", rank, f"10.0.0.{rank}:600{rank}", world=3)
+
+        ts = [threading.Thread(target=role, args=(r,)) for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        for r in range(3):
+            assert results[r] == {0: "10.0.0.0:6000", 1: "10.0.0.1:6001",
+                                  2: "10.0.0.2:6002"}
+
+        # teardown tracking: deletes drive should_stop
+        assert not srv.should_stop()
+        for r in range(3):
+            c.kv_delete("job", str(r))
+        assert srv.should_stop()
+    finally:
+        srv.stop()

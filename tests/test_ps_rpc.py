@@ -201,3 +201,56 @@ def test_heartbeat_monitor(two_servers):
     assert status3["0"]["age_sec"] < status2["0"]["age_sec"]
     assert client.worker_status(server=0, timeout=5.0)["0"]["alive"]
     client.shutdown_servers()
+
+
+def test_pull_prefetcher_overlaps_compute():
+    """VERDICT item: overlap the PS hybrid step. A PullPrefetcher keeps
+    the next batch's sparse pull in flight while 'compute' runs; with
+    pull latency ~ compute latency the overlapped loop must beat the
+    serial loop, and values must match what a serial pull returns
+    (downpour_worker.cc:726 overlap analog)."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import sparse_table as st
+    from paddle_tpu.distributed.ps.prefetch import PullPrefetcher
+
+    PULL_MS = 0.02
+    COMPUTE_MS = 0.02
+
+    class SlowTable(st.SparseTable):
+        def _pull_now(self, ids):
+            time.sleep(PULL_MS)          # simulated PS round-trip
+            return super()._pull_now(ids)
+
+    st.REGISTRY.clear()
+    table = SlowTable("slow_emb", value_dim=4)
+    st.REGISTRY._tables["slow_emb"] = table
+
+    rng = np.random.RandomState(0)
+    batches = [{"ids": rng.randint(0, 100, (16,))} for _ in range(10)]
+
+    # warm the table so init-on-miss doesn't skew either timing
+    for b in batches:
+        table._pull_now(b["ids"])
+
+    def step(batch):
+        rows = table.pull(batch["ids"])
+        time.sleep(COMPUTE_MS)           # simulated device step
+        return rows
+
+    t0 = time.perf_counter()
+    serial = [step(b) for b in batches]
+    t_serial = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    overlapped = [step(b) for b in PullPrefetcher(
+        batches, {"slow_emb": lambda b: b["ids"]})]
+    t_overlap = time.perf_counter() - t0
+
+    for a, b in zip(serial, overlapped):
+        np.testing.assert_allclose(a, b)
+    # 10 batches: serial ~ 10*(pull+compute); overlapped ~ pull + 10*max
+    assert t_overlap < t_serial * 0.8, (t_serial, t_overlap)
+    st.REGISTRY.clear()
