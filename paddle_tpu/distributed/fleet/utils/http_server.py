@@ -51,16 +51,20 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         scope, key = self._split()
+        # snapshot under the lock, write AFTER releasing it — a stalled
+        # client socket must not block every other KV operation
         with self.server.kv_lock:
             if key is None:
-                body = "\n".join(
-                    sorted(self.server.kv.get(scope, {}))).encode()
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            value = self.server.kv.get(scope, {}).get(key)
+                keys = sorted(self.server.kv.get(scope, {}))
+            else:
+                value = self.server.kv.get(scope, {}).get(key)
+        if key is None:
+            body = "\n".join(keys).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if value is None:
             self.send_error(404)
             return

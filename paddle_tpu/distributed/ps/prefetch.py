@@ -51,10 +51,24 @@ class PullPrefetcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._thread: Optional[threading.Thread] = None
         self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when the consumer has left the scope
+        (prevents a leaked worker blocked forever on a full queue)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self):
         try:
             for batch in self._batches:
+                if self._stop.is_set():
+                    return
                 for tname, extract in self._table_ids.items():
                     table = REGISTRY.get(tname)
                     if table is None:
@@ -62,12 +76,19 @@ class PullPrefetcher:
                     ids = np.asarray(extract(batch))
                     rows = table._pull_now(ids)
                     with table._stage_lock:
+                        # never stage after the consumer's finally-block
+                        # deactivated the scope — a later scope must not
+                        # see this (pre-push) row set
+                        if self._stop.is_set() \
+                                or table._stage_active <= 0:
+                            return
                         table._staged[_stage_key(ids)] = rows
-                self._q.put(batch)      # blocks at `depth` in flight
+                if not self._put(batch):
+                    return
         except BaseException as e:      # surface in the consumer
             self._err = e
         finally:
-            self._q.put(_DONE)
+            self._put(_DONE)
 
     def _tables(self):
         return [t for t in (REGISTRY.get(n) for n in self._table_ids)
@@ -90,8 +111,11 @@ class PullPrefetcher:
                 yield item
         finally:
             # leaving the prefetch scope (done, break, or exception):
-            # deactivate and drop leftovers so no later unrelated pull
-            # can consume pre-push staged rows
+            # stop the worker first, then deactivate and drop leftovers
+            # so no later unrelated pull can consume pre-push staged rows
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=5)
             for t in tables:
                 with t._stage_lock:
                     t._stage_active = max(t._stage_active - 1, 0)

@@ -387,27 +387,35 @@ def ctc_greedy_decoder(input, blank, input_length=None, name=None):
     return out, lens
 
 
+def _apply_act(out, act):
+    if act is None:
+        return out
+    return _one_out(act, {"X": out})
+
+
 def row_conv(input, future_context_size, param_attr=None, act=None):
     """Creates the lookahead filter parameter internally."""
     helper = LayerHelper("row_conv")
     d = input.shape[-1]
     filt = helper.create_parameter(
-        [future_context_size + 1, d], dtype=input.dtype, attr=param_attr)
-    return _one_out("row_conv", {"X": input, "Filter": filt})
+        param_attr, [future_context_size + 1, d], dtype=input.dtype)
+    return _apply_act(_one_out("row_conv", {"X": input, "Filter": filt}),
+                      act)
 
 
 def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
                             act=None, name=None):
     helper = LayerHelper("bilinear_tensor_product", name=name)
     dx, dy = x.shape[-1], y.shape[-1]
-    w = helper.create_parameter([size, dx, dy], dtype=x.dtype,
-                                attr=param_attr)
+    w = helper.create_parameter(param_attr, [size, dx, dy],
+                                dtype=x.dtype)
     inputs = {"X": x, "Y": y, "Weight": w}
     if bias_attr is not False:
-        b = helper.create_parameter([1, size], dtype=x.dtype,
-                                    attr=bias_attr, is_bias=True)
+        b = helper.create_parameter(bias_attr, [1, size], dtype=x.dtype,
+                                    is_bias=True)
         inputs["Bias"] = b
-    return _one_out("bilinear_tensor_product", inputs, name=name)
+    return _apply_act(
+        _one_out("bilinear_tensor_product", inputs, name=name), act)
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
@@ -416,8 +424,8 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     helper = LayerHelper("spectral_norm", name=name)
     h = weight.shape[dim]
     w = int(_np.prod(weight.shape)) // h
-    u = helper.create_parameter([h], dtype=weight.dtype)
-    v = helper.create_parameter([w], dtype=weight.dtype)
+    u = helper.create_parameter(None, [h], dtype=weight.dtype)
+    v = helper.create_parameter(None, [w], dtype=weight.dtype)
     return _one_out("spectral_norm", {"Weight": weight, "U": u, "V": v},
                     {"dim": dim, "power_iters": power_iters, "eps": eps},
                     name=name)

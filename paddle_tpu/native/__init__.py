@@ -25,17 +25,21 @@ _lock = threading.Lock()
 _libs = {}
 
 
-def build_and_load(name: str) -> Optional[ctypes.CDLL]:
+def build_and_load(name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
     """Compile native/<name>.cpp -> _<name>-<srchash>.so (if absent) and
-    dlopen it. Returns None when no g++ toolchain is available."""
+    dlopen it. Returns None when no g++ toolchain is available.
+    ``extra_flags`` extends the compile line (e.g. python embedding flags
+    for the inference C API) and participates in the cache key."""
+    memo_key = (name, tuple(extra_flags))
     with _lock:
-        if name in _libs:
-            return _libs[name]
+        if memo_key in _libs:
+            return _libs[memo_key]
         here = os.path.dirname(os.path.abspath(__file__))
         src = os.path.join(here, f"{name}.cpp")
         try:
             with open(src, "rb") as f:
-                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+                payload = f.read() + "\0".join(extra_flags).encode()
+            digest = hashlib.sha256(payload).hexdigest()[:16]
             so = os.path.join(here, f"_{name}-{digest}.so")
             if not os.path.exists(so):
                 # compile to a temp path and rename: a killed g++ must
@@ -44,7 +48,7 @@ def build_and_load(name: str) -> Optional[ctypes.CDLL]:
                 tmp = so + f".tmp{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", src, "-o", tmp],
+                     "-pthread", src, "-o", tmp, *extra_flags],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, so)
                 # drop stale builds of the same component
@@ -57,5 +61,5 @@ def build_and_load(name: str) -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(so)
         except (OSError, subprocess.SubprocessError):
             lib = None
-        _libs[name] = lib
+        _libs[memo_key] = lib
         return lib

@@ -1,0 +1,87 @@
+/* C inference demo — analog of the reference's inference/capi demo and
+ * the spirit of paddle/fluid/train/demo: a plain-C program that loads a
+ * saved inference model through the C API (inference_capi.cpp) and runs
+ * a batch, no Python written by the caller.
+ *
+ * Usage: capi_demo <libpath> <model_dir> <n_features> <batch>
+ * Prints "OK <n_outputs> <numel0> <sum0>" on success.
+ */
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void PD_Predictor;
+typedef PD_Predictor *(*new_fn)(const char *);
+typedef void (*del_fn)(PD_Predictor *);
+typedef int (*run_fn)(PD_Predictor *, const float *const *,
+                      const int64_t *const *, const int *, int, float ***,
+                      int64_t ***, int **, int *);
+typedef void (*free_fn)(float **, int64_t **, int *, int);
+typedef const char *(*err_fn)(void);
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s <libpath> <model_dir> <nfeat> <batch>\n",
+            argv[0]);
+    return 2;
+  }
+  void *lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  new_fn pd_new = (new_fn)dlsym(lib, "PD_NewPredictor");
+  del_fn pd_del = (del_fn)dlsym(lib, "PD_DeletePredictor");
+  run_fn pd_run = (run_fn)dlsym(lib, "PD_PredictorRunFloat");
+  free_fn pd_free = (free_fn)dlsym(lib, "PD_FreeOutputs");
+  err_fn pd_err = (err_fn)dlsym(lib, "PD_GetLastError");
+  if (!pd_new || !pd_del || !pd_run || !pd_free) {
+    fprintf(stderr, "missing symbols\n");
+    return 2;
+  }
+
+  PD_Predictor *p = pd_new(argv[2]);
+  if (!p) {
+    fprintf(stderr, "PD_NewPredictor failed: %s\n",
+            pd_err ? pd_err() : "?");
+    return 1;
+  }
+
+  int nfeat = atoi(argv[3]);
+  int batch = atoi(argv[4]);
+  float *input = (float *)malloc(sizeof(float) * batch * nfeat);
+  for (int i = 0; i < batch * nfeat; i++) input[i] = 0.5f;
+  int64_t shape[2];
+  shape[0] = batch;
+  shape[1] = nfeat;
+  const float *inputs[1];
+  const int64_t *shapes[1];
+  int ndims[1];
+  inputs[0] = input;
+  shapes[0] = shape;
+  ndims[0] = 2;
+
+  float **outputs = NULL;
+  int64_t **out_shapes = NULL;
+  int *out_ndims = NULL;
+  int n_out = 0;
+  int rc = pd_run(p, inputs, shapes, ndims, 1, &outputs, &out_shapes,
+                  &out_ndims, &n_out);
+  if (rc != 0) {
+    fprintf(stderr, "PD_PredictorRunFloat failed: %s\n",
+            pd_err ? pd_err() : "?");
+    pd_del(p);
+    return 1;
+  }
+  int64_t numel = 1;
+  for (int d = 0; d < out_ndims[0]; d++) numel *= out_shapes[0][d];
+  double sum = 0;
+  for (int64_t i = 0; i < numel; i++) sum += outputs[0][i];
+  printf("OK %d %lld %.6f\n", n_out, (long long)numel, sum);
+  pd_free(outputs, out_shapes, out_ndims, n_out);
+  pd_del(p);
+  free(input);
+  return 0;
+}

@@ -1,0 +1,218 @@
+// C inference API — analog of the reference's inference/capi/
+// (pd_predictor.cc, paddle_c_api.h): lets C/C++ applications load a
+// saved inference model and run it without writing any Python.
+//
+// Design: the reference's C API wraps its C++ AnalysisPredictor; here
+// the predictor IS the XLA trace-once executor, whose front door is the
+// python Predictor (inference.py). So this shim embeds the interpreter
+// (libpython) once per process and marshals float tensors in/out through
+// the buffer protocol — the C caller sees only a plain C ABI:
+//
+//   PD_Predictor* p = PD_NewPredictor(model_dir);
+//   PD_PredictorRunFloat(p, ins, in_shapes, in_ndims, n_in,
+//                        &outs, &out_shapes, &out_ndims, &n_out);
+//   PD_FreeOutputs(outs, out_shapes, out_ndims, n_out);
+//   PD_DeletePredictor(p);
+//
+// Threading: every entry point takes the GIL via PyGILState_Ensure, so
+// any C thread may call in. Compile with: -lpython3.X (the python test
+// builds it through native/__init__.py with extra link flags).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+struct PD_Predictor {
+  PyObject* predictor;  // paddle_tpu.inference.Predictor
+};
+
+static bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  return Py_IsInitialized();
+}
+
+static void set_last_error(const char* what);
+static char g_last_error[1024] = {0};
+
+static void set_last_error(const char* what) {
+  std::strncpy(g_last_error, what, sizeof(g_last_error) - 1);
+}
+
+static void capture_py_error(const char* fallback) {
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    PyObject* s = value ? PyObject_Str(value) : nullptr;
+    const char* msg = s ? PyUnicode_AsUTF8(s) : fallback;
+    set_last_error(msg ? msg : fallback);
+    Py_XDECREF(s);
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+    PyErr_Clear();
+  } else {
+    set_last_error(fallback);
+  }
+}
+
+const char* PD_GetLastError() { return g_last_error; }
+
+PD_Predictor* PD_NewPredictor(const char* model_dir) {
+  if (!ensure_python()) {
+    set_last_error("could not initialize python runtime");
+    return nullptr;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  PD_Predictor* out = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod) {
+    PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+    PyObject* mk = PyObject_GetAttrString(mod, "create_predictor");
+    PyObject* cfg =
+        cfg_cls ? PyObject_CallFunction(cfg_cls, "s", model_dir) : nullptr;
+    PyObject* pred =
+        (mk && cfg) ? PyObject_CallFunctionObjArgs(mk, cfg, nullptr)
+                    : nullptr;
+    if (pred) {
+      out = new PD_Predictor{pred};
+    } else {
+      capture_py_error("predictor construction failed");
+    }
+    Py_XDECREF(cfg);
+    Py_XDECREF(cfg_cls);
+    Py_XDECREF(mk);
+    Py_DECREF(mod);
+  } else {
+    capture_py_error(
+        "import paddle_tpu failed (is PYTHONPATH set to the repo root?)");
+  }
+  PyGILState_Release(g);
+  return out;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (!p) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_XDECREF(p->predictor);
+  PyGILState_Release(g);
+  delete p;
+}
+
+// Run with float32 inputs/outputs. Outputs are malloc'd by the library;
+// release with PD_FreeOutputs. Returns 0 on success.
+int PD_PredictorRunFloat(PD_Predictor* p, const float* const* inputs,
+                         const int64_t* const* in_shapes,
+                         const int* in_ndims, int n_inputs,
+                         float*** outputs, int64_t*** out_shapes,
+                         int** out_ndims, int* n_outputs) {
+  if (!p) {
+    set_last_error("null predictor");
+    return 1;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = 1;
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* in_list = PyList_New(n_inputs);
+  bool ok = np && in_list;
+  for (int i = 0; ok && i < n_inputs; i++) {
+    int64_t numel = 1;
+    for (int d = 0; d < in_ndims[i]; d++) numel *= in_shapes[i][d];
+    PyObject* mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(inputs[i])),
+        numel * sizeof(float), PyBUF_READ);
+    PyObject* arr =
+        mv ? PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32")
+           : nullptr;
+    PyObject* shape = PyTuple_New(in_ndims[i]);
+    for (int d = 0; shape && d < in_ndims[i]; d++) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(in_shapes[i][d]));
+    }
+    PyObject* shaped =
+        (arr && shape) ? PyObject_CallMethod(arr, "reshape", "O", shape)
+                       : nullptr;
+    if (shaped) {
+      PyList_SET_ITEM(in_list, i, shaped);  // steals
+    } else {
+      ok = false;
+    }
+    Py_XDECREF(arr);
+    Py_XDECREF(shape);
+    Py_XDECREF(mv);
+  }
+  PyObject* res =
+      ok ? PyObject_CallMethod(p->predictor, "run", "O", in_list) : nullptr;
+  if (res) {
+    Py_ssize_t n = PySequence_Size(res);
+    *n_outputs = static_cast<int>(n);
+    *outputs = static_cast<float**>(std::malloc(n * sizeof(float*)));
+    *out_shapes =
+        static_cast<int64_t**>(std::malloc(n * sizeof(int64_t*)));
+    *out_ndims = static_cast<int*>(std::malloc(n * sizeof(int)));
+    rc = 0;
+    for (Py_ssize_t i = 0; i < n && rc == 0; i++) {
+      PyObject* item = PySequence_GetItem(res, i);
+      PyObject* arr = PyObject_CallMethod(
+          np, "ascontiguousarray", "Os", item, "float32");
+      Py_buffer view;
+      if (arr && PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) == 0) {
+        (*out_ndims)[i] = view.ndim;
+        (*out_shapes)[i] = static_cast<int64_t*>(
+            std::malloc(view.ndim * sizeof(int64_t)));
+        int64_t numel = 1;
+        for (int d = 0; d < view.ndim; d++) {
+          (*out_shapes)[i][d] = view.shape[d];
+          numel *= view.shape[d];
+        }
+        (*outputs)[i] =
+            static_cast<float*>(std::malloc(numel * sizeof(float)));
+        std::memcpy((*outputs)[i], view.buf, numel * sizeof(float));
+        PyBuffer_Release(&view);
+      } else {
+        capture_py_error("output marshalling failed");
+        rc = 1;
+      }
+      Py_XDECREF(arr);
+      Py_XDECREF(item);
+    }
+    Py_DECREF(res);
+  } else {
+    capture_py_error("predictor run failed");
+  }
+  Py_XDECREF(in_list);
+  Py_XDECREF(np);
+  PyGILState_Release(g);
+  return rc;
+}
+
+void PD_FreeOutputs(float** outputs, int64_t** out_shapes, int* out_ndims,
+                    int n_outputs) {
+  for (int i = 0; i < n_outputs; i++) {
+    std::free(outputs[i]);
+    std::free(out_shapes[i]);
+  }
+  std::free(outputs);
+  std::free(out_shapes);
+  std::free(out_ndims);
+  (void)out_ndims;
+}
+
+int PD_GetInputNum(PD_Predictor* p) {
+  if (!p) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* names = PyObject_CallMethod(p->predictor, "get_input_names",
+                                        nullptr);
+  int n = names ? static_cast<int>(PySequence_Size(names)) : -1;
+  Py_XDECREF(names);
+  PyGILState_Release(g);
+  return n;
+}
+
+}  // extern "C"

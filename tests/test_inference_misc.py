@@ -162,3 +162,68 @@ def test_jit_load_applies_passes(tmp_path):
     types = [op.type for op in loaded.program.global_block().ops]
     assert "dropout" not in types, types
     np.testing.assert_allclose(loaded(xv).numpy(), eager, rtol=1e-5)
+
+
+def test_c_api_inference(tmp_path):
+    """The C inference API (native/inference_capi.cpp): a plain-C demo
+    binary dlopens the shim, loads a saved model, runs a batch, and its
+    output sum matches the python Predictor (inference/capi parity)."""
+    import subprocess
+    import sysconfig
+
+    # save a tiny model from python
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 5
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [6])
+        pred = layers.fc(x, 3, act="tanh")
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "capi_model")
+    pt.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+
+    xv = np.full((2, 6), 0.5, np.float32)
+
+    # build the C API shim with python-embedding link flags
+    from paddle_tpu import native
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    inc = sysconfig.get_config_var("INCLUDEPY")
+    lib = native.build_and_load(
+        "inference_capi",
+        extra_flags=(f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+                     f"-Wl,-rpath,{libdir}"))
+    if lib is None:
+        pytest.skip("no toolchain for C API")
+    so_path = lib._name
+
+    # build + run the pure-C demo in a clean subprocess
+    here = os.path.dirname(native.__file__)
+    demo_src = os.path.join(here, "capi_demo.c")
+    demo_bin = str(tmp_path / "capi_demo")
+    subprocess.run(["gcc", demo_src, "-o", demo_bin, "-ldl"], check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([demo_bin, so_path, d, "6", "2"],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    parts = r.stdout.split()
+    assert parts[0] == "OK" and parts[1] == "1" and parts[2] == "6"
+
+    # reference: the PYTHON predictor in an identical clean subprocess
+    # (the parent's conftest flips x64/precision config, which shifts
+    # float results at the 1e-3 level — compare apples to apples)
+    ref = subprocess.run(
+        [sys.executable, "-c",
+         "import numpy as np\n"
+         "from paddle_tpu.inference import Config, create_predictor\n"
+         f"p = create_predictor(Config({d!r}))\n"
+         "out, = p.run([np.full((2, 6), 0.5, np.float32)])\n"
+         "print(float(np.asarray(out).sum()))"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert ref.returncode == 0, ref.stderr[-800:]
+    np.testing.assert_allclose(float(parts[3]),
+                               float(ref.stdout.strip()), rtol=1e-5)
