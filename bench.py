@@ -71,6 +71,9 @@ def build_steps(model_name: str):
     from paddle_tpu.optimizer import AdamW
 
     cfg = GPT_CONFIGS[model_name]
+    if os.environ.get("BENCH_RECOMPUTE") == "1":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, recompute=True)
     model = GPTForCausalLM(cfg)
     # bf16 m/v is the recommended TPU config (halves optimizer-state HBM;
     # measured +1.1pt MFU on the 345M flagship) — opt out with =0

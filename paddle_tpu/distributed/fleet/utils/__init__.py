@@ -2,3 +2,6 @@
 
 from . import fs
 from .fs import HDFSClient, LocalFS
+from . import http_server
+from .http_server import KVClient, KVServer
+from .recompute import recompute

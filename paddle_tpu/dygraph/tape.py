@@ -112,6 +112,14 @@ class GradNode:
                         if getattr(t, "_grad_node", None) is not None]
 
 
+# Parameter-discovery hook for fleet.utils.recompute's abstract probe:
+# while set on THIS thread, every traced op reports its input tensors
+# (thread-local so concurrent/nested probes can't clear each other).
+import threading as _threading
+
+_probe_tls = _threading.local()
+
+
 class Tracer:
     def __init__(self):
         self.enabled = True         # False under no_grad
@@ -121,6 +129,9 @@ class Tracer:
     # -- op execution ------------------------------------------------------
     def trace_op(self, op_type: str, ins: Dict[str, List[Tensor]],
                  attrs: Dict) -> Dict[str, List[Tensor]]:
+        hook = getattr(_probe_tls, "hook", None)
+        if hook is not None:
+            hook(ins)
         d = _reg.OPS.get(op_type)
         if self._amp_level in ("O1", "O2"):
             from ..amp.auto_cast import maybe_autocast_inputs

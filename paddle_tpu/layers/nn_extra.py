@@ -387,20 +387,14 @@ def ctc_greedy_decoder(input, blank, input_length=None, name=None):
     return out, lens
 
 
-def _apply_act(out, act):
-    if act is None:
-        return out
-    return _one_out(act, {"X": out})
-
-
 def row_conv(input, future_context_size, param_attr=None, act=None):
     """Creates the lookahead filter parameter internally."""
     helper = LayerHelper("row_conv")
     d = input.shape[-1]
     filt = helper.create_parameter(
         param_attr, [future_context_size + 1, d], dtype=input.dtype)
-    return _apply_act(_one_out("row_conv", {"X": input, "Filter": filt}),
-                      act)
+    return helper.append_activation(
+        _one_out("row_conv", {"X": input, "Filter": filt}), act)
 
 
 def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
@@ -414,7 +408,7 @@ def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
         b = helper.create_parameter(bias_attr, [1, size], dtype=x.dtype,
                                     is_bias=True)
         inputs["Bias"] = b
-    return _apply_act(
+    return helper.append_activation(
         _one_out("bilinear_tensor_product", inputs, name=name), act)
 
 

@@ -40,6 +40,9 @@ class GPTConfig:
     ffn_hidden_size: int = 4096
     dropout: float = 0.0
     init_std: float = 0.02
+    # rematerialize each block's activations in backward (batch-size
+    # lever; fleet.utils.recompute over every decoder block)
+    recompute: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -156,7 +159,12 @@ class GPTModel(Layer):
         new_caches = []
         for i, blk in enumerate(self.blocks):
             if cache is None:
-                x = blk(x)
+                if self.cfg.recompute:
+                    from ..distributed.fleet.utils.recompute import \
+                        recompute as _rc
+                    x = _rc(blk, x)
+                else:
+                    x = blk(x)
             else:
                 x, c = blk(x, cache[i])
                 new_caches.append(c)

@@ -38,9 +38,14 @@ def build_and_load(name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
         src = os.path.join(here, f"{name}.cpp")
         try:
             with open(src, "rb") as f:
-                payload = f.read() + "\0".join(extra_flags).encode()
-            digest = hashlib.sha256(payload).hexdigest()[:16]
-            so = os.path.join(here, f"_{name}-{digest}.so")
+                src_digest = hashlib.sha256(f.read()).hexdigest()[:12]
+            flag_digest = hashlib.sha256(
+                "\0".join(extra_flags).encode()).hexdigest()[:6]
+            # one cached build per (source, flag-set): the cleanup below
+            # only touches stale builds of the SAME flag variant, so two
+            # legitimate flag variants never evict each other
+            so = os.path.join(here,
+                              f"_{name}-{src_digest}-{flag_digest}.so")
             if not os.path.exists(so):
                 # compile to a temp path and rename: a killed g++ must
                 # not leave a truncated .so at the final name (rename is
@@ -51,8 +56,9 @@ def build_and_load(name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
                      "-pthread", src, "-o", tmp, *extra_flags],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, so)
-                # drop stale builds of the same component
-                for old in glob.glob(os.path.join(here, f"_{name}-*.so")):
+                # drop stale builds of the same component + flag variant
+                for old in glob.glob(os.path.join(
+                        here, f"_{name}-*-{flag_digest}.so")):
                     if old != so:
                         try:
                             os.unlink(old)

@@ -35,6 +35,10 @@ struct PD_Predictor {
 static bool ensure_python() {
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    // release the GIL the initializing thread holds, or every other
+    // thread's PyGILState_Ensure would deadlock ("any C thread may
+    // call in" contract)
+    PyEval_SaveThread();
   }
   return Py_IsInitialized();
 }
@@ -64,6 +68,9 @@ static void capture_py_error(const char* fallback) {
 }
 
 const char* PD_GetLastError() { return g_last_error; }
+
+void PD_FreeOutputs(float** outputs, int64_t** out_shapes, int* out_ndims,
+                    int n_outputs);
 
 PD_Predictor* PD_NewPredictor(const char* model_dir) {
   if (!ensure_python()) {
@@ -151,11 +158,19 @@ int PD_PredictorRunFloat(PD_Predictor* p, const float* const* inputs,
       ok ? PyObject_CallMethod(p->predictor, "run", "O", in_list) : nullptr;
   if (res) {
     Py_ssize_t n = PySequence_Size(res);
+    if (n < 0) {
+      capture_py_error("predictor returned a non-sequence");
+      Py_DECREF(res);
+      Py_XDECREF(in_list);
+      Py_XDECREF(np);
+      PyGILState_Release(g);
+      return 1;
+    }
     *n_outputs = static_cast<int>(n);
-    *outputs = static_cast<float**>(std::malloc(n * sizeof(float*)));
+    *outputs = static_cast<float**>(std::calloc(n, sizeof(float*)));
     *out_shapes =
-        static_cast<int64_t**>(std::malloc(n * sizeof(int64_t*)));
-    *out_ndims = static_cast<int*>(std::malloc(n * sizeof(int)));
+        static_cast<int64_t**>(std::calloc(n, sizeof(int64_t*)));
+    *out_ndims = static_cast<int*>(std::calloc(n, sizeof(int)));
     rc = 0;
     for (Py_ssize_t i = 0; i < n && rc == 0; i++) {
       PyObject* item = PySequence_GetItem(res, i);
@@ -181,6 +196,14 @@ int PD_PredictorRunFloat(PD_Predictor* p, const float* const* inputs,
       }
       Py_XDECREF(arr);
       Py_XDECREF(item);
+    }
+    if (rc != 0) {
+      // the caller must not free on failure — release the partial copy
+      PD_FreeOutputs(*outputs, *out_shapes, *out_ndims, *n_outputs);
+      *outputs = nullptr;
+      *out_shapes = nullptr;
+      *out_ndims = nullptr;
+      *n_outputs = 0;
     }
     Py_DECREF(res);
   } else {

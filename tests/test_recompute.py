@@ -1,0 +1,144 @@
+"""fleet.utils.recompute — dygraph activation rematerialization.
+
+Parity target: python/paddle/distributed/fleet/utils/recompute.py
+(RecomputeFunction). The TPU design runs the segment under jax.checkpoint
+inside one tape op; these tests pin (1) gradient equality with the
+non-recomputed graph, (2) parameter discovery through the abstract probe,
+(3) the GPT recompute config end-to-end, (4) rng-replay stability with
+dropout inside the segment."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.fleet.utils import recompute
+
+RNG = np.random.RandomState(3)
+
+
+def _grads(params):
+    return [np.asarray(p.grad.value) for p in params]
+
+
+def _clear(params):
+    for p in params:
+        p.clear_grad()
+
+
+def test_recompute_grads_match_eager():
+    m1 = pt.nn.Linear(6, 6)
+    m2 = pt.nn.Linear(6, 3)
+    params = m1.parameters() + m2.parameters()
+    x = RNG.randn(4, 6).astype(np.float32)
+
+    out = m2(pt.nn.functional.relu(m1(pt.dygraph.to_tensor(x))))
+    (out ** 2).mean().backward()
+    ref = _grads(params)
+    _clear(params)
+
+    h = recompute(lambda a: pt.nn.functional.relu(m1(a)),
+                  pt.dygraph.to_tensor(x))
+    (m2(h) ** 2).mean().backward()
+    got = _grads(params)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_multi_arg_multi_out():
+    m = pt.nn.Linear(5, 5)
+    a = pt.dygraph.to_tensor(RNG.randn(3, 5).astype(np.float32))
+    b = pt.dygraph.to_tensor(RNG.randn(3, 5).astype(np.float32))
+    a.stop_gradient = False
+    b.stop_gradient = False
+
+    def seg(x, y):
+        h = m(x) + y
+        return h, h * 2.0
+
+    o1, o2 = recompute(seg, a, b)
+    (o1.mean() + o2.mean()).backward()
+    assert m.parameters()[0].grad is not None
+    assert a.grad is not None and b.grad is not None
+    np.testing.assert_allclose(np.asarray(b.grad.value), 3.0 / b.size,
+                               rtol=1e-5)
+
+
+def test_recompute_in_to_static_trains():
+    m1 = pt.nn.Linear(6, 6)
+    m2 = pt.nn.Linear(6, 1)
+    opt = pt.optimizer.SGD(learning_rate=0.2,
+                           parameters=m1.parameters() + m2.parameters())
+    x = RNG.randn(8, 6).astype(np.float32)
+    y = RNG.randn(8, 1).astype(np.float32)
+
+    @pt.jit.to_static(layers=[m1, m2], optimizers=[opt])
+    def step(xb, yb):
+        h = recompute(lambda a: pt.nn.functional.relu(m1(a)),
+                      pt.dygraph.to_tensor(xb))
+        loss = ((m2(h) - pt.dygraph.to_tensor(yb)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    l0 = float(step(x, y).numpy())
+    for _ in range(30):
+        l1 = float(step(x, y).numpy())
+    assert l1 < l0 * 0.3, (l0, l1)
+
+
+def test_gpt_recompute_config_loss_parity():
+    """gpt2-tiny with cfg.recompute=True computes the same loss/grads as
+    the stored-activation path."""
+    import dataclasses
+
+    from paddle_tpu.models import GPT_CONFIGS, GPTForCausalLM
+
+    cfg = GPT_CONFIGS["gpt2-tiny"]
+    ids = RNG.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    m_plain = GPTForCausalLM(cfg)
+    m_rc = GPTForCausalLM(dataclasses.replace(cfg, recompute=True))
+    m_rc.set_state_dict(m_plain.state_dict())
+
+    l_plain = m_plain(pt.dygraph.to_tensor(ids),
+                      labels=pt.dygraph.to_tensor(labels))
+    l_rc = m_rc(pt.dygraph.to_tensor(ids),
+                labels=pt.dygraph.to_tensor(labels))
+    np.testing.assert_allclose(float(l_rc.numpy()), float(l_plain.numpy()),
+                               rtol=1e-5)
+
+    l_plain.backward()
+    l_rc.backward()
+    gp = {p.name.split(".")[-1] + str(i): p.grad
+          for i, p in enumerate(m_plain.parameters())}
+    for i, p in enumerate(m_rc.parameters()):
+        ref = m_plain.parameters()[i].grad
+        assert (p.grad is None) == (ref is None)
+        if p.grad is not None:
+            np.testing.assert_allclose(
+                np.asarray(p.grad.value), np.asarray(ref.value),
+                rtol=2e-4, atol=2e-6)
+
+
+def test_recompute_with_dropout_rng_replay():
+    """Dropout inside the segment: the rng draw must replay identically
+    in the rematerialized backward — grads stay consistent with the
+    actually-sampled mask (checked via grad of a linear-in-x segment:
+    d/dx(mean(dropout(x))) equals mask/keep/size)."""
+    x = pt.dygraph.to_tensor(RNG.randn(64, 64).astype(np.float32))
+    x.stop_gradient = False
+    drop = pt.nn.Dropout(0.5)
+    drop.train()
+
+    out = recompute(lambda a: drop(a), x)
+    out.mean().backward()
+    g = np.asarray(x.grad.value) * x.size
+    # upscale_in_train: grad is 1/keep where kept, 0 where dropped
+    vals = np.unique(np.round(g, 4))
+    assert set(vals).issubset({0.0, 2.0}), vals
+    kept = (g > 0).mean()
+    assert 0.3 < kept < 0.7
+    # and the forward mask agrees with the gradient's mask
+    fwd_mask = (np.asarray(out.value) != 0)
+    np.testing.assert_array_equal(fwd_mask, g > 0)
