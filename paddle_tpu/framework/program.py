@@ -374,8 +374,15 @@ class Program:
 
     # -- serialization -----------------------------------------------------
     def to_dict(self) -> dict:
+        from ..ops.registry import op_version_map
+        used = {op.type for b in self.blocks for op in b.ops}
+        versions = {t: v for t, v in op_version_map().items() if t in used}
         return {
             "ir_version": IR_VERSION,
+            # per-op semantic versions at save time (op_version.h analog):
+            # loaders reject ops whose registered version moved past the
+            # saved one instead of mis-executing them
+            "op_versions": versions,
             "random_seed": self.random_seed,
             "blocks": [b.to_dict() for b in self.blocks],
         }
@@ -385,6 +392,32 @@ class Program:
 
     @staticmethod
     def from_dict(d: dict) -> "Program":
+        saved = d.get("op_versions") or {}
+        if saved:
+            from ..ops.registry import op_version_map
+            cur = op_version_map()
+            # the versions dict records every op type registered at SAVE
+            # time, so a type unknown here means removed/renamed — fail
+            # at load with a clear message, not at first execution
+            gone = sorted(t for t in saved if t not in cur)
+            if gone:
+                raise ValueError(
+                    f"saved program uses ops this build no longer "
+                    f"registers: {gone} — re-export the model")
+            stale = {t: (v, cur[t]) for t, v in saved.items()
+                     if cur[t] > v}
+            if stale:
+                raise ValueError(
+                    "saved program uses older op versions than this "
+                    f"build: {stale} — re-export the model or add a "
+                    "compat shim (op_version_registry analog)")
+            future = {t: (v, cur[t]) for t, v in saved.items()
+                      if cur[t] < v}
+            if future:
+                # an older build can never shim a future version
+                raise ValueError(
+                    "saved program was exported by a NEWER build (op "
+                    f"versions {future}) — upgrade this runtime")
         prog = Program()
         prog.random_seed = d.get("random_seed")
         prog.blocks = []

@@ -125,6 +125,10 @@ class OpDef:
     # in-program input does, so backward still emits the grad op whose
     # custom maker routes the push.
     virtual_param: bool = False
+    # Per-op semantic version (analog of the reference's op_version.h
+    # registry): bump when an op's attrs/slots/semantics change so saved
+    # programs can detect incompatibility at load.
+    version: int = 1
 
 
 OPS: Dict[str, OpDef] = {}
@@ -155,6 +159,11 @@ def is_registered(op_type: str) -> bool:
 
 def registered_ops() -> List[str]:
     return sorted(OPS.keys())
+
+
+def op_version_map() -> Dict[str, int]:
+    """op type -> semantic version (op_version_registry.h analog)."""
+    return {name: d.version for name, d in OPS.items()}
 
 
 # ---------------------------------------------------------------------------

@@ -221,3 +221,47 @@ def test_prune_keeps_needed_subblock_and_free_vars():
     (o,) = exe.run(pruned, feed={"x": np.ones((2, 4), np.float32)},
                    fetch_list=[out.name], scope=scope)
     assert o.shape == (2, 4)
+
+
+def test_op_version_registry_and_load_guard():
+    """Per-op semantic versions (op_version.h analog): versions ride in
+    serialized programs; loading a program saved against an OLDER op
+    version than the running registry raises instead of mis-executing."""
+    from paddle_tpu.framework.program import Program
+    from paddle_tpu.ops import registry as reg
+
+    vm = reg.op_version_map()
+    assert vm["matmul_v2"] >= 1 and len(vm) > 350
+
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("a", shape=(2, 2), dtype="float32", is_data=True)
+    blk.create_var("b")
+    blk.append_op("relu", {"X": "a"}, {"Out": "b"}, {})
+    d = prog.to_dict()
+    assert d["op_versions"] == {"relu": reg.OPS["relu"].version}
+
+    # round-trips today
+    Program.from_dict(d)
+
+    import pytest
+
+    # simulate an op whose semantics moved on since the save
+    d_old = dict(d, op_versions={"relu": reg.OPS["relu"].version})
+    reg.OPS["relu"].version += 1
+    try:
+        with pytest.raises(ValueError, match="older op versions"):
+            Program.from_dict(d_old)
+    finally:
+        reg.OPS["relu"].version -= 1
+
+    # a FUTURE version (saved by a newer build) is rejected too — an
+    # older runtime can never shim semantics it doesn't know
+    d_future = dict(d, op_versions={"relu": reg.OPS["relu"].version + 1})
+    with pytest.raises(ValueError, match="NEWER build"):
+        Program.from_dict(d_future)
+
+    # removed/renamed op types fail at LOAD, not first execution
+    d_gone = dict(d, op_versions={"relu": 1, "laser_beam": 1})
+    with pytest.raises(ValueError, match="no longer registers"):
+        Program.from_dict(d_gone)

@@ -33,6 +33,7 @@ def dump_ops() -> dict:
             "grad_drops_inputs": sorted(d.grad_drops_inputs),
             "grad_needs_outputs": sorted(d.grad_needs_outputs),
             "custom_grad": d.custom_grad_maker is not None,
+            "version": d.version,
         }
     return out
 
@@ -50,8 +51,17 @@ def main(argv=None):
         base = json.load(f)
     removed = sorted(set(base) - set(ops))
     added = sorted(set(ops) - set(base))
+
+    def _norm(d):
+        # keep old baselines usable: fields added to the dump format
+        # since the baseline was generated get their defaults, instead
+        # of flagging every op as CHANGED
+        out = dict(d)
+        out.setdefault("version", 1)
+        return out
+
     changed = sorted(k for k in set(base) & set(ops)
-                     if base[k] != ops[k])
+                     if _norm(base[k]) != _norm(ops[k]))
     for kind, names in (("REMOVED", removed), ("CHANGED", changed)):
         for n in names:
             print(f"{kind}: {n}")

@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# CI harness — the build-tooling tier (SURVEY §2.8; analog of the
+# reference's paddle_build.sh + CI scripts, scoped to what matters for a
+# pure-python+native-extension tree):
+#
+#   1. import smoke (the package must import with no toolchain at all)
+#   2. full test suite on the virtual 8-device CPU mesh
+#   3. op coverage gate (>= 80% of the reference forward-op surface)
+#   4. API-freeze check (public signature snapshot diff)
+#   5. multi-chip dry-run (GSPMD train step on N virtual devices)
+#
+# Usage: tools/ci.sh [quick]   — `quick` skips the full suite (smoke only)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/5 import smoke"
+JAX_PLATFORMS=cpu python -c "
+import paddle_tpu
+from paddle_tpu.ops import registry
+n = len(registry.registered_ops())
+assert n > 350, n
+print(f'   paddle_tpu imports, {n} op lowerings registered')
+"
+
+if [[ "${1:-}" != "quick" ]]; then
+  echo "== 2/5 test suite (virtual 8-device CPU mesh)"
+  python -m pytest tests/ -q -x --timeout=1200 2>/dev/null \
+    || python -m pytest tests/ -q -x
+else
+  echo "== 2/5 test suite: SKIPPED (quick mode)"
+fi
+
+echo "== 3/5 op coverage gate"
+if [[ -d /root/reference ]]; then
+  JAX_PLATFORMS=cpu python tools/op_coverage.py --json
+else
+  echo "   reference tree absent — skipped"
+fi
+
+echo "== 4/5 API freeze"
+SNAP=tools/api_signatures.txt
+JAX_PLATFORMS=cpu python tools/print_signatures.py > /tmp/api_now.txt
+if [[ -f "$SNAP" ]]; then
+  if ! diff -u "$SNAP" /tmp/api_now.txt > /tmp/api_diff.txt; then
+    echo "   PUBLIC API CHANGED vs snapshot:"
+    head -40 /tmp/api_diff.txt
+    echo "   (intentional? refresh with: cp /tmp/api_now.txt $SNAP)"
+    exit 1
+  fi
+  echo "   public API matches snapshot ($(wc -l < "$SNAP") symbols)"
+else
+  cp /tmp/api_now.txt "$SNAP"
+  echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
+fi
+
+echo "== 5/5 multi-chip dry run"
+python -c "
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print('   8-device GSPMD train step ok')
+"
+
+echo "CI PASSED"
