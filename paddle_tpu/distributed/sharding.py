@@ -43,6 +43,17 @@ class ShardingRules:
                 return _fit_spec(spec, shape, mesh)
         return _fit_spec(self.default, shape, mesh)
 
+    def merge(self, other: "ShardingRules",
+              default: PartitionSpec = None) -> "ShardingRules":
+        """Compose rule tables: self's rules take precedence, then
+        other's; default comes from `default` or other. The ZeRO+TP
+        composition (TP rules first, fully-sharded fallback) is the
+        canonical use."""
+        out = ShardingRules([], default=default if default is not None
+                            else other.default)
+        out._rules = list(self._rules) + list(other._rules)
+        return out
+
 
 def _fit_spec(spec: PartitionSpec, shape: Sequence[int],
               mesh: Mesh) -> PartitionSpec:

@@ -396,9 +396,9 @@ class Program:
         if saved:
             from ..ops.registry import op_version_map
             cur = op_version_map()
-            # the versions dict records every op type registered at SAVE
-            # time, so a type unknown here means removed/renamed — fail
-            # at load with a clear message, not at first execution
+            # the versions dict records every op type USED by the program
+            # at save time, so a type unknown here means removed/renamed
+            # — fail at load with a clear message, not at first execution
             gone = sorted(t for t in saved if t not in cur)
             if gone:
                 raise ValueError(

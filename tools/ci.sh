@@ -25,8 +25,11 @@ print(f'   paddle_tpu imports, {n} op lowerings registered')
 
 if [[ "${1:-}" != "quick" ]]; then
   echo "== 2/5 test suite (virtual 8-device CPU mesh)"
-  python -m pytest tests/ -q -x --timeout=1200 2>/dev/null \
-    || python -m pytest tests/ -q -x
+  if python -c 'import pytest_timeout' 2>/dev/null; then
+    python -m pytest tests/ -q -x --timeout=1200
+  else
+    python -m pytest tests/ -q -x
+  fi
 else
   echo "== 2/5 test suite: SKIPPED (quick mode)"
 fi
@@ -40,17 +43,20 @@ fi
 
 echo "== 4/5 API freeze"
 SNAP=tools/api_signatures.txt
-JAX_PLATFORMS=cpu python tools/print_signatures.py > /tmp/api_now.txt
+API_NOW=$(mktemp)
+API_DIFF=$(mktemp)
+trap 'rm -f "$API_NOW" "$API_DIFF"' EXIT
+JAX_PLATFORMS=cpu python tools/print_signatures.py > "$API_NOW"
 if [[ -f "$SNAP" ]]; then
-  if ! diff -u "$SNAP" /tmp/api_now.txt > /tmp/api_diff.txt; then
+  if ! diff -u "$SNAP" "$API_NOW" > "$API_DIFF"; then
     echo "   PUBLIC API CHANGED vs snapshot:"
-    head -40 /tmp/api_diff.txt
-    echo "   (intentional? refresh with: cp /tmp/api_now.txt $SNAP)"
+    head -40 "$API_DIFF"
+    echo "   (intentional? refresh with: python tools/print_signatures.py > $SNAP)"
     exit 1
   fi
   echo "   public API matches snapshot ($(wc -l < "$SNAP") symbols)"
 else
-  cp /tmp/api_now.txt "$SNAP"
+  cp "$API_NOW" "$SNAP"
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
