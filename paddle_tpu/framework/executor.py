@@ -22,6 +22,7 @@ Semantics preserved:
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -159,7 +160,11 @@ class Executor:
         # state through the scope should enable it.
         self.donate_state = donate_state
         self._cache: Dict[Any, Any] = {}
+        # serialize cache-miss builds: concurrent hogwild workers racing
+        # a miss must not duplicate minutes of XLA compilation
+        self._build_lock = threading.Lock()
         self._seed_counters: Dict[int, int] = {}
+        self._seed_lock = threading.Lock()
         # OS-entropy seeded: unseeded programs vary run to run (matching
         # the reference's unseeded generators); set program.random_seed
         # for determinism.
@@ -211,8 +216,12 @@ class Executor:
                id(scope), scope_sig, _flags.version())
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._build(program, feed_arrays, fetch_names, scope)
-            self._cache[key] = entry
+            with self._build_lock:
+                entry = self._cache.get(key)
+                if entry is None:
+                    entry = self._build(program, feed_arrays,
+                                        fetch_names, scope)
+                    self._cache[key] = entry
         compiled, state_in, written, _refs = entry
 
         state = {}
@@ -264,6 +273,14 @@ class Executor:
         scope = scope or global_scope()
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
+        thread_num = int(getattr(trainer_desc, "_thread_num", 1) or 1) \
+            if trainer_desc is not None else 1
+        if thread_num > 1:
+            return self._train_hogwild(program, dataset, scope,
+                                       fetch_names, thread_num,
+                                       debug=debug,
+                                       fetch_info=fetch_info,
+                                       print_period=print_period)
         last = None
         for step, feed in enumerate(dataset.batch_iterator()):
             last = self.run(program, feed=feed, fetch_list=fetch_names,
@@ -274,6 +291,87 @@ class Executor:
                                 for n, v in zip(infos, last))
                 print(f"[train_from_dataset] step {step}: {msg}")
         return last
+
+    def _train_hogwild(self, program, dataset, scope, fetch_names,
+                       thread_num: int, debug: bool = False,
+                       fetch_info=None, print_period: int = 100):
+        """Hogwild-style concurrent device workers (TrainerDesc
+        thread_num > 1; analog of hogwild_worker.cc under
+        MultiTrainer::Run): N threads drain one shared batch queue and
+        run the SAME compiled step against the SAME scope, lock-free.
+        Racing parameter writes are last-writer-wins — the hogwild
+        contract — while host sparse tables stay consistent through
+        their per-shard locks. The first batch runs single-threaded so
+        the common-shape compile happens once (use
+        ``set_pad_to_max_length`` for shape-stable batches; varying
+        shapes compile per shape, serialized by the executor's build
+        lock)."""
+        import queue as _queue
+
+        it = dataset.batch_iterator()
+        try:
+            first = next(it)
+        except StopIteration:
+            return None
+        last_holder = {0: self.run(program, feed=first,
+                                   fetch_list=fetch_names, scope=scope)}
+        step_counter = [1]
+        counter_lock = threading.Lock()
+
+        q: "_queue.Queue" = _queue.Queue(maxsize=thread_num * 2)
+        errors: list = []
+
+        def worker():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                try:
+                    r = self.run(program, feed=item,
+                                 fetch_list=fetch_names, scope=scope)
+                    with counter_lock:
+                        step = step_counter[0]
+                        step_counter[0] += 1
+                        last_holder[0] = r
+                    if debug and fetch_names and                             step % max(print_period, 1) == 0:
+                        infos = fetch_info or fetch_names
+                        msg = ", ".join(
+                            f"{n}={np.asarray(v).ravel()[:4]}"
+                            for n, v in zip(infos, r))
+                        print(f"[train_from_dataset] step {step}: {msg}")
+                except BaseException as e:  # surfaced after join
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(thread_num)]
+        for t in threads:
+            t.start()
+
+        def put_checked(item) -> bool:
+            """Bounded put that never deadlocks: if every worker died on
+            errors, stop producing and surface the failure."""
+            while True:
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    if len(errors) >= thread_num:
+                        return False
+
+        for feed in it:
+            if errors:
+                break
+            if not put_checked(feed):
+                break
+        for _ in threads:
+            if not put_checked(None):
+                break
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return last_holder[0]
 
     def infer_from_dataset(self, program=None, dataset=None,
                            scope: Optional[Scope] = None,
@@ -290,11 +388,15 @@ class Executor:
     def _next_rng(self, program: Program):
         if program.random_seed is not None:
             seed = int(program.random_seed)
-            # deterministic but varying per call for this program
-            ctr = self._seed_counters.get(id(program), 0) + 1
-            self._seed_counters[id(program)] = ctr
+            # deterministic but varying per call for this program; the
+            # lock keeps hogwild workers from drawing duplicate keys
+            with self._seed_lock:
+                ctr = self._seed_counters.get(id(program), 0) + 1
+                self._seed_counters[id(program)] = ctr
             return jax.random.fold_in(jax.random.PRNGKey(seed), ctr)
-        return jax.random.PRNGKey(int(self._nprng.randint(0, 2**31 - 1)))
+        with self._seed_lock:  # RandomState is not thread-safe
+            raw = int(self._nprng.randint(0, 2**31 - 1))
+        return jax.random.PRNGKey(raw)
 
     def _build(self, program: Program, feed_arrays, fetch_names, scope):
         block = program.global_block()

@@ -210,3 +210,56 @@ def test_train_from_dataset_e2e(slot_path, tmp_path):
         last = exe.train_from_dataset(prog, ds, scope=scope,
                                       fetch_list=[loss.name])
     assert float(last[0]) < float(first[0])
+
+
+def test_train_from_dataset_hogwild_threads(tmp_path):
+    """TrainerDesc.thread_num > 1 runs Hogwild-style concurrent workers
+    (hogwild_worker.cc analog): N threads share one scope and drain one
+    batch queue; training still converges (lock-free last-writer-wins
+    updates)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+    from paddle_tpu.trainer_desc import MultiTrainer
+
+    f = tmp_path / "part-0"
+    lines = []
+    for i in range(256):
+        label = i % 2
+        feat = 100 + label * 3 + (i % 3)
+        lines.append(f"{label} 0:{feat}\n")
+    f.write_text("".join(lines))
+
+    dataset = InMemoryDataset(num_slots=1)
+    dataset.set_filelist([str(f)])
+    dataset.set_batch_size(16)
+    dataset.set_pad_to_max_length(True)   # one compile across batches
+    dataset.load_into_memory()
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        ids = layers.data("slot_0", [1], dtype="int64")
+        label = layers.data("label", [1], dtype="float32")
+        emb = layers.embedding(ids, size=[200, 8])
+        emb = layers.reshape(emb, [0, 8])
+        logit = layers.fc(emb, 1)
+        loss = layers.mean(
+            layers.sigmoid_cross_entropy_with_logits(logit, label))
+        pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    desc = MultiTrainer()
+    desc.set_thread(4)
+
+    first = exe.run(main, feed=next(dataset.batch_iterator()),
+                    fetch_list=[loss.name], scope=scope)
+    for _ in range(4):
+        out = exe.train_from_dataset(main, dataset, scope=scope,
+                                     fetch_list=[loss],
+                                     trainer_desc=desc)
+    assert out is not None
+    final = exe.run(main, feed=next(dataset.batch_iterator()),
+                    fetch_list=[loss.name], scope=scope)
+    assert float(final[0]) < float(first[0]), (first, final)
