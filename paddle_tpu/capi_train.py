@@ -1,0 +1,81 @@
+"""Python-free training backend for the C API.
+
+Analog of the reference's C++ train demo (paddle/fluid/train/demo:
+load a saved ProgramDesc and drive Executor::Run from C++ with no python
+written by the user). Here `save_train_model` persists the full TRAIN
+program pair (startup + main, backward and optimizer ops included — the
+Program JSON round-trips them), and `Trainer` reloads and steps it; the C
+shim exposes it over a plain C ABI (native/inference_capi.cpp:
+PD_NewTrainer to load, then the shared PD_PredictorRunFloat to step),
+so a C program can run the whole training loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def save_train_model(dirname: str, feed_names: Sequence[str],
+                     fetch_names: Sequence[str], main_program=None,
+                     startup_program=None):
+    """Persist a trainable program pair for python-free driving."""
+    from .framework import (default_main_program, default_startup_program)
+    from .framework.program import Variable
+    main = main_program or default_main_program()
+    startup = startup_program or default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "main": main.to_dict(),
+        "startup": startup.to_dict(),
+        "feed": list(feed_names),
+        "fetch": [v.name if isinstance(v, Variable) else str(v)
+                  for v in fetch_names],
+    }
+    with open(os.path.join(dirname, "__train__.json"), "w") as f:
+        json.dump(meta, f)
+
+
+class Trainer:
+    """Load a saved train pair, run startup once, step on demand."""
+
+    def __init__(self, model_dir: str):
+        from .framework import Executor, Scope
+        from .framework.program import Program
+        with open(os.path.join(model_dir, "__train__.json")) as f:
+            meta = json.load(f)
+        self._main = Program.from_dict(meta["main"])
+        self._startup = Program.from_dict(meta["startup"])
+        self._feed_names: List[str] = meta["feed"]
+        self._fetch_names: List[str] = meta["fetch"]
+        self._scope = Scope()
+        self._exe = Executor(donate_state=True)
+        self._exe.run(self._startup, scope=self._scope)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """One training step; returns the fetch values (e.g. the loss).
+        Signature-compatible with inference.Predictor.run so the C shim
+        drives both through one code path."""
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                f"expected {len(self._feed_names)} inputs "
+                f"({self._feed_names}), got {len(inputs)}")
+        feed = {n: np.asarray(a) for n, a in zip(self._feed_names, inputs)}
+        return self._exe.run(self._main, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+
+    def save_persistables(self, dirname: str):
+        from .framework_io import save_persistables
+        save_persistables(self._exe, dirname, self._main,
+                          scope=self._scope)
+
+
+def create_trainer(model_dir: str) -> Trainer:
+    return Trainer(model_dir)

@@ -308,6 +308,13 @@ class Executor:
         lock)."""
         import queue as _queue
 
+        if self.donate_state:
+            raise ValueError(
+                "hogwild (thread_num > 1) is incompatible with "
+                "donate_state=True: concurrent workers would donate the "
+                "same scope buffers another worker is still reading — "
+                "use a non-donating Executor or thread_num=1")
+
         it = dataset.batch_iterator()
         try:
             first = next(it)
@@ -333,7 +340,8 @@ class Executor:
                         step = step_counter[0]
                         step_counter[0] += 1
                         last_holder[0] = r
-                    if debug and fetch_names and                             step % max(print_period, 1) == 0:
+                    if (debug and fetch_names
+                            and step % max(print_period, 1) == 0):
                         infos = fetch_info or fetch_names
                         msg = ", ".join(
                             f"{n}={np.asarray(v).ravel()[:4]}"
@@ -359,16 +367,20 @@ class Executor:
                     if len(errors) >= thread_num:
                         return False
 
-        for feed in it:
-            if errors:
-                break
-            if not put_checked(feed):
-                break
-        for _ in threads:
-            if not put_checked(None):
-                break
-        for t in threads:
-            t.join()
+        try:
+            for feed in it:
+                if errors:
+                    break
+                if not put_checked(feed):
+                    break
+        finally:
+            # always shut workers down — a dataset iterator that raises
+            # mid-epoch must not leak N threads parked on q.get()
+            for _ in threads:
+                if not put_checked(None):
+                    break
+            for t in threads:
+                t.join()
         if errors:
             raise errors[0]
         return last_holder[0]

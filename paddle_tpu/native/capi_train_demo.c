@@ -1,0 +1,105 @@
+/* C training demo — analog of paddle/fluid/train/demo/demo_trainer.cc:
+ * a plain-C program that loads a saved TRAIN program (forward + backward
+ * + SGD ops serialized in the Program JSON) and runs the full training
+ * loop, printing the loss each epoch. No python written by the caller.
+ *
+ * Usage: capi_train_demo <libpath> <model_dir> <nfeat> <batch> <steps>
+ * Prints "first=<loss> last=<loss>" then "TRAIN OK" when the loss fell.
+ */
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void PD_Predictor;
+typedef PD_Predictor *(*new_fn)(const char *);
+typedef void (*del_fn)(PD_Predictor *);
+typedef int (*run_fn)(PD_Predictor *, const float *const *,
+                      const int64_t *const *, const int *, int, float ***,
+                      int64_t ***, int **, int *);
+typedef void (*free_fn)(float **, int64_t **, int *, int);
+typedef const char *(*err_fn)(void);
+
+int main(int argc, char **argv) {
+  if (argc < 6) {
+    fprintf(stderr, "usage: %s <lib> <dir> <nfeat> <batch> <steps>\n",
+            argv[0]);
+    return 2;
+  }
+  void *lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  new_fn mk = (new_fn)dlsym(lib, "PD_NewTrainer");
+  del_fn del = (del_fn)dlsym(lib, "PD_DeletePredictor");
+  run_fn run = (run_fn)dlsym(lib, "PD_PredictorRunFloat");
+  free_fn freo = (free_fn)dlsym(lib, "PD_FreeOutputs");
+  err_fn err = (err_fn)dlsym(lib, "PD_GetLastError");
+  if (!mk || !del || !run || !freo) {
+    fprintf(stderr, "missing symbols\n");
+    return 2;
+  }
+
+  PD_Predictor *t = mk(argv[2]);
+  if (!t) {
+    fprintf(stderr, "PD_NewTrainer: %s\n", err ? err() : "?");
+    return 1;
+  }
+
+  int nfeat = atoi(argv[3]);
+  int batch = atoi(argv[4]);
+  int steps = atoi(argv[5]);
+  float *x = (float *)malloc(sizeof(float) * batch * nfeat);
+  float *y = (float *)malloc(sizeof(float) * batch);
+  unsigned seed = 12345;
+  double first = -1, last = -1;
+  for (int s = 0; s < steps; s++) {
+    /* synthetic linear data: y = sum_j (j+1) * x_j */
+    for (int i = 0; i < batch; i++) {
+      double target = 0;
+      for (int j = 0; j < nfeat; j++) {
+        seed = seed * 1103515245u + 12345u;
+        float v = (float)((seed >> 16) & 0x7fff) / 32768.0f - 0.5f;
+        x[i * nfeat + j] = v;
+        target += (j + 1) * v;
+      }
+      y[i] = (float)target;
+    }
+    int64_t xs[2] = {batch, nfeat};
+    int64_t ys[2] = {batch, 1};
+    const float *ins[2] = {x, y};
+    const int64_t *shapes[2] = {xs, ys};
+    int nd[2] = {2, 2};
+    float **outs = NULL;
+    int64_t **oshapes = NULL;
+    int *ond = NULL;
+    int nout = 0;
+    if (run(t, ins, shapes, nd, 2, &outs, &oshapes, &ond, &nout) != 0) {
+      fprintf(stderr, "step failed: %s\n", err ? err() : "?");
+      del(t);
+      return 1;
+    }
+    if (nout < 1) {
+      fprintf(stderr, "model has no fetch outputs\n");
+      freo(outs, oshapes, ond, nout);
+      del(t);
+      return 1;
+    }
+    double loss = outs[0][0];
+    if (s == 0) first = loss;
+    last = loss;
+    freo(outs, oshapes, ond, nout);
+  }
+  printf("first=%.5f last=%.5f\n", first, last);
+  del(t);
+  free(x);
+  free(y);
+  if (last < first * 0.2) {
+    printf("TRAIN OK\n");
+    return 0;
+  }
+  fprintf(stderr, "loss did not fall\n");
+  return 1;
+}

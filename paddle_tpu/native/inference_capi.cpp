@@ -105,6 +105,37 @@ PD_Predictor* PD_NewPredictor(const char* model_dir) {
   return out;
 }
 
+// Training twin: loads a saved TRAIN program pair (capi_train.py
+// save_train_model) — the returned handle's run() does one optimizer
+// step, driven through the same PD_PredictorRunFloat/PD_DeletePredictor
+// as inference (both python objects expose run()).
+PD_Predictor* PD_NewTrainer(const char* model_dir) {
+  if (!ensure_python()) {
+    set_last_error("could not initialize python runtime");
+    return nullptr;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  PD_Predictor* out = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_train");
+  if (mod) {
+    PyObject* mk = PyObject_GetAttrString(mod, "create_trainer");
+    PyObject* tr =
+        mk ? PyObject_CallFunction(mk, "s", model_dir) : nullptr;
+    if (tr) {
+      out = new PD_Predictor{tr};
+    } else {
+      capture_py_error("trainer construction failed");
+    }
+    Py_XDECREF(mk);
+    Py_DECREF(mod);
+  } else {
+    capture_py_error(
+        "import paddle_tpu failed (is PYTHONPATH set to the repo root?)");
+  }
+  PyGILState_Release(g);
+  return out;
+}
+
 void PD_DeletePredictor(PD_Predictor* p) {
   if (!p) return;
   PyGILState_STATE g = PyGILState_Ensure();
