@@ -65,15 +65,19 @@ def model_flops_per_token(cfg, seq: int) -> float:
     return 3.0 * fwd
 
 
-def build_steps(model_name: str):
+def build_steps(model_name: str, seq: int = 1024):
     from paddle_tpu import amp, jit
     from paddle_tpu.models import GPT_CONFIGS, GPTForCausalLM
     from paddle_tpu.optimizer import AdamW
 
     cfg = GPT_CONFIGS[model_name]
+    import dataclasses
     if os.environ.get("BENCH_RECOMPUTE") == "1":
-        import dataclasses
         cfg = dataclasses.replace(cfg, recompute=True)
+    if seq > cfg.max_position_embeddings:
+        # long-seq configs need position rows to exist (the model raises
+        # on out-of-range positions rather than NaN-ing)
+        cfg = dataclasses.replace(cfg, max_position_embeddings=seq)
     model = GPTForCausalLM(cfg)
     # bf16 m/v is the recommended TPU config (halves optimizer-state HBM;
     # measured +1.1pt MFU on the 345M flagship) — opt out with =0
@@ -190,7 +194,7 @@ def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
     peak = detect_peak_flops(dev)
 
     try:
-        cfg, step, multi = build_steps(model_name)
+        cfg, step, multi = build_steps(model_name, seq)
         rng = np.random.RandomState(0)
         ids1 = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
         lab1 = np.roll(ids1, -1, axis=1).astype(np.int32)

@@ -31,7 +31,8 @@ def save_train_model(dirname: str, feed_names: Sequence[str],
     meta = {
         "main": main.to_dict(),
         "startup": startup.to_dict(),
-        "feed": list(feed_names),
+        "feed": [v.name if isinstance(v, Variable) else str(v)
+                 for v in feed_names],
         "fetch": [v.name if isinstance(v, Variable) else str(v)
                   for v in fetch_names],
     }

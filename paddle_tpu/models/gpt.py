@@ -150,6 +150,14 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, cache=None, position_offset=0):
         s = input_ids.shape[1]
+        if position_offset + s > self.cfg.max_position_embeddings:
+            # out-of-range position gathers would silently produce NaN
+            # embeddings (jnp.take fill mode) — fail with guidance instead
+            raise ValueError(
+                f"sequence length {position_offset + s} exceeds "
+                f"max_position_embeddings={self.cfg.max_position_embeddings}"
+                "; raise it in the GPTConfig (dataclasses.replace) or "
+                "truncate the input")
         import jax.numpy as jnp
         pos = Tensor(jnp.arange(position_offset, position_offset + s,
                                 dtype=jnp.int32)[None, :],

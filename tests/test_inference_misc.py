@@ -252,7 +252,8 @@ def test_c_api_training(tmp_path):
     save_train_model(d, ["x", "y"], [loss], main, startup)
 
     from paddle_tpu import native
-    ver = sysconfig.get_config_var("LDVERSION")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
     libdir = sysconfig.get_config_var("LIBDIR")
     inc = sysconfig.get_config_var("INCLUDEPY")
     lib = native.build_and_load(

@@ -222,3 +222,17 @@ def test_ctr_models_accept_multi_hot():
         np.testing.assert_allclose(np.asarray(out.numpy()),
                                    np.asarray(out2.numpy()), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_gpt_rejects_sequences_beyond_max_position():
+    """Positions past max_position_embeddings previously gathered NaN
+    embedding rows (jnp.take fill mode) and silently NaN'd the loss;
+    now the model raises with guidance (found by the seq-2048 bench)."""
+    from paddle_tpu.models import GPT_CONFIGS, GPTForCausalLM
+
+    cfg = GPT_CONFIGS["gpt2-tiny"]
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = np.zeros((1, cfg.max_position_embeddings + 8), np.int32)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        m(pt.to_tensor(ids))
