@@ -19,9 +19,10 @@ from typing import Dict, List, Sequence
 import numpy as np
 
 
-def save_train_model(dirname: str, feed_names: Sequence[str],
-                     fetch_names: Sequence[str], main_program=None,
+def save_train_model(dirname: str, feed_names: Sequence,
+                     fetch_names: Sequence, main_program=None,
                      startup_program=None):
+    # feed_names / fetch_names: variable names (str) or Variable objects
     """Persist a trainable program pair for python-free driving."""
     from .framework import (default_main_program, default_startup_program)
     from .framework.program import Variable

@@ -198,7 +198,9 @@ class GPTForCausalLM(Layer):
     def forward(self, input_ids, labels=None, cache=None,
                 position_offset=0):
         if cache is None:
-            h = self.gpt(input_ids)
+            # forward the offset: chunked-prefill callers without a cache
+            # must get real positions (and the out-of-range guard)
+            h = self.gpt(input_ids, position_offset=position_offset)
         else:
             h, cache = self.gpt(input_ids, cache, position_offset)
         # tied LM head: h @ wte.T

@@ -38,6 +38,12 @@ def iter_api(root_name: str):
                                           prefix=root_name + "."):
             if info.name in seen_modules:
                 continue
+            # built native artifacts (_<name>-<srchash>-<flaghash>.so)
+            # carry content hashes in their filenames — they are build
+            # outputs, not API surface, and would churn the snapshot on
+            # every C++ edit
+            if info.name.rsplit(".", 1)[-1].startswith("_"):
+                continue
             seen_modules.add(info.name)
             try:
                 modules.append(importlib.import_module(info.name))
