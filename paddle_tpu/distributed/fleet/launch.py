@@ -30,6 +30,16 @@ def _parse_args(argv=None):
                    default=int(os.getenv("HOST_RANK", "0")))
     p.add_argument("--coordinator", default=None,
                    help="coordinator address host:port for jax.distributed")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (reference launch_utils "
+                        "get_cluster_from_args parity; >1 spawns ranked "
+                        "children that jax.distributed-join one world)")
+    p.add_argument("--dist_platform", default=None,
+                   help="force jax platform in ranked children "
+                        "(cpu = virtual-device CI mode with gloo "
+                        "cross-process collectives)")
+    p.add_argument("--devices_per_proc", type=int, default=0,
+                   help="virtual devices per child (cpu CI mode)")
     p.add_argument("--servers", default="",
                    help="PS mode: comma-separated server endpoints")
     p.add_argument("--workers", default="",
@@ -44,20 +54,79 @@ def _parse_args(argv=None):
 def launch_collective(args):
     hosts = args.ips.split(",")
     nhosts = len(hosts)
-    os.environ.setdefault("PADDLE_TRAINER_ID", str(args.host_rank))
-    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nhosts))
-    os.environ.setdefault("PADDLE_TRAINER_ENDPOINTS",
-                          ",".join(f"{h}:8910" for h in hosts))
-    os.environ.setdefault("PADDLE_CURRENT_ENDPOINT",
-                          f"{hosts[args.host_rank]}:8910")
+    nproc = max(1, args.nproc_per_node)
+    world = nhosts * nproc
+    if nproc > 1:
+        return _launch_collective_multiproc(args, hosts, nproc, world)
+    # the CLI args are the source of truth — force-set so stale ambient
+    # PADDLE_* values from a prior run can't override --ips/--host_rank
+    os.environ["PADDLE_TRAINER_ID"] = str(args.host_rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nhosts)
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = \
+        ",".join(f"{h}:8910" for h in hosts)
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = f"{hosts[args.host_rank]}:8910"
     if nhosts > 1:
-        import jax
+        # export the coordinator plane AND join the world here, so
+        # scripts that never call init_parallel_env still see global
+        # devices; init_parallel_env's is_initialized() check keeps its
+        # own join a no-op afterwards
         coordinator = args.coordinator or f"{hosts[0]}:8476"
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=nhosts,
-                                   process_id=args.host_rank)
+        os.environ["PADDLE_COORDINATOR"] = coordinator
+        from ..parallel import _maybe_init_multiprocess
+        _maybe_init_multiprocess()
     sys.argv = [args.training_script] + args.training_script_args
     runpy.run_path(args.training_script, run_name="__main__")
+
+
+def _launch_collective_multiproc(args, hosts, nproc, world):
+    """Spawn ``nproc`` ranked trainer processes on this host, one global
+    jax.distributed world across all of them (reference: one process per
+    GPU, launch_utils.start_local_trainers / get_cluster_from_args).
+
+    Each child re-runs the training script with the PADDLE_* rank plane
+    set; the script joins the world by calling
+    ``paddle_tpu.distributed.init_parallel_env()``. Children are watched
+    pod-style: any non-zero exit terminates the rest (launch.py:188-226).
+    """
+    coordinator = args.coordinator or f"{hosts[0]}:8476"
+    procs: List[subprocess.Popen] = []
+    for i in range(nproc):
+        rank = args.host_rank * nproc + i
+        env = dict(os.environ,
+                   PADDLE_TRAINER_ID=str(rank),
+                   PADDLE_TRAINERS_NUM=str(world),
+                   PADDLE_COORDINATOR=coordinator,
+                   PADDLE_TRAINER_ENDPOINTS=",".join(
+                       f"{h}:{8910 + j}" for h in hosts
+                       for j in range(nproc)),
+                   PADDLE_CURRENT_ENDPOINT=f"{hosts[args.host_rank]}:"
+                                           f"{8910 + i}")
+        if args.dist_platform:
+            env["PADDLE_DIST_PLATFORM"] = args.dist_platform
+        if args.devices_per_proc:
+            env["PADDLE_DIST_DEVICES_PER_PROC"] = str(args.devices_per_proc)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", args.training_script] +
+            args.training_script_args, env=env))
+    _watch_pod(procs)
+
+
+def _watch_pod(procs: List[subprocess.Popen]):
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    for q in procs:
+                        q.terminate()
+                    sys.exit(ret)
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
 
 
 def launch_ps(args):
@@ -85,21 +154,7 @@ def launch_ps(args):
             [sys.executable, args.training_script] +
             args.training_script_args, env=env))
     # watch children; terminate the pod on any failure (launch.py:188-226)
-    try:
-        while procs:
-            for p in list(procs):
-                ret = p.poll()
-                if ret is None:
-                    continue
-                procs.remove(p)
-                if ret != 0:
-                    for q in procs:
-                        q.terminate()
-                    sys.exit(ret)
-            time.sleep(1)
-    except KeyboardInterrupt:
-        for p in procs:
-            p.terminate()
+    _watch_pod(procs)
 
 
 def main(argv=None):

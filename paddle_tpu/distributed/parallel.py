@@ -47,17 +47,79 @@ class ParallelEnv:
     nranks = world_size
 
 
+def _maybe_init_multiprocess():
+    """Join the multi-process world described by the launcher env plane.
+
+    The launcher (``paddle_tpu.distributed.launch --nproc_per_node N``)
+    exports ``PADDLE_COORDINATOR`` + ``PADDLE_TRAINER_ID`` +
+    ``PADDLE_TRAINERS_NUM`` — the analog of the reference's
+    gen_nccl_id rank bootstrap (imperative/nccl_context.cc, launch_utils
+    PADDLE_* plane), realized as ``jax.distributed.initialize``: after it
+    returns, ``jax.devices()`` is the GLOBAL device list and GSPMD
+    computations over a global mesh insert cross-process collectives.
+
+    Testability plane: ``PADDLE_DIST_PLATFORM=cpu`` +
+    ``PADDLE_DIST_DEVICES_PER_PROC=K`` provision K virtual CPU devices
+    per process with the gloo cross-process collectives implementation —
+    the TestDistBase-style CI path (no TPU pod required).
+    """
+    _apply_platform_env()
+    coordinator = os.getenv("PADDLE_COORDINATOR")
+    if not coordinator:
+        return False
+    import jax
+
+    if jax.distributed.is_initialized():
+        return True  # already initialized (idempotent re-entry)
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    world = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world, process_id=rank)
+    return True
+
+
+def _apply_platform_env():
+    """Apply the launcher's platform plane (PADDLE_DIST_PLATFORM /
+    PADDLE_DIST_DEVICES_PER_PROC) — must run before the jax backend is
+    touched. The axon sitecustomize imports jax with a fixed platform at
+    interpreter start, so plain JAX_PLATFORMS env vars are too late in
+    child processes; config.update is the only reliable channel."""
+    import jax
+
+    platform = os.getenv("PADDLE_DIST_PLATFORM")
+    ndev = os.getenv("PADDLE_DIST_DEVICES_PER_PROC")
+    if not platform and not ndev:
+        return
+    try:
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        if ndev:
+            jax.config.update("jax_num_cpu_devices", int(ndev))
+        if (platform or "").startswith("cpu"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:
+        raise RuntimeError(
+            "multi-process init needs jax platform config before the "
+            "backend is touched; call init_parallel_env() before any "
+            f"device computation (config error: {e})")
+
+
 def init_parallel_env(data_axis: str = "dp",
                       mesh_shape: Optional[dict] = None):
     """Create the device mesh and register ring 0 -> data axis.
 
-    Single host: mesh over all local devices. Multi-host: call
-    jax.distributed.initialize first (the launcher does).
+    Single host: mesh over all local devices. Multi-process/multi-host:
+    when the launcher's ``PADDLE_COORDINATOR`` env plane is present this
+    first joins the global world via ``jax.distributed.initialize`` (so
+    the mesh spans every process's devices); otherwise call
+    jax.distributed.initialize yourself before this.
     Returns the ParallelEnv.
     """
     import jax
     from jax.sharding import Mesh
     from . import env as dist_env
+
+    _maybe_init_multiprocess()
 
     from .env import build_mesh
     if mesh_shape:
