@@ -256,6 +256,11 @@ class PipelineRunner:
                 for i in range(M - warmup):
                     items.append(("F", warmup + i))
                     items.append(("B", i))
+                # drain the warmup microbatches' backwards. NOTE: emitted
+                # in ASCENDING mb order (GPipe drains descending); only
+                # correct because _linearize re-sorts by dependency —
+                # consumers of _stage_orders must not assume issue order
+                # equals execution order
                 items += [("B", mb) for mb in range(M - warmup, M)]
             items.append(("OPT", -1))
             orders.append(items)

@@ -87,14 +87,20 @@ class _KVHandler(BaseHTTPRequestHandler):
 class KVServer:
     """fleet/utils/http_server.py KVServer parity.
 
+    Security note: like the reference's fleet KVServer, this speaks
+    unauthenticated HTTP and by default binds 0.0.0.0 — the trust
+    assumption is a cluster-private network. Pass ``bind_address`` to
+    restrict (e.g. "127.0.0.1" for single-host rendezvous).
+
     >>> srv = KVServer(0)          # port 0 = ephemeral
     >>> srv.start()
     >>> ... clients rendezvous ...
     >>> srv.stop()
     """
 
-    def __init__(self, port: int, size: Optional[Dict[str, int]] = None):
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+    def __init__(self, port: int, size: Optional[Dict[str, int]] = None,
+                 bind_address: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((bind_address, port), _KVHandler)
         self._httpd.kv = {}
         self._httpd.kv_lock = threading.Lock()
         self._httpd.deleted = {}

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from typing import Callable, Dict, Iterable, Optional, Sequence
 
 import numpy as np
@@ -82,7 +83,8 @@ class PullPrefetcher:
                         if self._stop.is_set() \
                                 or table._stage_active <= 0:
                             return
-                        table._staged[_stage_key(ids)] = rows
+                        table._staged.setdefault(
+                            _stage_key(ids), deque()).append(rows)
                 if not self._put(batch):
                     return
         except BaseException as e:      # surface in the consumer

@@ -12,6 +12,7 @@ in behind the same interface.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -42,11 +43,16 @@ class SparseTable:
         self._accum: List[Dict[int, np.ndarray]] = [
             {} for _ in range(shard_num)]
         # rows staged by a PullPrefetcher (ps/prefetch.py), keyed by the
-        # exact ids payload; consumed once by the next matching pull.
-        # Staging is only honored while a prefetcher is actively scoped
-        # (_stage_active > 0) — an abandoned loop's leftovers must never
-        # serve a later unrelated pull with pre-push values.
-        self._staged: Dict[bytes, np.ndarray] = {}
+        # exact ids payload, FIFO per key: each staged row set is
+        # consumed exactly ONCE, in stage order, so duplicate consecutive
+        # batches each get their own pre-pulled copy (no silent
+        # overwrite). Staging is only honored while a prefetcher is
+        # actively scoped (_stage_active > 0) — an abandoned loop's
+        # leftovers must never serve a later unrelated pull with
+        # pre-push values. Staleness contract: a staged row may predate
+        # pushes issued after its pull — the reference's async/
+        # half-async semantics (see ps/prefetch.py docstring).
+        self._staged: Dict[bytes, "deque"] = {}
         self._stage_lock = threading.Lock()
         self._stage_active = 0
 
@@ -67,8 +73,13 @@ class SparseTable:
         if self._staged and self._stage_active > 0:
             from .prefetch import _stage_key
             key = _stage_key(ids)
+            rows = None
             with self._stage_lock:
-                rows = self._staged.pop(key, None)
+                q = self._staged.get(key)
+                if q:
+                    rows = q.popleft()
+                    if not q:
+                        del self._staged[key]
             if rows is not None:
                 return rows.reshape(
                     tuple(np.asarray(ids).shape) + (self.value_dim,))

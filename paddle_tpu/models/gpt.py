@@ -66,6 +66,11 @@ GPT_CONFIGS = {
                             ffn_hidden_size=3072),
     "gpt2-medium": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
                              ffn_hidden_size=4096),   # the 345M baseline
+    # BASELINE configs[2] 1.3B-class flagship: GPT-3-style geometry —
+    # head_dim 128 fills the full 128-lane MXU contraction (d=64 GPT-2
+    # heads run at half MXU width; PERF.md "where the time goes")
+    "gpt2-1p3b": GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                           ffn_hidden_size=8192),
     "gpt2-xl": GPTConfig(hidden_size=1600, num_layers=48, num_heads=25,
                          ffn_hidden_size=6400),
 }

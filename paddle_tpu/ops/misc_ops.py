@@ -216,11 +216,12 @@ def _bilinear_tensor_product(ctx, ins, attrs):
 @register("fill", not_differentiable=True)
 def _fill(ctx, ins, attrs):
     from .registry import np_dtype
+    from ..framework.program import convert_dtype
     shape = [int(s) for s in attrs["shape"]]
     vals = np.asarray(attrs["value"], np.float64).reshape(shape)
-    return {"Out": [jnp.asarray(vals, np_dtype(
-        attrs.get("dtype_str", attrs.get("dtype", "float32"))
-        if isinstance(attrs.get("dtype"), str) else "float32"))]}
+    dt = attrs.get("dtype_str", attrs.get("dtype"))
+    dt = "float32" if dt is None else convert_dtype(dt)
+    return {"Out": [jnp.asarray(vals, np_dtype(dt))]}
 
 
 @register("fill_constant_batch_size_like", not_differentiable=True)

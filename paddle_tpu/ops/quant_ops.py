@@ -154,12 +154,16 @@ def _fake_q_channel_abs_max(ctx, ins, attrs):
 
 
 @register("fake_quantize_range_abs_max",
-          no_grad_slots=("InScale", "Iter"),
+          no_grad_slots=("InScale", "Iter", "InScales"),
           custom_grad_maker=_ste_grad_maker)
 def _fake_q_range_abs_max(ctx, ins, attrs):
-    """reference FakeQuantizeRangeAbsMax: scale = max of a sliding window
-    of per-step abs-maxes (window_size); collapsed to the running max,
-    which is what the reference converges to within a window."""
+    """reference FakeQuantizeRangeAbsMax (fake_quantize_op.cc): scale =
+    max over a window_size ring of per-step abs-maxes, so the scale can
+    DECREASE when activation ranges decay during long QAT runs. The ring
+    is threaded functionally: feed the previous step's OutScales back as
+    InScales plus the step counter Iter. Without those inputs the op
+    degrades to the running max (what the reference converges to within
+    one window) — that approximation can only pin the scale high."""
     x = ins["X"][0]
     in_scale = ins["InScale"][0].reshape(())
     qmax = float(2 ** (int(attrs.get("bit_length", 8)) - 1) - 1)
@@ -167,8 +171,16 @@ def _fake_q_range_abs_max(ctx, ins, attrs):
     if attrs.get("is_test"):
         scale = in_scale
     else:
-        scale = jnp.maximum(jnp.max(jnp.abs(x)), in_scale)
-        outs["OutScales"] = [scale.reshape(1)]
+        cur = jnp.max(jnp.abs(x))
+        if "InScales" in ins and "Iter" in ins:
+            ring = ins["InScales"][0]
+            it = ins["Iter"][0].reshape(()).astype(jnp.int32)
+            ring = ring.at[jnp.mod(it, ring.shape[0])].set(cur)
+            scale = jnp.max(ring)   # empty slots are 0 <= any abs-max
+            outs["OutScales"] = [ring]
+        else:
+            scale = jnp.maximum(cur, in_scale)
+            outs["OutScales"] = [scale.reshape(1)]
     outs["OutScale"] = [scale]
     outs["Out"] = [_q(x, scale, qmax)]
     return outs

@@ -8,13 +8,14 @@
 #   3. op coverage gate (>= 80% of the reference forward-op surface)
 #   4. API-freeze check (public signature snapshot diff)
 #   5. multi-chip dry-run (GSPMD train step on N virtual devices)
+#   6. README headline vs latest bench artifact (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite (smoke only)
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/5 import smoke"
+echo "== 1/6 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -24,24 +25,24 @@ print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 2/5 test suite (virtual 8-device CPU mesh)"
+  echo "== 2/6 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 2/5 test suite: SKIPPED (quick mode)"
+  echo "== 2/6 test suite: SKIPPED (quick mode)"
 fi
 
-echo "== 3/5 op coverage gate"
+echo "== 3/6 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 4/5 API freeze"
+echo "== 4/6 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -60,11 +61,14 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 5/5 multi-chip dry run"
+echo "== 5/6 multi-chip dry run"
 python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('   8-device GSPMD train step ok')
 "
+
+echo "== 6/6 README headline sync"
+JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"
