@@ -3,3 +3,5 @@
 from .tensor import Parameter, Tensor, to_tensor, to_variable
 from .tape import Tracer, default_tracer, grad, no_grad, run_op
 from .layers import (Layer, LayerList, ParameterList, Sequential, seed)
+from .dygraph_to_static import (ProgramTranslator, convert_function,
+                                declarative)

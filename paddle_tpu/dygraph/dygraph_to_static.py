@@ -1,0 +1,457 @@
+"""dygraph_to_static — minimal AST conversion for data-dependent control
+flow.
+
+Analog of the reference's ProgramTranslator AST transpiler
+(python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:667
+plus ifelse_transformer.py / logical_transformer.py): the reference
+rewrites data-dependent python ``if``/``while`` into cond/while ops so a
+dygraph model can compile to a static program. Here the compile target
+is a jax trace (jit.to_static), so the converter's job is to make
+data-dependent ``if`` statements *traceable*:
+
+- ``if`` whose test is a TRACED scalar Tensor: both branches execute
+  during tracing and every branch-assigned variable is merged with an
+  elementwise ``where`` select on the predicate — XLA's native form of a
+  value-dependent conditional (no divergent control flow on the MXU; the
+  taken-branch gradient flows, the untaken side's is zeroed by the
+  select vjp). This is the retrace-per-branch strategy specialized to
+  tracing: functional branches, one compiled program for both paths.
+- ``if`` whose test is CONCRETE (eager mode, or a python value): plain
+  python branching — semantics identical to undecorated code, only the
+  taken branch runs (so side effects behave exactly as in dygraph).
+- ``and`` / ``or`` / ``not`` inside a transformed test: rewritten to
+  helpers that short-circuit on concrete values and lower to
+  logical_and/or/not on traced ones (logical_transformer.py parity).
+- ``for i in range(n)`` with tensor-independent bounds needs no rewrite:
+  the trace unrolls it (the reference transpiles it because its py
+  functions can't run against Variables; ours can).
+
+Anything outside this subset — early ``return``/``break``/``continue``
+inside a converted branch, attribute/subscript assignment in a branch
+(would double-apply side effects under a traced predicate), ``while`` on
+a traced condition — is left untransformed and falls back to the
+existing traced-``__bool__`` guard (dygraph/tensor.py), which raises
+with guidance instead of silently miscompiling.
+
+Branch contract under a traced predicate: both branches run, so they
+must be side-effect free w.r.t. model state (the same contract as
+jax.lax.cond / the reference's cond op, whose branches are separate
+blocks).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable
+
+__all__ = ["convert_function", "declarative", "ProgramTranslator"]
+
+_HELPER_PREFIX = "__pt_d2s_"
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (injected into converted functions' globals)
+# ---------------------------------------------------------------------------
+
+def _is_traced(x) -> bool:
+    from .tensor import Tensor
+    if not isinstance(x, Tensor):
+        return False
+    import jax
+    return isinstance(x.value, jax.core.Tracer)
+
+
+def _truth(x) -> bool:
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return bool(x)        # concrete: VarBase-style scalar coercion
+    return bool(x)
+
+
+def _as_tensor(x):
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(x), stop_gradient=True)
+
+
+def _bool_pred(pred):
+    """Normalize a traced predicate to a boolean tensor (truthiness of
+    non-bool dtypes = `!= 0`, python semantics)."""
+    from .tape import run_op
+    import jax.numpy as jnp
+    if pred.value.dtype == jnp.bool_:
+        return pred
+    zero = _as_tensor(jnp.zeros((), pred.value.dtype))
+    return run_op("not_equal", {"X": [pred], "Y": [zero]}, {})["Out"][0]
+
+
+class _Missing:
+    """Placeholder for a branch variable with no binding (unassigned
+    before the ``if`` and in the taken branch). ANY use raises — the
+    python-semantics analog of the UnboundLocalError undecorated code
+    would produce at the use site."""
+
+    def __init__(self, name=None):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        nm = f"'{self.name}'" if self.name else "(from a converted `if`)"
+        raise UnboundLocalError(
+            f"local variable {nm} referenced before assignment — it was "
+            "not bound by the taken branch of a converted "
+            "data-dependent `if`")
+
+    __bool__ = __call__ = __getitem__ = __iter__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __gt__ = __lt__ = __ge__ = __le__ = _raise
+    __eq__ = __ne__ = __neg__ = __contains__ = _raise
+    __hash__ = None
+
+    def __getattr__(self, key):
+        self._raise()
+
+    def __repr__(self):
+        return f"<undefined branch variable {self.name!r}>"
+
+
+_MISSING = _Missing()
+
+
+def _run_cond(pred, true_fn, false_fn, names, env):
+    """Evaluate a converted ``if``: python branch on a concrete pred,
+    both-branch where-merge on a traced one. ``env`` is the caller's
+    locals(): the merged names' current bindings are passed INTO the
+    branch functions as arguments (a branch that read-then-assigns an
+    outer variable would otherwise hit python's local-shadowing
+    UnboundLocalError — the same live-variable problem the reference's
+    ifelse_transformer solves with function args)."""
+    kw = {k: env[k] for k in names
+          if k in env and not isinstance(env[k], _Missing)}
+    if not _is_traced(pred):
+        out = (true_fn if _truth(pred) else false_fn)(**kw)
+        # names the taken branch left unbound get a NAMED sentinel that
+        # raises on any use — matching python's use-site
+        # UnboundLocalError instead of leaking a truthy placeholder
+        return tuple(_Missing(nm) if isinstance(v, _Missing) else v
+                     for nm, v in zip(names, out))
+    from .tape import run_op
+    if getattr(pred.value, "size", 1) != 1:
+        raise TypeError(
+            "converted `if` needs a SCALAR tensor predicate, got shape "
+            f"{tuple(pred.shape)}; reduce it (e.g. .all()/.any()/.mean())"
+            " first")
+    t_out = true_fn(**kw)
+    f_out = false_fn(**kw)
+    for name, a, b in zip(names, t_out, f_out):
+        if isinstance(a, _Missing) or isinstance(b, _Missing):
+            raise NameError(
+                f"variable '{name}' is assigned in only one branch of a "
+                "data-dependent `if` and has no value before it; define "
+                "it before the `if` (both branches execute under "
+                "tracing, and the untaken branch needs a value to "
+                "merge)")
+    pb = _bool_pred(pred)
+    out = []
+    for name, a, b in zip(names, t_out, f_out):
+        from .tensor import Tensor
+        if isinstance(a, Tensor) or isinstance(b, Tensor):
+            ta, tb = _as_tensor(a), _as_tensor(b)
+            try:
+                merged = run_op("where", {"Condition": [pb], "X": [ta],
+                                          "Y": [tb]}, {})["Out"][0]
+            except Exception as e:
+                raise TypeError(
+                    f"cannot merge variable '{name}' across the branches "
+                    f"of a data-dependent `if`: true-branch shape "
+                    f"{tuple(ta.shape)} vs false-branch {tuple(tb.shape)}"
+                    f" ({e})") from e
+            out.append(merged)
+        else:
+            eq = a is b
+            if not eq:
+                try:
+                    eq = bool(a == b)
+                except Exception:
+                    eq = False     # ambiguous (e.g. ndarray) != mergeable
+            if eq:
+                out.append(a)
+            else:
+                raise TypeError(
+                    f"variable '{name}' takes different non-tensor "
+                    f"values across a data-dependent `if` ({a!r} vs "
+                    f"{b!r}); only Tensor values can be merged by the "
+                    "traced predicate (wrap arrays in "
+                    "paddle_tpu.to_tensor)")
+    return tuple(out)
+
+
+def _run_and(*thunks):
+    val = thunks[0]()
+    for th in thunks[1:]:
+        if _is_traced(val):
+            from .tape import run_op
+            val = run_op("logical_and",
+                         {"X": [_bool_pred(val)],
+                          "Y": [_bool_pred(_ensure_t(th()))]},
+                         {})["Out"][0]
+        else:
+            if not _truth(val):
+                return val        # python `and` returns the falsy operand
+            val = th()
+    return val
+
+
+def _run_or(*thunks):
+    val = thunks[0]()
+    for th in thunks[1:]:
+        if _is_traced(val):
+            from .tape import run_op
+            val = run_op("logical_or",
+                         {"X": [_bool_pred(val)],
+                          "Y": [_bool_pred(_ensure_t(th()))]},
+                         {})["Out"][0]
+        else:
+            if _truth(val):
+                return val        # python `or` returns the truthy operand
+            val = th()
+    return val
+
+
+def _run_not(x):
+    if _is_traced(x):
+        from .tape import run_op
+        return run_op("logical_not", {"X": [_bool_pred(x)]}, {})["Out"][0]
+    return not _truth(x)
+
+
+def _ensure_t(x):
+    from .tensor import Tensor
+    if _is_traced(x) or isinstance(x, Tensor):
+        return x
+    return _as_tensor(x)
+
+
+_RUNTIME = {
+    _HELPER_PREFIX + "cond": _run_cond,
+    _HELPER_PREFIX + "and": _run_and,
+    _HELPER_PREFIX + "or": _run_or,
+    _HELPER_PREFIX + "not": _run_not,
+    _HELPER_PREFIX + "missing": _MISSING,
+}
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+_BRANCH_BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Global,
+                    ast.Nonlocal, ast.Import, ast.ImportFrom)
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+
+
+def _assigned_names(stmts):
+    """Names bound by simple stores in these statements (not descending
+    into nested function/class/comprehension scopes — a comprehension's
+    loop target is NOT a function-local binding in py3). Returns None if
+    the branch does something we refuse to convert (early exit,
+    attribute/subscript store — the latter would double-apply side
+    effects when both branches run under a traced predicate)."""
+    names = []
+
+    def walk(node) -> bool:
+        if isinstance(node, _SCOPE_BARRIERS + _COMPREHENSIONS):
+            return True               # own scope: no outer bindings
+        if isinstance(node, _BRANCH_BLOCKERS):
+            return False
+        if isinstance(node, (ast.Attribute, ast.Subscript)) \
+                and isinstance(node.ctx, ast.Store):
+            return False              # side-effecting store: refuse
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id not in names:
+                names.append(node.id)
+        return all(walk(c) for c in ast.iter_child_nodes(node))
+
+    for s in stmts:
+        if not walk(s):
+            return None
+    return names
+
+
+class _TestTransformer(ast.NodeTransformer):
+    """Inside a converted `if` test only: and/or -> short-circuit thunk
+    helpers, not -> logical helper (logical_transformer.py parity)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = _HELPER_PREFIX + ("and" if isinstance(node.op, ast.And)
+                               else "or")
+        thunks = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=v) for v in node.values]
+        return ast.Call(func=ast.Name(id=fn, ctx=ast.Load()),
+                        args=thunks, keywords=[])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Name(id=_HELPER_PREFIX + "not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+class _IfTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.converted = 0
+
+    # do not descend into nested defs — they convert on their own call
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_If(self, node):
+        self.generic_visit(node)        # inner ifs (incl. elif) first
+        t_names = _assigned_names(node.body)
+        f_names = _assigned_names(node.orelse)
+        if t_names is None or f_names is None:
+            return node                 # unsupported shape: guard handles
+        names = list(dict.fromkeys(t_names + f_names))
+        n = self.counter
+        self.counter += 1
+        self.converted += 1
+        tf, ff = f"{_HELPER_PREFIX}tb{n}", f"{_HELPER_PREFIX}fb{n}"
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=nm, ctx=ast.Load()) for nm in names],
+            ctx=ast.Load()))
+        # the merged names become branch-function PARAMETERS (defaulting
+        # to the missing sentinel): a branch that read-then-assigns an
+        # outer variable must receive it as an argument, or python's
+        # local-shadowing rules raise UnboundLocalError
+        branch_args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=nm) for nm in names], kwonlyargs=[],
+            kw_defaults=[],
+            defaults=[ast.Name(id=_HELPER_PREFIX + "missing",
+                               ctx=ast.Load()) for _ in names])
+        t_def = ast.FunctionDef(
+            name=tf, args=branch_args, body=list(node.body) + [ret],
+            decorator_list=[], returns=None)
+        f_def = ast.FunctionDef(
+            name=ff, args=branch_args,
+            body=list(node.orelse) + [ret], decorator_list=[],
+            returns=None)
+        test = _TestTransformer().visit(node.test)
+        call = ast.Call(
+            func=ast.Name(id=_HELPER_PREFIX + "cond", ctx=ast.Load()),
+            args=[test, ast.Name(id=tf, ctx=ast.Load()),
+                  ast.Name(id=ff, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=nm) for nm in names],
+                            ctx=ast.Load()),
+                  ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                           args=[], keywords=[])],
+            keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=nm, ctx=ast.Store())
+                          for nm in names], ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [t_def, f_def, assign]
+
+
+def convert_function(fn: Callable) -> Callable:
+    """Source-rewrite ``fn`` so supported data-dependent ``if``
+    statements trace; returns ``fn`` unchanged when there is nothing to
+    convert or the source is unavailable (builtins, C extensions,
+    already-converted functions)."""
+    if getattr(fn, "__d2s_converted__", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    tr = _IfTransformer()
+    # transform the target function's BODY (visit_FunctionDef is a
+    # barrier for nested defs, which must not apply to fdef itself)
+    new_body = []
+    for stmt in fdef.body:
+        r = tr.visit(stmt)
+        new_body.extend(r if isinstance(r, list) else [r])
+    fdef.body = new_body
+    if tr.converted == 0:
+        return fn
+    ast.fix_missing_locations(tree)
+    code = compile(tree, f"<dygraph_to_static {fn.__qualname__}>", "exec")
+    # rebuild the defining environment: module globals + a snapshot of
+    # the closure (converted code is exec'd, so real cells are gone —
+    # same limitation as the reference's to-source round trip)
+    env = dict(fn.__globals__)
+    if fn.__closure__:
+        env.update(zip(fn.__code__.co_freevars,
+                       (c.cell_contents for c in fn.__closure__)))
+    env.update(_RUNTIME)
+    ns = {}
+    exec(code, env, ns)
+    new_fn = ns[fdef.name]
+    # wrap the PLAIN function (method objects forbid setattr), then bind
+    new_fn = functools.wraps(getattr(fn, "__func__", fn))(new_fn)
+    new_fn.__d2s_converted__ = True
+    if inspect.ismethod(fn):
+        new_fn = new_fn.__get__(fn.__self__)
+    return new_fn
+
+
+def declarative(fn: Callable) -> Callable:
+    """Decorator parity with fluid.dygraph.declarative / the 2.x
+    @paddle.jit.to_static AST mode: convert on first call (so a
+    ProgramTranslator().enable(False) at call time falls through to the
+    original eager function)."""
+    converted_holder = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not ProgramTranslator().enable_to_static:
+            return fn(*args, **kwargs)
+        if "fn" not in converted_holder:
+            converted_holder["fn"] = convert_function(fn)
+        return converted_holder["fn"](*args, **kwargs)
+
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+class ProgramTranslator:
+    """Singleton toggle (program_translator.py ProgramTranslator parity:
+    enable(False) makes @declarative functions run eagerly)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    def enable(self, flag: bool):
+        self.enable_to_static = bool(flag)

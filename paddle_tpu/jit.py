@@ -73,7 +73,7 @@ class _StateSpec:
 
 def to_static(function: Optional[Callable] = None, *, layers=None,
               optimizers=None, donate_state: bool = True, mesh=None,
-              param_rules=None, arg_specs=None):
+              param_rules=None, arg_specs=None, ast_convert: bool = False):
     """Compile a dygraph function into one XLA computation.
 
     - forward-only: ``fast = to_static(model)`` or
@@ -90,15 +90,28 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
 
     Inputs may be Tensors or arrays; outputs mirror the function's returns
     with Tensors for traced arrays. Retraces on new input shapes/dtypes.
+
+    ``ast_convert=True`` first runs the dygraph_to_static source
+    converter over the function (the reference's ProgramTranslator AST
+    mode): supported data-dependent ``if`` statements become traceable
+    where-merges instead of tripping the traced-``__bool__`` guard.
     """
     if function is not None and isinstance(function, Layer) and layers is None:
         layer = function
+        if ast_convert:
+            # AST mode targets the layer's forward (the lambda below has
+            # no convertible source); hooks still run via __call__
+            from .dygraph.dygraph_to_static import convert_function
+            layer.forward = convert_function(layer.forward)
         return to_static(lambda *a, **kw: layer(*a, **kw), layers=[layer],
                          optimizers=optimizers, donate_state=donate_state,
                          mesh=mesh, param_rules=param_rules,
                          arg_specs=arg_specs)
 
     def deco(fn):
+        if ast_convert:
+            from .dygraph.dygraph_to_static import convert_function
+            fn = convert_function(fn)
         spec_holder = {}
 
         def get_spec():
@@ -379,3 +392,9 @@ def load(path: str) -> TranslatedLayer:
     state = {k: data[k] for k in data.files}
     return TranslatedLayer(prog, meta["feed_names"], meta["fetch_names"],
                            state)
+
+
+# AST-mode entry points (ProgramTranslator parity) — re-exported so user
+# code can write `from paddle_tpu.jit import declarative`
+from .dygraph.dygraph_to_static import (ProgramTranslator,  # noqa: E402
+                                        convert_function, declarative)

@@ -1,0 +1,338 @@
+"""dygraph_to_static AST conversion (minimal ProgramTranslator parity).
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:667 (+ ifelse_transformer.py,
+logical_transformer.py). The TPU-native converter makes data-dependent
+``if`` traceable: concrete predicates keep exact python semantics,
+traced scalar predicates become both-branch where-merges (XLA select —
+no divergent control flow), everything unsupported falls back to the
+traced-``__bool__`` guard.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit
+from paddle_tpu.dygraph import (ProgramTranslator, Tensor, declarative,
+                                to_tensor)
+from paddle_tpu.dygraph.dygraph_to_static import convert_function
+
+
+def _arr(*vals):
+    return np.array(vals, np.float32)
+
+
+# --- module-level functions (inspect.getsource needs a real file) -----
+
+@declarative
+def branchy(x, thresh):
+    if x.mean() > thresh and x.max() < 10.0:
+        y = x * 2.0
+    else:
+        y = x - 1.0
+    return y
+
+
+def three_way(x):
+    s = x.sum()
+    if s > 10.0:
+        y = x * 2.0
+    elif s > 0.0:
+        y = x * 5.0
+    else:
+        y = -x
+    return y
+
+
+def one_sided(x):
+    y = x * 1.0          # defined before the if: mergeable
+    if x.mean() > 0.0:
+        y = y + 100.0
+    return y
+
+
+def undefined_one_sided(x):
+    if x.mean() > 0.0:
+        z = x * 2.0
+    else:
+        z = x * 3.0
+        w = x * 9.0      # w undefined in the true branch and before
+    return z + w
+
+
+def early_return(x):
+    if x.mean() > 0.0:
+        return x * 2.0
+    return x - 1.0
+
+
+def diverging_python(x):
+    if x.mean() > 0.0:
+        k = 1
+    else:
+        k = 2
+    return x * k
+
+
+def loop_with_if(x):
+    acc = x * 0.0
+    for i in range(3):
+        if x.mean() > float(i):
+            acc = acc + x
+    return acc
+
+
+def not_pred(x):
+    if not (x.mean() > 0.0):
+        y = x * -1.0
+    else:
+        y = x
+    return y
+
+
+class GateModel(pt.dygraph.Layer):
+    """A model whose forward has a data-dependent if (the conversion
+    target the reference's AST transpiler exists for)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = pt.nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0.0:
+            out = h * 2.0
+        else:
+            out = h * 0.5
+        return out.sum()
+
+
+# --- concrete (eager) semantics --------------------------------------
+
+def test_eager_branching_matches_python():
+    np.testing.assert_allclose(
+        np.asarray(branchy(to_tensor(_arr(1, 2, 3)), 0.0).value),
+        _arr(2, 4, 6))
+    np.testing.assert_allclose(
+        np.asarray(branchy(to_tensor(_arr(1, 2, 3)), 99.0).value),
+        _arr(0, 1, 2))
+
+
+def test_eager_short_circuit_preserved():
+    calls = []
+
+    def right():
+        calls.append(1)
+        return True
+
+    def sc(x):
+        if x.mean() > 99.0 and right():
+            y = x * 2.0
+        else:
+            y = x
+        return y
+
+    convert_function(sc)(to_tensor(_arr(1.0)))
+    assert calls == []   # left side false -> right never evaluated
+
+
+# --- traced semantics -------------------------------------------------
+
+def test_traced_if_matches_eager_both_directions():
+    f = jit.to_static(lambda x, t: branchy(x, t))
+    np.testing.assert_allclose(
+        np.asarray(f(_arr(1, 2, 3), np.float32(0.0)).value), _arr(2, 4, 6))
+    np.testing.assert_allclose(
+        np.asarray(f(_arr(1, 2, 3), np.float32(99.0)).value),
+        _arr(0, 1, 2))
+
+
+def test_traced_elif_chain():
+    c = convert_function(three_way)
+    f = jit.to_static(c)
+    for x, want in [(_arr(6, 6), _arr(12, 12)),     # s=12 > 10
+                    (_arr(1, 2), _arr(5, 10)),      # 0 < s=3 <= 10
+                    (_arr(-1, -2), _arr(1, 2))]:    # s < 0
+        np.testing.assert_allclose(np.asarray(f(x).value), want)
+        # eager parity
+        np.testing.assert_allclose(np.asarray(c(to_tensor(x)).value),
+                                   want)
+
+
+def test_traced_one_sided_if():
+    f = jit.to_static(convert_function(one_sided))
+    np.testing.assert_allclose(np.asarray(f(_arr(1, 1)).value),
+                               _arr(101, 101))
+    np.testing.assert_allclose(np.asarray(f(_arr(-1, -1)).value),
+                               _arr(-1, -1))
+
+
+def test_traced_not_predicate():
+    f = jit.to_static(convert_function(not_pred))
+    np.testing.assert_allclose(np.asarray(f(_arr(-2.0)).value),
+                               _arr(2.0))
+    np.testing.assert_allclose(np.asarray(f(_arr(3.0)).value), _arr(3.0))
+
+
+def test_loop_unrolls_with_inner_if():
+    f = jit.to_static(convert_function(loop_with_if))
+    # mean=2.5 > 0,1,2 -> 3 adds
+    np.testing.assert_allclose(np.asarray(f(_arr(2.5)).value), _arr(7.5))
+    # mean=0.5 > 0 only -> 1 add
+    np.testing.assert_allclose(np.asarray(f(_arr(0.5)).value), _arr(0.5))
+
+
+def test_model_forward_converts_and_matches_eager():
+    pt.seed(0)
+    model = GateModel()
+    model.forward = convert_function(model.forward.__func__).__get__(model)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    eager = float(np.asarray(model(to_tensor(x)).value))
+    traced = jit.to_static(lambda a: model(a), layers=[model])
+    got = float(np.asarray(traced(x).value))
+    np.testing.assert_allclose(got, eager, rtol=1e-5)
+
+
+def test_gradient_flows_through_select():
+    def g(x, t):
+        if x.mean() > t:
+            y = x * 3.0
+        else:
+            y = x * 5.0
+        return y.sum()
+
+    gc = convert_function(g)
+    x = to_tensor(_arr(1, 2))
+    x.stop_gradient = False
+    gc(x, to_tensor(np.float32(0.0))).backward()
+    np.testing.assert_allclose(np.asarray(x.grad.value), _arr(3, 3))
+    x2 = to_tensor(_arr(1, 2))
+    x2.stop_gradient = False
+    gc(x2, to_tensor(np.float32(99.0))).backward()
+    np.testing.assert_allclose(np.asarray(x2.grad.value), _arr(5, 5))
+
+
+# --- guardrails -------------------------------------------------------
+
+def test_undefined_one_branch_var_raises_helpfully():
+    f = jit.to_static(convert_function(undefined_one_sided))
+    with pytest.raises(NameError, match="assigned in only one branch"):
+        f(_arr(1, 2))
+
+
+def test_early_return_falls_back_to_guard():
+    c = convert_function(early_return)
+    # eager still works (python branching)
+    np.testing.assert_allclose(
+        np.asarray(c(to_tensor(_arr(1.0))).value), _arr(2.0))
+    # traced: unconverted -> the existing guard raises with guidance
+    with pytest.raises(TypeError, match="traced Tensor"):
+        jit.to_static(c)(_arr(1.0))
+
+
+def test_diverging_python_values_raise():
+    f = jit.to_static(convert_function(diverging_python))
+    with pytest.raises(TypeError, match="different non-tensor values"):
+        f(_arr(1.0))
+
+
+def test_vector_predicate_raises():
+    def vec(x):
+        if x > 0.0:          # vector-shaped predicate
+            y = x * 2.0
+        else:
+            y = x
+        return y
+
+    f = jit.to_static(convert_function(vec))
+    with pytest.raises(TypeError, match="SCALAR"):
+        f(_arr(1, 2))
+
+
+def test_program_translator_disable():
+    ProgramTranslator().enable(False)
+    try:
+        # runs the ORIGINAL function: traced -> guard raises even though
+        # the decorated source is convertible
+        with pytest.raises(TypeError, match="traced Tensor"):
+            jit.to_static(lambda x: branchy(x, 0.0))(_arr(1.0))
+    finally:
+        ProgramTranslator().enable(True)
+
+
+def unbound_after_untaken(x, flag):
+    if flag:
+        found = x * 1.0
+    return found
+
+
+def comprehension_branch(x):
+    if x.mean() > 0.0:
+        y = sum([i * 1.0 for i in range(3)]) + x
+    else:
+        y = x * 2.0
+    return y
+
+
+def test_concrete_untaken_branch_raises_on_use():
+    """Python semantics for the sentinel: using a variable the taken
+    branch never bound raises at the USE site (not silently truthy)."""
+    c = convert_function(unbound_after_untaken)
+    out = c(to_tensor(_arr(1.0)), True)
+    np.testing.assert_allclose(np.asarray(out.value), _arr(1.0))
+    with pytest.raises(UnboundLocalError, match="found"):
+        _ = c(to_tensor(_arr(1.0)), False) + 1.0
+
+
+def test_comprehension_target_not_merged():
+    f = jit.to_static(convert_function(comprehension_branch))
+    np.testing.assert_allclose(np.asarray(f(_arr(1.0)).value), _arr(4.0))
+    np.testing.assert_allclose(np.asarray(f(_arr(-1.0)).value),
+                               _arr(-2.0))
+
+
+def test_bound_method_conversion():
+    pt.seed(0)
+    model = GateModel()
+    fwd = convert_function(model.forward)      # bound method directly
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    eager = float(np.asarray(fwd(to_tensor(x)).value))
+    assert np.isfinite(eager)
+
+
+def test_layer_shorthand_forwards_ast_convert():
+    pt.seed(0)
+    model = GateModel()
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    eager = float(np.asarray(model(to_tensor(x)).value))
+    fast = jit.to_static(model, ast_convert=True)
+    np.testing.assert_allclose(float(np.asarray(fast(x).value)), eager,
+                               rtol=1e-5)
+
+
+def test_ndarray_branch_values_raise_mergeable_hint():
+    def f(x):
+        if x.mean() > 0.0:
+            k = np.zeros(3, np.float32)
+        else:
+            k = np.ones(3, np.float32)
+        return x + k[0]
+
+    g = jit.to_static(convert_function(f))
+    with pytest.raises(TypeError, match="to_tensor"):
+        g(_arr(1.0))
+
+
+def test_to_static_ast_convert_flag():
+    def f(x):
+        if x.mean() > 0.0:
+            y = x * 2.0
+        else:
+            y = x * 7.0
+        return y
+
+    g = jit.to_static(f, ast_convert=True)
+    np.testing.assert_allclose(np.asarray(g(_arr(1.0)).value), _arr(2.0))
+    np.testing.assert_allclose(np.asarray(g(_arr(-1.0)).value),
+                               _arr(-7.0))
