@@ -1,0 +1,382 @@
+"""Two-stage detection proposal machinery — host-callback lowerings.
+
+Capability analog of the reference's proposal cluster:
+- generate_proposals  (operators/detection/generate_proposals_op.cc:309)
+- rpn_target_assign   (operators/detection/rpn_target_assign_op.cc:156)
+- generate_proposal_labels
+  (operators/detection/generate_proposal_labels_op.cc:63)
+
+These ops are training-time SAMPLING machinery: per-image variable
+counts, greedy NMS over decoded anchors, reservoir sampling of fg/bg
+sets. That shape-dynamism is exactly what XLA's static shapes exclude,
+so the TPU-native design runs them on the HOST via ``jax.pure_callback``
+with PADDED fixed-capacity outputs plus valid counts — the same
+padded+count contract the in-graph multiclass_nms lowering uses
+(detection_ops.py), and the repo-wide replacement for the reference's
+LoD outputs. None of them is differentiable (the reference registers no
+grad either); gradients flow through the differentiable gathers that
+consume the returned indices, which is how RPN/head losses train.
+
+Numerics follow the standard Faster R-CNN formulation the reference
+implements: box decode with delta*variance and log(1000/16) wh-clip,
+min_size filtering at the image scale, IoU-based fg/bg assignment with
+per-gt argmax promotion, fixed fg fraction sampling.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+_BBOX_CLIP = math.log(1000.0 / 16.0)
+
+
+# ---------------------------------------------------------------------------
+# numpy geometry helpers (host side)
+# ---------------------------------------------------------------------------
+
+def _decode(anchors, deltas, variances):
+    """anchors [M,4] xyxy, deltas [M,4] -> boxes [M,4] xyxy."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * w
+    cy = anchors[:, 1] + 0.5 * h
+    d = deltas * variances if variances is not None else deltas
+    pcx = d[:, 0] * w + cx
+    pcy = d[:, 1] * h + cy
+    pw = np.exp(np.minimum(d[:, 2], _BBOX_CLIP)) * w
+    ph = np.exp(np.minimum(d[:, 3], _BBOX_CLIP)) * h
+    return np.stack([pcx - 0.5 * pw, pcy - 0.5 * ph,
+                     pcx + 0.5 * pw - 1.0, pcy + 0.5 * ph - 1.0], axis=1)
+
+
+def _encode(ex, gt, weights=(1.0, 1.0, 1.0, 1.0)):
+    """Inverse of _decode: regression targets of gt w.r.t. ex boxes."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    wx, wy, ww, wh = weights
+    return np.stack([wx * (gcx - ecx) / ew, wy * (gcy - ecy) / eh,
+                     ww * np.log(gw / ew), wh * np.log(gh / eh)], axis=1)
+
+
+def _clip(boxes, im_h, im_w):
+    out = boxes.copy()
+    out[:, 0::2] = np.clip(out[:, 0::2], 0, im_w - 1)
+    out[:, 1::2] = np.clip(out[:, 1::2], 0, im_h - 1)
+    return out
+
+
+def _iou(a, b):
+    """[M,4] x [G,4] -> [M,G] IoU (legacy +1 pixel convention)."""
+    if a.size == 0 or b.size == 0:
+        return np.zeros((a.shape[0], b.shape[0]), np.float32)
+    ax = np.maximum(a[:, None, 0], b[None, :, 0])
+    ay = np.maximum(a[:, None, 1], b[None, :, 1])
+    bx = np.minimum(a[:, None, 2], b[None, :, 2])
+    by = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(bx - ax + 1.0, 0.0)
+    ih = np.maximum(by - ay + 1.0, 0.0)
+    inter = iw * ih
+    area_a = (a[:, 2] - a[:, 0] + 1.0) * (a[:, 3] - a[:, 1] + 1.0)
+    area_b = (b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0)
+    return (inter / (area_a[:, None] + area_b[None] - inter)).astype(
+        np.float32)
+
+
+def _nms_np(boxes, scores, thresh, max_keep):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size and len(keep) < max_keep:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        ious = _iou(boxes[i:i + 1], boxes[order[1:]])[0]
+        order = order[1:][ious <= thresh]
+    return np.asarray(keep, np.int64)
+
+
+def _sample(idx, want, rng):
+    """Reservoir-sampling analog: keep ``want`` of ``idx`` (all if fewer);
+    deterministic prefix when rng is None (use_random=False)."""
+    if want <= 0 or idx.size <= want:
+        return idx
+    if rng is None:
+        return idx[:want]
+    return rng.choice(idx, size=want, replace=False)
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals
+# ---------------------------------------------------------------------------
+
+def _gen_proposals_host(scores, deltas, im_info, anchors, variances,
+                        pre_n, post_n, nms_thresh, min_size):
+    n = scores.shape[0]
+    rois = np.zeros((n, post_n, 4), np.float32)
+    probs = np.zeros((n, post_n, 1), np.float32)
+    counts = np.zeros((n,), np.int32)
+    a_flat = anchors.reshape(-1, 4).astype(np.float32)
+    v_flat = (variances.reshape(-1, 4).astype(np.float32)
+              if variances is not None and variances.size else None)
+    for i in range(n):
+        # [A,H,W] score / [4A,H,W] deltas -> anchor-major flat order
+        s = np.transpose(scores[i], (1, 2, 0)).reshape(-1)
+        d = np.transpose(
+            deltas[i].reshape(-1, 4, deltas.shape[2], deltas.shape[3]),
+            (2, 3, 0, 1)).reshape(-1, 4)
+        k = min(pre_n, s.size) if pre_n > 0 else s.size
+        top = np.argsort(-s)[:k]
+        boxes = _decode(a_flat[top], d[top],
+                        v_flat[top] if v_flat is not None else None)
+        im_h, im_w, im_scale = im_info[i][:3]
+        boxes = _clip(boxes, im_h, im_w)
+        ws = (boxes[:, 2] - boxes[:, 0] + 1.0) / im_scale
+        hs = (boxes[:, 3] - boxes[:, 1] + 1.0) / im_scale
+        ms = max(min_size, 1.0)
+        keep = (ws >= ms) & (hs >= ms)
+        boxes, sc = boxes[keep], s[top][keep]
+        if boxes.shape[0]:
+            kept = _nms_np(boxes, sc, nms_thresh, post_n)
+            c = kept.size
+            rois[i, :c] = boxes[kept]
+            probs[i, :c, 0] = sc[kept]
+            counts[i] = c
+    return rois, probs, counts
+
+
+@register("generate_proposals", not_differentiable=True)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (generate_proposals_op.cc:309). Padded
+    contract: RpnRois [N, post_nms_topN, 4], RpnRoiProbs
+    [N, post_nms_topN, 1], RpnRoisNum [N] valid counts (the reference's
+    LoD offsets, redesigned as padded+lengths)."""
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    im_info = ins["ImInfo"][0]
+    anchors = ins["Anchors"][0]
+    variances = ins.get("Variances", [None])[0]
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+    n = scores.shape[0]
+
+    def cb(s, d, ii, an, va):
+        return _gen_proposals_host(
+            np.asarray(s), np.asarray(d), np.asarray(ii), np.asarray(an),
+            None if va is None else np.asarray(va),
+            pre_n, post_n, nms_thresh, min_size)
+
+    if variances is None:
+        def cb2(s, d, ii, an):
+            return cb(s, d, ii, an, None)
+        args = (scores, deltas, im_info, anchors)
+        fn = cb2
+    else:
+        args = (scores, deltas, im_info, anchors, variances)
+        fn = cb
+    rois, probs, counts = jax.pure_callback(
+        fn,
+        (jax.ShapeDtypeStruct((n, post_n, 4), jnp.float32),
+         jax.ShapeDtypeStruct((n, post_n, 1), jnp.float32),
+         jax.ShapeDtypeStruct((n,), jnp.int32)),
+        *args, vmap_method="sequential")
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs],
+            "RpnRoisNum": [counts]}
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign
+# ---------------------------------------------------------------------------
+
+def _rpn_assign_host(anchors, gt_boxes, gt_counts, im_info, batch_per_im,
+                     fg_frac, pos_thresh, neg_thresh, use_random, seed):
+    n = gt_boxes.shape[0]
+    a = anchors.reshape(-1, 4)
+    na = a.shape[0]
+    loc_idx = np.full((n, batch_per_im), -1, np.int32)
+    score_idx = np.full((n, batch_per_im), -1, np.int32)
+    labels = np.zeros((n, batch_per_im), np.int32)
+    targets = np.zeros((n, batch_per_im, 4), np.float32)
+    fg_counts = np.zeros((n,), np.int32)
+    tot_counts = np.zeros((n,), np.int32)
+    rng = np.random.RandomState(seed) if use_random else None
+    for i in range(n):
+        g = gt_boxes[i][:int(gt_counts[i])]
+        if g.shape[0] == 0:
+            continue
+        iou = _iou(a, g)                       # [A, G]
+        amax = iou.max(axis=1)
+        argmax = iou.argmax(axis=1)
+        fg_mask = amax >= pos_thresh
+        # per-gt best anchor is always fg (handles all-low-IoU gts)
+        fg_mask[iou.argmax(axis=0)] = True
+        fg = np.flatnonzero(fg_mask)
+        fg = _sample(fg, int(fg_frac * batch_per_im), rng)
+        bg = np.flatnonzero((amax < neg_thresh) & ~fg_mask)
+        bg = _sample(bg, batch_per_im - fg.size, rng)
+        nf, nb = fg.size, bg.size
+        loc_idx[i, :nf] = fg
+        score_idx[i, :nf] = fg
+        score_idx[i, nf:nf + nb] = bg
+        labels[i, :nf] = 1
+        targets[i, :nf] = _encode(a[fg], g[argmax[fg]])
+        fg_counts[i] = nf
+        tot_counts[i] = nf + nb
+    return loc_idx, score_idx, labels, targets, fg_counts, tot_counts
+
+
+@register("rpn_target_assign", not_differentiable=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """RPN anchor sampling (rpn_target_assign_op.cc:156). Per-image
+    padded contract (the reference concatenates flat LoD index lists):
+    LocationIndex/ScoreIndex [N, rpn_batch_size_per_im] anchor indices
+    (-1 padded), TargetLabel [N, B] (1 fg / 0 bg), TargetBBox [N, B, 4]
+    encoded fg regression targets, BBoxInsideWeight [N, B, 4], plus
+    FgNum/SampledNum [N] valid counts. GtBoxes comes padded [N, G, 4]
+    with GtNum [N] (LoD redesign)."""
+    anchors = ins["Anchor"][0]
+    gt = ins["GtBoxes"][0]
+    gt_num = ins.get("GtNum", [None])[0]
+    im_info = ins["ImInfo"][0]
+    n, gmax = gt.shape[0], gt.shape[1]
+    if gt_num is None:
+        gt_num = jnp.full((n,), gmax, jnp.int32)
+    b = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg = float(attrs.get("rpn_negative_overlap", 0.3))
+    use_random = bool(attrs.get("use_random", True))
+    seed = int(attrs.get("seed", 0))
+
+    def cb(a, g, gn, ii):
+        return _rpn_assign_host(np.asarray(a), np.asarray(g),
+                                np.asarray(gn), np.asarray(ii), b,
+                                fg_frac, pos, neg, use_random, seed)
+
+    loc, sc, lab, tgt, fgn, totn = jax.pure_callback(
+        cb,
+        (jax.ShapeDtypeStruct((n, b), jnp.int32),
+         jax.ShapeDtypeStruct((n, b), jnp.int32),
+         jax.ShapeDtypeStruct((n, b), jnp.int32),
+         jax.ShapeDtypeStruct((n, b, 4), jnp.float32),
+         jax.ShapeDtypeStruct((n,), jnp.int32),
+         jax.ShapeDtypeStruct((n,), jnp.int32)),
+        anchors, gt, gt_num, im_info, vmap_method="sequential")
+    inside_w = (jnp.arange(b)[None, :, None] < fgn[:, None, None]
+                ).astype(jnp.float32) * jnp.ones((1, 1, 4), jnp.float32)
+    return {"LocationIndex": [loc], "ScoreIndex": [sc],
+            "TargetLabel": [lab], "TargetBBox": [tgt],
+            "BBoxInsideWeight": [inside_w], "FgNum": [fgn],
+            "SampledNum": [totn]}
+
+
+# ---------------------------------------------------------------------------
+# generate_proposal_labels
+# ---------------------------------------------------------------------------
+
+def _proposal_labels_host(rois, rois_num, gt_classes, gt_boxes, gt_num,
+                          im_info, batch_per_im, fg_frac, fg_thresh,
+                          bg_lo, bg_hi, class_nums, use_random, seed,
+                          bbox_reg_weights):
+    n = rois.shape[0]
+    out_rois = np.zeros((n, batch_per_im, 4), np.float32)
+    out_labels = np.zeros((n, batch_per_im), np.int32)
+    out_targets = np.zeros((n, batch_per_im, 4 * class_nums), np.float32)
+    out_inside = np.zeros_like(out_targets)
+    counts = np.zeros((n,), np.int32)
+    rng = np.random.RandomState(seed) if use_random else None
+    for i in range(n):
+        r = rois[i][:int(rois_num[i])]
+        g = gt_boxes[i][:int(gt_num[i])]
+        gc = gt_classes[i][:int(gt_num[i])]
+        # gt boxes join the candidate set (generate_proposal_labels_op.cc
+        # concatenates gt to rois so every gt has a perfect candidate)
+        cand = np.concatenate([r, g], axis=0) if g.size else r
+        if cand.shape[0] == 0:
+            continue
+        iou = _iou(cand, g)
+        cmax = iou.max(axis=1) if g.size else np.zeros(cand.shape[0])
+        cargmax = iou.argmax(axis=1) if g.size else np.zeros(
+            cand.shape[0], np.int64)
+        fg = np.flatnonzero(cmax >= fg_thresh)
+        fg = _sample(fg, int(fg_frac * batch_per_im), rng)
+        bg = np.flatnonzero((cmax < bg_hi) & (cmax >= bg_lo))
+        bg = _sample(bg, batch_per_im - fg.size, rng)
+        sel = np.concatenate([fg, bg])
+        c = sel.size
+        out_rois[i, :c] = cand[sel]
+        lab = np.zeros((c,), np.int32)
+        lab[:fg.size] = gc[cargmax[fg]].astype(np.int32)
+        out_labels[i, :c] = lab
+        if fg.size:
+            t = _encode(cand[fg], g[cargmax[fg]], bbox_reg_weights)
+            for j, cls in enumerate(lab[:fg.size]):
+                out_targets[i, j, 4 * cls:4 * cls + 4] = t[j]
+                out_inside[i, j, 4 * cls:4 * cls + 4] = 1.0
+        counts[i] = c
+    return out_rois, out_labels, out_targets, out_inside, counts
+
+
+@register("generate_proposal_labels", not_differentiable=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """RoI sampling for the box head
+    (generate_proposal_labels_op.cc:63). Padded contract: Rois
+    [N, batch_size_per_im, 4], LabelsInt32 [N, B], BboxTargets
+    [N, B, 4*class_nums] with inside/outside weights, RoisNum [N].
+    RpnRois comes padded [N, R, 4] + RpnRoisNum (the generate_proposals
+    output contract feeds straight in)."""
+    rois = ins["RpnRois"][0]
+    rois_num = ins.get("RpnRoisNum", [None])[0]
+    gt_classes = ins["GtClasses"][0]
+    gt_boxes = ins["GtBoxes"][0]
+    gt_num = ins.get("GtNum", [None])[0]
+    im_info = ins["ImInfo"][0]
+    n, rmax = rois.shape[0], rois.shape[1]
+    gmax = gt_boxes.shape[1]
+    if rois_num is None:
+        rois_num = jnp.full((n,), rmax, jnp.int32)
+    if gt_num is None:
+        gt_num = jnp.full((n,), gmax, jnp.int32)
+    b = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    seed = int(attrs.get("seed", 0))
+    w = tuple(attrs.get("bbox_reg_weights", (0.1, 0.1, 0.2, 0.2)))
+    # reference weights DIVIDE the targets; _encode multiplies, so invert
+    w = tuple(1.0 / x for x in w)
+
+    def cb(r, rn, gc, g, gn, ii):
+        return _proposal_labels_host(
+            np.asarray(r), np.asarray(rn), np.asarray(gc), np.asarray(g),
+            np.asarray(gn), np.asarray(ii), b, fg_frac, fg_thresh, bg_lo,
+            bg_hi, class_nums, use_random, seed, w)
+
+    out_rois, labels, targets, inside, counts = jax.pure_callback(
+        cb,
+        (jax.ShapeDtypeStruct((n, b, 4), jnp.float32),
+         jax.ShapeDtypeStruct((n, b), jnp.int32),
+         jax.ShapeDtypeStruct((n, b, 4 * class_nums), jnp.float32),
+         jax.ShapeDtypeStruct((n, b, 4 * class_nums), jnp.float32),
+         jax.ShapeDtypeStruct((n,), jnp.int32)),
+        rois, rois_num, gt_classes, gt_boxes, gt_num, im_info,
+        vmap_method="sequential")
+    return {"Rois": [out_rois], "LabelsInt32": [labels],
+            "BboxTargets": [targets], "BboxInsideWeights": [inside],
+            "BboxOutsideWeights": [inside], "RoisNum": [counts]}
