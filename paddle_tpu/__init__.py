@@ -108,6 +108,10 @@ dataset_compat = _compat_dataset
 from . import dataset as _ds_mod  # noqa: E402
 _ds_mod.uci_housing = _compat_dataset.uci_housing
 _ds_mod.mnist = _compat_dataset.mnist
+_ds_mod.imikolov = _compat_dataset.imikolov
+_ds_mod.cifar = _compat_dataset.cifar
+_ds_mod.conll05 = _compat_dataset.conll05
+_ds_mod.movielens = _compat_dataset.movielens
 
 
 def __getattr__(name):

@@ -212,9 +212,181 @@ def _make_mnist():
     return mod
 
 
+def _make_imikolov():
+    """PTB n-gram fixture: a 3rd-order markov chain over a small vocab,
+    so the (N-1)-gram genuinely predicts the next word (the book's
+    word2vec loss can then actually fall)."""
+    mod = _types.ModuleType("paddle_tpu.dataset.imikolov")
+    VOCAB = 200
+
+    def build_dict(min_word_freq=50):
+        return {f"w{i}": i for i in range(VOCAB)}
+
+    def _stream(n, count, seed):
+        # deterministic successor table: next depends on prev word
+        succ = np.random.RandomState(3).randint(0, VOCAB, (VOCAB, 4))
+
+        def r():
+            # reseed per invocation: readers must replay identically on
+            # every pass (the classic paddle reader contract)
+            rng = np.random.RandomState(seed)
+            w = list(rng.randint(0, VOCAB, n - 1))
+            for _ in range(count):
+                nxt = int(succ[w[-1], rng.randint(0, 4)])
+                yield tuple(w[-(n - 1):]) + (nxt,)
+                w.append(nxt)
+        return r
+
+    def train(word_dict, n):
+        return _stream(n, 2000, seed=0)
+
+    def test(word_dict, n):
+        return _stream(n, 200, seed=1)
+
+    mod.build_dict = build_dict
+    mod.train = train
+    mod.test = test
+    return mod
+
+
+def _make_cifar():
+    """cifar.train10 fixture: class-separable 3x32x32 blobs."""
+    mod = _types.ModuleType("paddle_tpu.dataset.cifar")
+
+    def _rows(n, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 10))
+            img = rng.rand(3 * 32 * 32).astype(np.float32) * 0.2
+            img[label * 300:(label + 1) * 300] += 0.8
+            yield img, label
+
+    def train10():
+        def r():
+            yield from _rows(1000, seed=0)
+        return r
+
+    def test10():
+        def r():
+            yield from _rows(200, seed=1)
+        return r
+
+    mod.train10 = train10
+    mod.test10 = test10
+    return mod
+
+
+_CONLL_WORD, _CONLL_PRED, _CONLL_LABEL, _CONLL_MAXLEN = 120, 20, 17, 12
+
+
+def _make_conll05():
+    """conll05 SRL fixture over the padded+lengths design: each sample is
+    8 padded int64 sequences (word, ctx_n2..ctx_p2, predicate-id
+    broadcast, mark) + the label sequence + the true length. Labels are
+    a deterministic function of (word, mark) so the tagger is learnable."""
+    mod = _types.ModuleType("paddle_tpu.dataset.conll05")
+
+    def get_dict():
+        w = {f"w{i}": i for i in range(_CONLL_WORD)}
+        v = {f"v{i}": i for i in range(_CONLL_PRED)}
+        l = {f"l{i}": i for i in range(_CONLL_LABEL)}
+        return w, v, l
+
+    def get_embedding():
+        return None     # the book loads pretrained vectors; fixture skips
+
+    def _rows(n, seed):
+        rng = np.random.RandomState(seed)
+        lab_map = np.random.RandomState(5).randint(
+            1, _CONLL_LABEL, (_CONLL_WORD, 2))
+        for _ in range(n):
+            ln = int(rng.randint(4, _CONLL_MAXLEN + 1))
+            words = rng.randint(0, _CONLL_WORD, _CONLL_MAXLEN)
+            words[ln:] = 0
+            pred = int(rng.randint(0, _CONLL_PRED))
+            mark_pos = int(rng.randint(0, ln))
+            mark = np.zeros(_CONLL_MAXLEN, np.int64)
+            mark[mark_pos] = 1
+            labels = lab_map[words, mark].astype(np.int64)
+            labels[ln:] = 0
+            ctx = [np.roll(words, k) for k in (2, 1, 0, -1, -2)]
+            yield (words.astype(np.int64), *[c.astype(np.int64)
+                                             for c in ctx],
+                   np.full(_CONLL_MAXLEN, pred, np.int64), mark,
+                   labels, np.int64(ln))
+
+    def test():
+        def r():
+            yield from _rows(300, seed=0)
+        return r
+
+    mod.get_dict = get_dict
+    mod.get_embedding = get_embedding
+    mod.test = test
+    return mod
+
+
+def _make_movielens():
+    """movielens fixture: (user_id, gender, age, job, movie_id,
+    category_seq[4], title_seq[4], score) with a planted low-rank
+    structure so the regression converges."""
+    mod = _types.ModuleType("paddle_tpu.dataset.movielens")
+    USERS, MOVIES, CATS, TITLES, JOBS = 100, 80, 10, 50, 8
+
+    def max_user_id():
+        return USERS
+
+    def max_movie_id():
+        return MOVIES
+
+    def max_job_id():
+        return JOBS - 1
+
+    def _rows(n, seed):
+        rng = np.random.RandomState(seed)
+        u_lat = np.random.RandomState(11).randn(USERS)
+        m_lat = np.random.RandomState(12).randn(MOVIES)
+        for _ in range(n):
+            u = int(rng.randint(1, USERS))
+            m = int(rng.randint(1, MOVIES))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, 7))
+            job = int(rng.randint(0, JOBS))
+            cats = rng.randint(0, CATS, 4).astype(np.int64)
+            title = rng.randint(0, TITLES, 4).astype(np.int64)
+            score = np.float32(
+                3.0 + 1.5 * np.tanh(u_lat[u] * m_lat[m]))
+            yield (np.int64(u), np.int64(gender), np.int64(age),
+                   np.int64(job), np.int64(m), cats, title, score)
+
+    def train():
+        def r():
+            yield from _rows(800, seed=0)
+        return r
+
+    def test():
+        def r():
+            yield from _rows(100, seed=1)
+        return r
+
+    mod.max_user_id = max_user_id
+    mod.max_movie_id = max_movie_id
+    mod.max_job_id = max_job_id
+    mod.age_table = [1, 18, 25, 35, 45, 50, 56]
+    mod.movie_categories = lambda: [f"c{i}" for i in range(CATS)]
+    mod.get_movie_title_dict = lambda: {f"t{i}": i for i in range(TITLES)}
+    mod.train = train
+    mod.test = test
+    return mod
+
+
 dataset = _types.ModuleType("paddle_tpu.dataset_compat")
 dataset.uci_housing = _make_uci_housing()
 dataset.mnist = _make_mnist()
+dataset.imikolov = _make_imikolov()
+dataset.cifar = _make_cifar()
+dataset.conll05 = _make_conll05()
+dataset.movielens = _make_movielens()
 
 
 def build_fluid_module():
@@ -273,6 +445,53 @@ def build_fluid_module():
                                  pool_padding=pool_padding)
 
     nets.simple_img_conv_pool = simple_img_conv_pool
+
+    def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                       conv_filter_size=3, conv_act=None,
+                       param_attr=None, conv_with_batchnorm=False,
+                       conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                       pool_type="max", use_cudnn=True):
+        """fluid.nets.img_conv_group parity (nets.py:104): a VGG-style
+        conv stack (+optional BN/dropout per conv) followed by a pool."""
+        n = len(conv_num_filter)
+
+        def expand(v):
+            return v if isinstance(v, (list, tuple)) else [v] * n
+
+        pads = expand(conv_padding)
+        ksizes = expand(conv_filter_size)
+        bns = expand(conv_with_batchnorm)
+        drops = expand(conv_batchnorm_drop_rate)
+        tmp = input
+        for i in range(n):
+            act = conv_act if not bns[i] else None
+            tmp = _pt.layers.conv2d(tmp, num_filters=conv_num_filter[i],
+                                    filter_size=ksizes[i],
+                                    padding=pads[i], act=act,
+                                    param_attr=param_attr)
+            if bns[i]:
+                tmp = _pt.layers.batch_norm(tmp, act=conv_act)
+                if drops[i] > 0:
+                    tmp = _pt.layers.dropout(tmp,
+                                             dropout_prob=drops[i])
+        return _pt.layers.pool2d(tmp, pool_size=pool_size,
+                                 pool_type=pool_type,
+                                 pool_stride=pool_stride)
+
+    def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
+                           pool_type="max", sequence_length=None,
+                           param_attr=None, bias_attr=None):
+        """fluid.nets.sequence_conv_pool parity (nets.py:193) over the
+        padded+lengths sequence design."""
+        conv = _pt.layers.sequence_conv(
+            input, num_filters=num_filters, filter_size=filter_size,
+            sequence_length=sequence_length, param_attr=param_attr,
+            bias_attr=bias_attr, act=act)
+        return _pt.layers.sequence_pool(conv, pool_type,
+                                        sequence_length)
+
+    nets.img_conv_group = img_conv_group
+    nets.sequence_conv_pool = sequence_conv_pool
     fluid.nets = nets
     fluid.core = _types.ModuleType("paddle_tpu.fluid.core")
     fluid.core.CPUPlace = CPUPlace

@@ -17,8 +17,10 @@ from .control_flow import (While, case, cond, equal, greater_equal,
                            while_loop)
 from .nn_extra import (add_position_encoding, affine_channel, affine_grid,
                        bilinear_tensor_product, bpr_loss, center_loss,
-                       continuous_value_model, cos_sim, crop_tensor,
+                       continuous_value_model, cos_sim, crf_decoding,
+                       crop_tensor,
                        ctc_greedy_decoder, data_norm, edit_distance,
+                       exponential_decay, fill_constant_batch_size_like,
                        gather_tree, grid_sampler, hinge_loss, hsigmoid,
                        huber_loss, image_resize, index_sample,
                        linear_chain_crf, log_loss, lrn, margin_rank_loss,
@@ -27,10 +29,11 @@ from .nn_extra import (add_position_encoding, affine_channel, affine_grid,
                        resize_bilinear, resize_linear, resize_nearest,
                        resize_trilinear, reverse, row_conv, sampling_id,
                        scatter_nd_add, selu, shuffle_channel,
-                       space_to_depth, spectral_norm, teacher_student_sigmoid_loss,
+                       space_to_depth, spectral_norm, sums,
+                       teacher_student_sigmoid_loss,
                        temporal_shift, unfold, warpctc)
 from . import detection
-from .sequence_lod import (sequence_concat, sequence_conv,
+from .sequence_lod import (dynamic_lstm, sequence_concat, sequence_conv,
                            sequence_enumerate, sequence_expand,
                            sequence_expand_as, sequence_first_step,
                            sequence_last_step, sequence_mask, sequence_pad,

@@ -32,18 +32,50 @@ def data(name: str, shape: Sequence[int], dtype="float32",
 def fc(input: Variable, size: int, num_flatten_dims: int = 1,
        param_attr=None, bias_attr=None, act: Optional[str] = None,
        name: Optional[str] = None) -> Variable:
-    """Fully connected (reference layers/nn.py fc -> mul+elementwise_add)."""
+    """Fully connected (reference layers/nn.py fc -> mul+elementwise_add).
+
+    Like the reference, ``input`` may be a LIST of variables: each gets
+    its own weight and the projections are summed before bias/act (the
+    book programs' multi-feature mixing idiom)."""
     helper = LayerHelper("fc", name=name)
-    in_shape = input.shape
-    in_features = int(np.prod(in_shape[num_flatten_dims:]))
-    w = helper.create_parameter(param_attr, shape=[in_features, size],
-                                dtype=input.dtype)
-    out = helper.create_variable_for_type_inference(input.dtype)
-    out.shape = tuple(in_shape[:num_flatten_dims]) + (size,)
-    helper.append_op("mul", inputs={"X": input, "Y": w},
-                     outputs={"Out": out},
-                     attrs={"x_num_col_dims": num_flatten_dims,
-                            "y_num_col_dims": 1})
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    # per-input param_attr list (reference fc semantics); a single NAMED
+    # attr across several inputs would silently share/mismatch weights
+    if isinstance(param_attr, (list, tuple)):
+        if len(param_attr) != len(inputs):
+            raise ValueError(
+                f"fc got {len(inputs)} inputs but {len(param_attr)} "
+                "param_attrs")
+        attrs_per_input = list(param_attr)
+    else:
+        if len(inputs) > 1 and param_attr is not None and \
+                getattr(ParamAttr._to_attr(param_attr), "name", None):
+            raise ValueError(
+                "fc with multiple inputs needs a param_attr LIST (one "
+                "per input); a single named attr would share one weight "
+                "across different-shaped projections")
+        attrs_per_input = [param_attr] * len(inputs)
+    projected = []
+    for x, p_attr in zip(inputs, attrs_per_input):
+        in_shape = x.shape
+        in_features = int(np.prod(in_shape[num_flatten_dims:]))
+        w = helper.create_parameter(p_attr,
+                                    shape=[in_features, size],
+                                    dtype=x.dtype)
+        proj = helper.create_variable_for_type_inference(x.dtype)
+        proj.shape = tuple(in_shape[:num_flatten_dims]) + (size,)
+        helper.append_op("mul", inputs={"X": x, "Y": w},
+                         outputs={"Out": proj},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        projected.append(proj)
+    if len(projected) == 1:
+        out = projected[0]
+    else:
+        out = helper.create_variable_for_type_inference(inputs[0].dtype)
+        out.shape = projected[0].shape
+        helper.append_op("sum", inputs={"X": projected},
+                         outputs={"Out": out}, attrs={})
     out = helper.append_bias_op(out, bias_attr if bias_attr is not None else ParamAttr())
     return helper.append_activation(out, act)
 

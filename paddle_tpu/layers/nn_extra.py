@@ -348,6 +348,73 @@ def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
     return ll
 
 
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """fluid.layers.exponential_decay parity
+    (layers/learning_rate_scheduler.py:94): builds the decay INTO the
+    program — a persistable step counter auto-incremented every run
+    feeds ``lr * decay_rate^(step/decay_steps)`` — and returns the lr
+    VARIABLE, which the optimizers accept as learning_rate (static-mode
+    only, like the reference's layers scheduler)."""
+    from .tensor import create_global_var, increment
+    from ..framework import unique_name
+
+    counter = create_global_var(
+        shape=[1], value=0.0, dtype="float32", persistable=True,
+        name=unique_name.generate("lr_decay_step"))
+    increment(counter, value=1.0)
+    div = _one_out("scale", {"X": counter},
+                   {"scale": 1.0 / float(decay_steps), "bias": 0.0})
+    if staircase:
+        div = _one_out("floor", {"X": div})
+    base = _one_out("fill_constant_batch_size_like", {"Input": div},
+                    {"shape": [1], "dtype": "float32",
+                     "value": float(decay_rate)})
+    factor = _one_out("elementwise_pow", {"X": base, "Y": div})
+    lr = _one_out("scale", {"X": factor},
+                  {"scale": float(learning_rate), "bias": 0.0})
+    lr.shape = (1,)
+    return lr
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    """fluid.layers.crf_decoding parity (crf_decoding_op.h): Viterbi
+    decode with the linear_chain_crf Transition variable. Without Label
+    returns the best path [b, t] (0 past each length); with Label
+    returns the 0/1 per-position correctness mask the reference emits."""
+    inputs = {"Emission": input, "Transition": param_attr}
+    if label is not None:
+        inputs["Label"] = label
+    if length is not None:
+        inputs["Length"] = length
+    return _one_out("crf_decoding", inputs, out_slot="ViterbiPath",
+                    name=name, dtype="int64")
+
+
+def sums(input, out=None):  # noqa: A002
+    """fluid.layers.sums parity: elementwise sum of a list of vars."""
+    res = _one_out("sum", {"X": list(input)})
+    res.shape = next((tuple(v.shape) for v in input
+                      if getattr(v, "shape", None) is not None), None)
+    if out is not None:
+        helper = LayerHelper("sums_assign")
+        helper.append_op("assign", {"X": [res]}, {"Out": [out]}, {})
+        return out
+    return res
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,  # noqa: A002
+                                  input_dim_idx=0, output_dim_idx=0):
+    """fluid.layers.fill_constant_batch_size_like parity."""
+    out = _one_out("fill_constant_batch_size_like", {"Input": input},
+                   {"shape": list(shape), "dtype": dtype,
+                    "value": float(value),
+                    "input_dim_idx": int(input_dim_idx),
+                    "output_dim_idx": int(output_dim_idx)}, dtype=dtype)
+    out.shape = tuple(shape)
+    return out
+
+
 def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
             label_length=None):
     inputs = {"Logits": input, "Label": label}

@@ -116,6 +116,40 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A
     return helper.append_activation(out, act)
 
 
+def dynamic_lstm(input, size, sequence_length=None, use_peepholes=True,  # noqa: A002
+                 is_reverse=False, param_attr=None, bias_attr=None,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """fluid.layers.dynamic_lstm parity (layers/nn.py dynamic_lstm; op
+    lstm_op.cc): classic LSTM over a PRE-PROJECTED input [b, s, 4h]
+    (``size`` = 4h, the caller's fc supplies x·W). Padded+lengths
+    redesign: pass ``sequence_length`` instead of LoD. Returns
+    (hidden, cell), both [b, s, h]."""
+    helper = LayerHelper("dynamic_lstm", param_attr=param_attr, name=name)
+    h = int(size) // 4
+    w = helper.create_parameter(param_attr, [h, 4 * h], dtype=dtype)
+    bias_size = 7 * h if use_peepholes else 4 * h
+    b = helper.create_parameter(bias_attr, [1, bias_size], dtype=dtype,
+                                is_bias=True)
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if sequence_length is not None:
+        inputs["Length"] = [sequence_length]
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    shp = _shape_of(input)
+    if shp:
+        hidden.shape = cell.shape = shp[:2] + [h]
+    helper.append_op("dynamic_lstm", inputs=inputs,
+                     outputs={"Hidden": [hidden], "Cell": [cell]},
+                     attrs={"use_peepholes": bool(use_peepholes),
+                            "is_reverse": bool(is_reverse),
+                            "gate_activation": str(gate_activation),
+                            "cell_activation": str(cell_activation),
+                            "candidate_activation":
+                                str(candidate_activation)})
+    return hidden, cell
+
+
 def sequence_slice(input, offset, length):  # noqa: A002
     """Per-row [offset, offset+length) slice, front-aligned and
     zero-padded (layers.sequence_slice)."""

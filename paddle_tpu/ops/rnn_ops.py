@@ -132,6 +132,83 @@ def _rnn(ctx, ins, attrs):
     return {"Out": [layer_in], "State": state}
 
 
+@register("dynamic_lstm", no_grad_slots=("Length",))
+def _dynamic_lstm(ctx, ins, attrs):
+    """reference lstm_op.cc + math/detail/lstm_kernel.h:30-51 — the
+    classic fluid LSTM over a PRE-PROJECTED input. Padded redesign:
+    Input [b, s, 4h] (caller's fc supplies x·W_x), Weight [h, 4h]
+    recurrent, Bias [1, 4h] (or [1, 7h] with use_peepholes: cols 4h:7h
+    are checkI/checkF/checkO), Length [b]. Gate layout follows the
+    reference kernel order [candidate, input, forget, output]. Outputs
+    Hidden/Cell [b, s, h] with zeros past each row's length;
+    is_reverse runs the recurrence over each row's reversed valid
+    prefix (masked-prefix reverse, like sequence_reverse)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1)
+    lengths = ins["Length"][0].reshape(-1).astype(jnp.int32) \
+        if ins.get("Length") else None
+    use_peepholes = bool(attrs.get("use_peepholes", True))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    act_gate = acts[attrs.get("gate_activation", "sigmoid")]
+    act_cell = acts[attrs.get("cell_activation", "tanh")]
+    act_cand = acts[attrs.get("candidate_activation", "tanh")]
+    b, s, four_h = x.shape
+    h = four_h // 4
+    b4 = bias[:4 * h]
+    if use_peepholes:
+        w_ic, w_fc, w_oc = (bias[4 * h:5 * h], bias[5 * h:6 * h],
+                            bias[6 * h:7 * h])
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+
+    if is_reverse:
+        # reverse each row's VALID prefix (padding stays in place)
+        t = jnp.arange(s)[None, :]
+        src = jnp.where(t < lengths[:, None],
+                        lengths[:, None] - 1 - t, t)
+        x = jnp.take_along_axis(x, src[:, :, None], axis=1)
+
+    xs = jnp.swapaxes(x, 0, 1)                  # [s, b, 4h]
+    steps = jnp.arange(s)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, t = inp
+        gates = xt + h_prev @ w + b4
+        g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            g_i = g_i + c_prev * w_ic
+            g_f = g_f + c_prev * w_fc
+        i = act_gate(g_i)
+        f = act_gate(g_f)
+        c_new = act_cand(g_c) * i + c_prev * f
+        if use_peepholes:
+            g_o = g_o + c_new * w_oc
+        o = act_gate(g_o)
+        h_new = o * act_cell(c_new)
+        live = (t < lengths)[:, None]
+        h_keep = jnp.where(live, h_new, h_prev)
+        c_keep = jnp.where(live, c_new, c_prev)
+        zero = jnp.zeros_like(h_new)
+        return (h_keep, c_keep), (jnp.where(live, h_new, zero),
+                                  jnp.where(live, c_new, zero))
+
+    init = (jnp.zeros((b, h), x.dtype), jnp.zeros((b, h), x.dtype))
+    _, (hs, cs) = jax.lax.scan(step, init, (xs, steps))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        t = jnp.arange(s)[None, :]
+        src = jnp.where(t < lengths[:, None],
+                        lengths[:, None] - 1 - t, t)
+        hs = jnp.take_along_axis(hs, src[:, :, None], axis=1)
+        cs = jnp.take_along_axis(cs, src[:, :, None], axis=1)
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
 # ------------------------------------------------------- sequence ops
 # Padded+lengths redesign of operators/sequence_ops/ (LoD-free).
 

@@ -351,6 +351,62 @@ def _linear_chain_crf(ctx, ins, attrs):
             "TransitionExps": [jnp.exp(transition)]}
 
 
+@register("crf_decoding", not_differentiable=True)
+def _crf_decoding(ctx, ins, attrs):
+    """reference crf_decoding_op.h: Viterbi decode under the
+    linear_chain_crf Transition convention (row 0 start, row 1 end,
+    rows 2: the K x K transitions). Emission (B, T, K), Length (B,) ->
+    ViterbiPath (B, T) int64, zero past each row's length. When Label
+    is supplied the reference emits a 0/1 correctness mask instead —
+    same here."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    b, t, k = emission.shape
+    length = ins.get("Length", [None])[0]
+    length = (jnp.full((b,), t, jnp.int32) if length is None
+              else length.reshape(-1).astype(jnp.int32))
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+
+    em_t = jnp.swapaxes(emission, 0, 1)            # (T, B, K)
+    a0 = start_w[None, :] + em_t[0]
+    tidx = jnp.arange(1, t)
+
+    def step(alpha, inp):
+        em, ti = inp
+        scores = alpha[:, :, None] + trans[None]   # (B, K_prev, K)
+        best_prev = jnp.argmax(scores, axis=1)
+        new = jnp.max(scores, axis=1) + em
+        live = (ti < length)[:, None]
+        new = jnp.where(live, new, alpha)
+        # finished rows back-point to themselves (identity)
+        best_prev = jnp.where(live, best_prev,
+                              jnp.arange(k)[None, :])
+        return new, best_prev
+
+    alpha_last, backptrs = jax.lax.scan(step, a0, (em_t[1:], tidx))
+    last = jnp.argmax(alpha_last + end_w[None, :], axis=1)  # (B,)
+
+    def backtrack(carry, bp):
+        cur = carry
+        prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    _, path_rev = jax.lax.scan(backtrack, last, backptrs[::-1])
+    path = jnp.concatenate([path_rev[::-1],
+                            last[None, :]], axis=0)     # (T, B)
+    path = jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+    # zero out positions past each row's length; ALSO re-anchor: for
+    # rows shorter than T the argmax above is the state at step len-1
+    # because the scan froze alpha there
+    valid = jnp.arange(t)[None, :] < length[:, None]
+    path = jnp.where(valid, path, 0)
+    label = ins.get("Label", [None])[0]
+    if label is not None:
+        correct = (path == label.reshape(b, t).astype(jnp.int64))
+        path = jnp.where(valid, correct.astype(jnp.int64), 0)
+    return {"ViterbiPath": [path]}
+
+
 # ---------------------------------------------------------------------------
 # conv transpose variants + deformable conv
 # ---------------------------------------------------------------------------

@@ -1,11 +1,18 @@
-"""Book-style end-to-end tests — transcriptions of the reference's
-python/paddle/fluid/tests/book/{test_fit_a_line.py,
-test_recognize_digits.py} train+infer bodies, changed ONLY in the import
-lines (paddle -> paddle_tpu), the removed distributed else-branch, and
-reduced pass counts. Everything else — the fluid.layers program builders,
-optimizer.minimize, DataFeeder, reader pipeline, save/load_inference_model
-round trip — runs through the compatibility surface exactly as written in
-2018-era fluid."""
+"""Book-style end-to-end tests — transcriptions of SIX of the
+reference's python/paddle/fluid/tests/book/ programs (test_fit_a_line,
+test_recognize_digits, test_word2vec, test_image_classification,
+test_label_semantic_roles, test_recommender_system) train+infer bodies.
+Changes from the originals: import lines (paddle -> paddle_tpu), removed
+distributed else-branches, reduced pass counts / layer sizes for the CPU
+suite, and — for the LoD-sequence programs — the padded+lengths
+adaptation (each lod_level=1 feed becomes a padded [b, maxlen] array
+plus an explicit sequence-length feed, the repo-wide LoD redesign).
+Everything else — the fluid.layers program builders, optimizer.minimize,
+DataFeeder, reader pipeline, save/load_inference_model round trip — runs
+through the compatibility surface as written in 2018-era fluid.
+Remaining book programs (test_machine_translation,
+test_rnn_encoder_decoder) need the DynamicRNN block + beam-search
+decoder, which stay out of scope this round."""
 
 import math
 import sys
@@ -216,3 +223,455 @@ def test_book_recognize_digits_conv(tmp_path):
     d = str(tmp_path / "recognize_digits_conv.inference.model")
     recognize_digits_train('conv', d)
     recognize_digits_infer(d)
+
+
+# ---------------------------------------------------------------------
+# test_word2vec.py transcription (N-gram LM, shared embedding table)
+# ---------------------------------------------------------------------
+
+
+def test_book_word2vec(tmp_path):
+    from paddle_tpu.framework import Program, program_guard, unique_name
+
+    PASS_NUM = 30
+    EMBED_SIZE = 32
+    HIDDEN_SIZE = 256
+    N = 5
+    BATCH_SIZE = 32
+    IS_SPARSE = True
+    save_dirname = str(tmp_path / "word2vec.inference.model")
+
+    with program_guard(Program(), Program()), unique_name.guard():
+        word_dict = paddle.dataset.imikolov.build_dict()
+        dict_size = len(word_dict)
+
+        first_word = fluid.layers.data(name='firstw', shape=[1],
+                                       dtype='int64')
+        second_word = fluid.layers.data(name='secondw', shape=[1],
+                                        dtype='int64')
+        third_word = fluid.layers.data(name='thirdw', shape=[1],
+                                       dtype='int64')
+        forth_word = fluid.layers.data(name='forthw', shape=[1],
+                                       dtype='int64')
+        next_word = fluid.layers.data(name='nextw', shape=[1],
+                                      dtype='int64')
+
+        def emb(w):
+            return fluid.layers.embedding(
+                input=w, size=[dict_size, EMBED_SIZE], dtype='float32',
+                is_sparse=IS_SPARSE, param_attr='shared_w')
+
+        concat_embed = fluid.layers.concat(
+            input=[emb(first_word), emb(second_word), emb(third_word),
+                   emb(forth_word)], axis=1)
+        hidden1 = fluid.layers.fc(input=concat_embed, size=HIDDEN_SIZE,
+                                  act='sigmoid')
+        predict_word = fluid.layers.fc(input=hidden1, size=dict_size,
+                                       act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict_word,
+                                          label=next_word)
+        avg_cost = fluid.layers.mean(cost)
+
+        sgd_optimizer = fluid.optimizer.SGD(learning_rate=0.01)
+        sgd_optimizer.minimize(avg_cost)
+
+        train_reader = paddle.batch(
+            paddle.dataset.imikolov.train(word_dict, N), BATCH_SIZE)
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        feeder = fluid.DataFeeder(
+            feed_list=[first_word, second_word, third_word, forth_word,
+                       next_word], place=place)
+        exe.run(fluid.default_startup_program())
+
+        for pass_id in range(PASS_NUM):
+            for data in train_reader():
+                avg_cost_np = exe.run(fluid.default_main_program(),
+                                      feed=feeder.feed(data),
+                                      fetch_list=[avg_cost])
+                if avg_cost_np[0] < 5.0:
+                    fluid.io.save_inference_model(
+                        save_dirname,
+                        ['firstw', 'secondw', 'thirdw', 'forthw'],
+                        [predict_word], exe)
+                    # infer leg (the book's infer() body)
+                    [prog, feeds, fetches] = fluid.io.load_inference_model(
+                        save_dirname, exe)
+                    lod = numpy.array([[1]], dtype='int64')
+                    results = exe.run(
+                        prog,
+                        feed={feeds[0]: lod, feeds[1]: lod,
+                              feeds[2]: lod, feeds[3]: lod},
+                        fetch_list=fetches)
+                    assert results[0].shape == (1, dict_size)
+                    return
+                if math.isnan(float(avg_cost_np[0])):
+                    sys.exit("got NaN loss, training failed.")
+        raise AssertionError(
+            "Cost is too large {0:2.2}".format(float(avg_cost_np[0])))
+
+
+# ---------------------------------------------------------------------
+# test_image_classification.py transcription (resnet_cifar10; depth 8
+# instead of 32 to keep the CPU-mesh suite fast)
+# ---------------------------------------------------------------------
+
+
+def resnet_cifar10(input, depth=8):
+    def conv_bn_layer(input, ch_out, filter_size, stride, padding,
+                      act='relu', bias_attr=False):
+        tmp = fluid.layers.conv2d(input=input, filter_size=filter_size,
+                                  num_filters=ch_out, stride=stride,
+                                  padding=padding, act=None,
+                                  bias_attr=bias_attr)
+        return fluid.layers.batch_norm(input=tmp, act=act)
+
+    def shortcut(input, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return input
+
+    def basicblock(input, ch_in, ch_out, stride):
+        tmp = conv_bn_layer(input, ch_out, 3, stride, 1)
+        tmp = conv_bn_layer(tmp, ch_out, 3, 1, 1, act=None,
+                            bias_attr=True)
+        short = shortcut(input, ch_in, ch_out, stride)
+        return fluid.layers.elementwise_add(x=tmp, y=short, act='relu')
+
+    def layer_warp(block_func, input, ch_in, ch_out, count, stride):
+        tmp = block_func(input, ch_in, ch_out, stride)
+        for i in range(1, count):
+            tmp = block_func(tmp, ch_out, ch_out, 1)
+        return tmp
+
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input=input, ch_out=16, filter_size=3,
+                          stride=1, padding=1)
+    res1 = layer_warp(basicblock, conv1, 16, 16, n, 1)
+    res2 = layer_warp(basicblock, res1, 16, 32, n, 2)
+    res3 = layer_warp(basicblock, res2, 32, 64, n, 2)
+    pool = fluid.layers.pool2d(input=res3, pool_size=8, pool_type='avg',
+                               pool_stride=1)
+    return pool
+
+
+def test_book_image_classification(tmp_path):
+    from paddle_tpu.framework import Program, program_guard, unique_name
+
+    BATCH = 32
+    save_dirname = str(tmp_path / "image_classification.inference.model")
+    with program_guard(Program(), Program()), unique_name.guard():
+        images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                                   dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+
+        net = resnet_cifar10(images, 8)
+        predict = fluid.layers.fc(input=net, size=10, act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=predict, label=label)
+
+        test_program = fluid.default_main_program().clone(for_test=True)
+        optimizer = fluid.optimizer.Adam(learning_rate=0.001)
+        optimizer.minimize(avg_cost)
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        train_reader = paddle.batch(
+            paddle.reader.shuffle(paddle.dataset.cifar.train10(),
+                                  buf_size=512),
+            batch_size=BATCH, drop_last=True)
+        test_reader = paddle.batch(paddle.dataset.cifar.test10(),
+                                   batch_size=BATCH, drop_last=True)
+        feeder = fluid.DataFeeder(feed_list=[images, label], place=place)
+        exe.run(fluid.default_startup_program())
+
+        for pass_id in range(3):
+            for data in train_reader():
+                exe.run(fluid.default_main_program(),
+                        feed=feeder.feed(data))
+            accs = []
+            for data in test_reader():
+                acc_np, = exe.run(program=test_program,
+                                  feed=feeder.feed(data),
+                                  fetch_list=[acc])
+                accs.append(float(acc_np))
+            acc_val = numpy.mean(accs)
+            if acc_val > 0.5:       # separable fixture: learnable fast
+                fluid.io.save_inference_model(save_dirname, ["pixel"],
+                                              [predict], exe)
+                [prog, feeds, fetches] = fluid.io.load_inference_model(
+                    save_dirname, exe)
+                batch = numpy.random.RandomState(0).rand(
+                    4, 3, 32, 32).astype("float32")
+                res = exe.run(prog, feed={feeds[0]: batch},
+                              fetch_list=fetches)
+                assert res[0].shape == (4, 10)
+                return
+        raise AssertionError(f"cifar accuracy too low: {acc_val:.3f}")
+
+
+# ---------------------------------------------------------------------
+# test_label_semantic_roles.py transcription (db_lstm SRL + CRF).
+# Padded+lengths adaptation: each lod_level=1 feed becomes a padded
+# [b, maxlen] int64 array plus one shared sequence-length feed; sizes
+# reduced (hidden 64, depth 4) for the CPU suite.
+# ---------------------------------------------------------------------
+
+
+def test_book_label_semantic_roles():
+    from paddle_tpu.framework import Program, program_guard, unique_name
+
+    word_dict, verb_dict, label_dict = paddle.dataset.conll05.get_dict()
+    word_dict_len = len(word_dict)
+    label_dict_len = len(label_dict)
+    pred_dict_len = len(verb_dict)
+
+    mark_dict_len = 2
+    word_dim = 16
+    mark_dim = 5
+    hidden_dim = 64
+    depth = 4
+    BATCH_SIZE = 20
+
+    with program_guard(Program(), Program()), unique_name.guard():
+        maxlen = 12
+        names = ['word_data', 'ctx_n2_data', 'ctx_n1_data', 'ctx_0_data',
+                 'ctx_p1_data', 'ctx_p2_data', 'verb_data', 'mark_data']
+        feeds = [fluid.layers.data(name=n, shape=[maxlen], dtype='int64')
+                 for n in names]
+        target = fluid.layers.data(name='target', shape=[maxlen],
+                                   dtype='int64')
+        seq_len = fluid.layers.data(name='seq_len', shape=[],
+                                    dtype='int64')
+        (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate,
+         mark) = feeds
+
+        predicate_embedding = fluid.layers.embedding(
+            input=predicate, size=[pred_dict_len, word_dim],
+            dtype='float32', param_attr='vemb')
+        mark_embedding = fluid.layers.embedding(
+            input=mark, size=[mark_dict_len, mark_dim], dtype='float32')
+        word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+        emb_layers = [
+            fluid.layers.embedding(
+                size=[word_dict_len, word_dim], input=x,
+                param_attr=fluid.ParamAttr(name='emb'))
+            for x in word_input]
+        emb_layers.append(predicate_embedding)
+        emb_layers.append(mark_embedding)
+
+        hidden_0_layers = [
+            fluid.layers.fc(input=emb, size=hidden_dim, num_flatten_dims=2)
+            for emb in emb_layers]
+        hidden_0 = fluid.layers.sums(input=hidden_0_layers)
+        lstm_0, _ = fluid.layers.dynamic_lstm(
+            input=hidden_0, size=hidden_dim, sequence_length=seq_len,
+            candidate_activation='relu', gate_activation='sigmoid',
+            cell_activation='sigmoid')
+
+        input_tmp = [hidden_0, lstm_0]
+        for i in range(1, depth):
+            mix_hidden = fluid.layers.sums(input=[
+                fluid.layers.fc(input=input_tmp[0], size=hidden_dim,
+                                num_flatten_dims=2),
+                fluid.layers.fc(input=input_tmp[1], size=hidden_dim,
+                                num_flatten_dims=2)])
+            lstm, _ = fluid.layers.dynamic_lstm(
+                input=mix_hidden, size=hidden_dim,
+                sequence_length=seq_len,
+                candidate_activation='relu', gate_activation='sigmoid',
+                cell_activation='sigmoid', is_reverse=((i % 2) == 1))
+            input_tmp = [mix_hidden, lstm]
+
+        feature_out = fluid.layers.sums(input=[
+            fluid.layers.fc(input=input_tmp[0], size=label_dict_len,
+                            num_flatten_dims=2, act='tanh'),
+            fluid.layers.fc(input=input_tmp[1], size=label_dict_len,
+                            num_flatten_dims=2, act='tanh')])
+
+        transition = fluid.layers.create_parameter(
+            shape=[label_dict_len + 2, label_dict_len], dtype='float32',
+            name='crfw')
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=feature_out, label=target, param_attr=transition,
+            length=seq_len)
+        avg_cost = fluid.layers.mean(crf_cost)
+        crf_decode = fluid.layers.crf_decoding(
+            input=feature_out, param_attr=transition, length=seq_len)
+
+        sgd_optimizer = fluid.optimizer.SGD(
+            learning_rate=fluid.layers.exponential_decay(
+                learning_rate=0.01, decay_steps=100000,
+                decay_rate=0.5, staircase=True))
+        sgd_optimizer.minimize(avg_cost)
+
+        train_reader = paddle.batch(
+            paddle.reader.shuffle(paddle.dataset.conll05.test(),
+                                  buf_size=512),
+            batch_size=BATCH_SIZE, drop_last=True)
+        place = fluid.CPUPlace()
+        feeder = fluid.DataFeeder(
+            feed_list=feeds + [target, seq_len], place=place)
+        exe = fluid.Executor(place)
+        exe.run(fluid.default_startup_program())
+
+        first = last = None
+        for pass_id in range(4):
+            for data in train_reader():
+                # reader slots align with the feed list: word, ctx(5),
+                # verb, mark, label, length
+                cost_np, path_np = exe.run(
+                    fluid.default_main_program(),
+                    feed=feeder.feed(data),
+                    fetch_list=[avg_cost, crf_decode])
+                v = float(cost_np)
+                if first is None:
+                    first = v
+                last = v
+                assert not math.isnan(v)
+        assert last < first, (first, last)
+        assert path_np.shape == (BATCH_SIZE, maxlen)
+        assert path_np.max() < label_dict_len
+
+
+# ---------------------------------------------------------------------
+# test_recommender_system.py transcription. Padded adaptation: the two
+# lod_level=1 sequence feeds (category, title) are fixed-length [4]
+# windows with a constant length feed.
+# ---------------------------------------------------------------------
+
+
+def test_book_recommender_system():
+    from paddle_tpu.framework import Program, program_guard, unique_name
+    layers = fluid.layers
+    nets = fluid.nets
+
+    IS_SPARSE = True
+    BATCH_SIZE = 128
+
+    with program_guard(Program(), Program()), unique_name.guard():
+        def get_usr_combined_features():
+            USR_DICT_SIZE = paddle.dataset.movielens.max_user_id() + 1
+            uid = layers.data(name='user_id', shape=[1], dtype='int64')
+            usr_emb = layers.embedding(
+                input=uid, dtype='float32', size=[USR_DICT_SIZE, 32],
+                param_attr='user_table', is_sparse=IS_SPARSE)
+            usr_fc = layers.fc(input=usr_emb, size=32)
+
+            usr_gender_id = layers.data(name='gender_id', shape=[1],
+                                        dtype='int64')
+            usr_gender_emb = layers.embedding(
+                input=usr_gender_id, size=[2, 16],
+                param_attr='gender_table', is_sparse=IS_SPARSE)
+            usr_gender_fc = layers.fc(input=usr_gender_emb, size=16)
+
+            USR_AGE_DICT_SIZE = len(paddle.dataset.movielens.age_table)
+            usr_age_id = layers.data(name='age_id', shape=[1],
+                                     dtype="int64")
+            usr_age_emb = layers.embedding(
+                input=usr_age_id, size=[USR_AGE_DICT_SIZE, 16],
+                is_sparse=IS_SPARSE, param_attr='age_table')
+            usr_age_fc = layers.fc(input=usr_age_emb, size=16)
+
+            USR_JOB_DICT_SIZE = paddle.dataset.movielens.max_job_id() + 1
+            usr_job_id = layers.data(name='job_id', shape=[1],
+                                     dtype="int64")
+            usr_job_emb = layers.embedding(
+                input=usr_job_id, size=[USR_JOB_DICT_SIZE, 16],
+                param_attr='job_table', is_sparse=IS_SPARSE)
+            usr_job_fc = layers.fc(input=usr_job_emb, size=16)
+
+            concat_embed = layers.concat(
+                input=[usr_fc, usr_gender_fc, usr_age_fc, usr_job_fc],
+                axis=-1)
+            return layers.fc(input=concat_embed, size=200, act="tanh")
+
+        def get_mov_combined_features(seq4_len):
+            MOV_DICT_SIZE = paddle.dataset.movielens.max_movie_id() + 1
+            mov_id = layers.data(name='movie_id', shape=[1],
+                                 dtype='int64')
+            mov_emb = layers.embedding(
+                input=mov_id, dtype='float32', size=[MOV_DICT_SIZE, 32],
+                param_attr='movie_table', is_sparse=IS_SPARSE)
+            mov_fc = layers.fc(input=mov_emb, size=32)
+
+            CATEGORY_DICT_SIZE = len(
+                paddle.dataset.movielens.movie_categories())
+            category_id = layers.data(name='category_id', shape=[4],
+                                      dtype='int64')
+            mov_categories_emb = layers.embedding(
+                input=category_id, size=[CATEGORY_DICT_SIZE, 32],
+                is_sparse=IS_SPARSE)
+            mov_categories_hidden = layers.sequence_pool(
+                input=mov_categories_emb, pool_type="sum",
+                sequence_length=seq4_len)
+
+            MOV_TITLE_DICT_SIZE = len(
+                paddle.dataset.movielens.get_movie_title_dict())
+            mov_title_id = layers.data(name='movie_title', shape=[4],
+                                       dtype='int64')
+            mov_title_emb = layers.embedding(
+                input=mov_title_id, size=[MOV_TITLE_DICT_SIZE, 32],
+                is_sparse=IS_SPARSE)
+            mov_title_conv = nets.sequence_conv_pool(
+                input=mov_title_emb, num_filters=32, filter_size=3,
+                act="tanh", pool_type="sum", sequence_length=seq4_len)
+
+            concat_embed = layers.concat(
+                input=[mov_fc, mov_categories_hidden, mov_title_conv],
+                axis=-1)
+            return layers.fc(input=concat_embed, size=200, act="tanh")
+
+        seq4_len = layers.data(name='seq4_len', shape=[], dtype='int64')
+        usr = get_usr_combined_features()
+        usr = layers.reshape(usr, [-1, 200])
+        mov = get_mov_combined_features(seq4_len)
+        inference = layers.cos_sim(X=usr, Y=mov)
+        scale_infer = layers.scale(x=inference, scale=5.0)
+        label = layers.data(name='score', shape=[1], dtype='float32')
+        square_cost = layers.square_error_cost(input=scale_infer,
+                                               label=label)
+        avg_cost = layers.mean(square_cost)
+
+        sgd_optimizer = fluid.optimizer.SGD(learning_rate=0.2)
+        sgd_optimizer.minimize(avg_cost)
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        exe.run(fluid.default_startup_program())
+
+        train_reader = paddle.batch(
+            paddle.reader.shuffle(paddle.dataset.movielens.train(),
+                                  buf_size=8192),
+            batch_size=BATCH_SIZE, drop_last=True)
+        first = last = None
+        for pass_id in range(8):
+            for data in train_reader():
+                feed = {
+                    'user_id': numpy.array([[d[0]] for d in data],
+                                           'int64'),
+                    'gender_id': numpy.array([[d[1]] for d in data],
+                                             'int64'),
+                    'age_id': numpy.array([[d[2]] for d in data],
+                                          'int64'),
+                    'job_id': numpy.array([[d[3]] for d in data],
+                                          'int64'),
+                    'movie_id': numpy.array([[d[4]] for d in data],
+                                            'int64'),
+                    'category_id': numpy.stack([d[5] for d in data]),
+                    'movie_title': numpy.stack([d[6] for d in data]),
+                    'seq4_len': numpy.full((len(data),), 4, 'int64'),
+                    'score': numpy.array([[d[7]] for d in data],
+                                         'float32'),
+                }
+                out = exe.run(fluid.default_main_program(), feed=feed,
+                              fetch_list=[avg_cost])
+                v = float(out[0])
+                if first is None:
+                    first = v
+                last = v
+                assert not math.isnan(v)
+        assert last < first * 0.9, (first, last)
