@@ -1,0 +1,236 @@
+// Package paddle — Go inference/training bindings over the paddle_tpu
+// C ABI (capability parity with the reference go/paddle/predictor.go,
+// which wraps paddle_fluid_c the same way via cgo).
+//
+// The native library (paddle_tpu/native/_inference_capi-*.so) embeds a
+// python interpreter that drives the XLA-compiled Predictor, so Go code
+// needs no python of its own. The library is hash-named by content, so
+// it is loaded with dlopen at runtime instead of a link-time -l flag:
+// set PADDLE_TPU_CAPI_SO to its path (and PYTHONPATH to the repo root).
+//
+// Build note: the CI image for this repo carries no Go toolchain, so
+// this package ships source-only; the C ABI underneath is exercised in
+// CI by a gcc-compiled C binary (tests/test_inference_misc.py). With a
+// local Go toolchain: `go test ./go/paddle` after exporting
+// PADDLE_TPU_CAPI_SO and PADDLE_TPU_MODEL_DIR.
+package paddle
+
+/*
+#cgo LDFLAGS: -ldl
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdlib.h>
+
+typedef void PD_Predictor;
+typedef PD_Predictor *(*pd_new_fn)(const char *);
+typedef void (*pd_del_fn)(PD_Predictor *);
+typedef int (*pd_run_fn)(PD_Predictor *, const float *const *,
+                         const int64_t *const *, const int *, int,
+                         float ***, int64_t ***, int **, int *);
+typedef void (*pd_free_fn)(float **, int64_t **, int *, int);
+typedef const char *(*pd_err_fn)(void);
+
+static void *pd_dlopen(const char *path) {
+	return dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+}
+static PD_Predictor *call_new(void *fn, const char *dir) {
+	return ((pd_new_fn)fn)(dir);
+}
+static void call_del(void *fn, PD_Predictor *p) { ((pd_del_fn)fn)(p); }
+static int call_run(void *fn, PD_Predictor *p, const float *const *in,
+                    const int64_t *const *shapes, const int *ndims,
+                    int n, float ***out, int64_t ***oshapes, int **ondims,
+                    int *nout) {
+	return ((pd_run_fn)fn)(p, in, shapes, ndims, n, out, oshapes, ondims,
+	                       nout);
+}
+static void call_free(void *fn, float **out, int64_t **shapes, int *ndims,
+                      int n) {
+	((pd_free_fn)fn)(out, shapes, ndims, n);
+}
+static const char *call_err(void *fn) { return ((pd_err_fn)fn)(); }
+*/
+import "C"
+
+import (
+	"errors"
+	"fmt"
+	"os"
+	"sync"
+	"unsafe"
+)
+
+type capi struct {
+	handle                      unsafe.Pointer
+	newP, newT, del, run, free_ unsafe.Pointer
+	lastErr                     unsafe.Pointer
+}
+
+var (
+	libOnce sync.Once
+	lib     *capi
+	libErr  error
+)
+
+func loadLib() (*capi, error) {
+	libOnce.Do(func() {
+		path := os.Getenv("PADDLE_TPU_CAPI_SO")
+		if path == "" {
+			libErr = errors.New(
+				"PADDLE_TPU_CAPI_SO not set (path to _inference_capi*.so)")
+			return
+		}
+		cpath := C.CString(path)
+		defer C.free(unsafe.Pointer(cpath))
+		h := C.pd_dlopen(cpath)
+		if h == nil {
+			libErr = fmt.Errorf("dlopen %s failed", path)
+			return
+		}
+		sym := func(name string) unsafe.Pointer {
+			cname := C.CString(name)
+			defer C.free(unsafe.Pointer(cname))
+			return C.dlsym(h, cname)
+		}
+		lib = &capi{
+			handle:  h,
+			newP:    sym("PD_NewPredictor"),
+			newT:    sym("PD_NewTrainer"),
+			del:     sym("PD_DeletePredictor"),
+			run:     sym("PD_PredictorRunFloat"),
+			free_:   sym("PD_FreeOutputs"),
+			lastErr: sym("PD_GetLastError"),
+		}
+		for name, p := range map[string]unsafe.Pointer{
+			"PD_NewPredictor": lib.newP, "PD_DeletePredictor": lib.del,
+			"PD_PredictorRunFloat": lib.run, "PD_FreeOutputs": lib.free_,
+			"PD_GetLastError": lib.lastErr,
+		} {
+			if p == nil {
+				libErr = fmt.Errorf("symbol %s missing in %s", name, path)
+				return
+			}
+		}
+	})
+	return lib, libErr
+}
+
+func lastError(l *capi) error {
+	msg := C.call_err(l.lastErr)
+	if msg == nil {
+		return errors.New("unknown paddle_tpu C API error")
+	}
+	return errors.New(C.GoString(msg))
+}
+
+// Predictor wraps a loaded inference model (reference predictor.go
+// Predictor). Trainer handles from NewTrainer run one optimizer step
+// per Run call, through the identical interface.
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// NewPredictor loads a save_inference_model directory.
+func NewPredictor(modelDir string) (*Predictor, error) {
+	l, err := loadLib()
+	if err != nil {
+		return nil, err
+	}
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	p := C.call_new(l.newP, cdir)
+	if p == nil {
+		return nil, lastError(l)
+	}
+	return &Predictor{c: p}, nil
+}
+
+// NewTrainer loads a capi_train.save_train_model directory; each Run
+// performs one training step (python-free training, PD_NewTrainer).
+func NewTrainer(modelDir string) (*Predictor, error) {
+	l, err := loadLib()
+	if err != nil {
+		return nil, err
+	}
+	if l.newT == nil {
+		return nil, errors.New("PD_NewTrainer missing in library")
+	}
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	p := C.call_new(l.newT, cdir)
+	if p == nil {
+		return nil, lastError(l)
+	}
+	return &Predictor{c: p}, nil
+}
+
+// Delete releases the native handle.
+func (p *Predictor) Delete() {
+	if p.c != nil {
+		l, _ := loadLib()
+		C.call_del(l.del, p.c)
+		p.c = nil
+	}
+}
+
+// Tensor is a dense float32 value with an explicit shape.
+type Tensor struct {
+	Data  []float32
+	Shape []int64
+}
+
+// Run feeds the inputs (in the model's feed order) and returns the
+// fetched outputs (PD_PredictorRunFloat).
+func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
+	l, err := loadLib()
+	if err != nil {
+		return nil, err
+	}
+	n := len(inputs)
+	inPtrs := make([]*C.float, n)
+	shapePtrs := make([]*C.int64_t, n)
+	ndims := make([]C.int, n)
+	for i, t := range inputs {
+		if len(t.Data) > 0 {
+			inPtrs[i] = (*C.float)(unsafe.Pointer(&t.Data[0]))
+		}
+		if len(t.Shape) > 0 {
+			shapePtrs[i] = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		}
+		ndims[i] = C.int(len(t.Shape))
+	}
+	var outs **C.float
+	var outShapes **C.int64_t
+	var outNdims *C.int
+	var nOut C.int
+	rc := C.call_run(l.run, p.c,
+		(**C.float)(unsafe.Pointer(&inPtrs[0])),
+		(**C.int64_t)(unsafe.Pointer(&shapePtrs[0])),
+		(*C.int)(unsafe.Pointer(&ndims[0])), C.int(n),
+		&outs, &outShapes, &outNdims, &nOut)
+	if rc != 0 {
+		return nil, lastError(l)
+	}
+	defer C.call_free(l.free_, outs, outShapes, outNdims, nOut)
+
+	count := int(nOut)
+	outSlice := unsafe.Slice(outs, count)
+	shapeSlice := unsafe.Slice(outShapes, count)
+	ndimSlice := unsafe.Slice(outNdims, count)
+	result := make([]Tensor, count)
+	for i := 0; i < count; i++ {
+		nd := int(ndimSlice[i])
+		shape := make([]int64, nd)
+		numel := int64(1)
+		cshape := unsafe.Slice(shapeSlice[i], nd)
+		for d := 0; d < nd; d++ {
+			shape[d] = int64(cshape[d])
+			numel *= shape[d]
+		}
+		data := make([]float32, numel)
+		copy(data, unsafe.Slice((*float32)(unsafe.Pointer(outSlice[i])),
+			numel))
+		result[i] = Tensor{Data: data, Shape: shape}
+	}
+	return result, nil
+}

@@ -1,0 +1,37 @@
+package paddle
+
+// End-to-end smoke test against a saved inference model. Requires a
+// local Go toolchain (absent from the CI image — the C ABI beneath is
+// CI-covered by a gcc-compiled C binary, tests/test_inference_misc.py)
+// plus:
+//
+//	export PADDLE_TPU_CAPI_SO=$(ls paddle_tpu/native/_inference_capi-*.so)
+//	export PYTHONPATH=$PWD
+//	export PADDLE_TPU_MODEL_DIR=/path/to/save_inference_model/dir
+//	go test ./go/paddle
+
+import (
+	"os"
+	"testing"
+)
+
+func TestPredictorRun(t *testing.T) {
+	dir := os.Getenv("PADDLE_TPU_MODEL_DIR")
+	if dir == "" || os.Getenv("PADDLE_TPU_CAPI_SO") == "" {
+		t.Skip("PADDLE_TPU_MODEL_DIR / PADDLE_TPU_CAPI_SO not set")
+	}
+	p, err := NewPredictor(dir)
+	if err != nil {
+		t.Fatalf("NewPredictor: %v", err)
+	}
+	defer p.Delete()
+
+	in := Tensor{Data: make([]float32, 13), Shape: []int64{1, 13}}
+	outs, err := p.Run([]Tensor{in})
+	if err != nil {
+		t.Fatalf("Run: %v", err)
+	}
+	if len(outs) == 0 || len(outs[0].Data) == 0 {
+		t.Fatalf("empty outputs: %+v", outs)
+	}
+}
