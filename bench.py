@@ -106,6 +106,72 @@ def build_steps(model_name: str, seq: int = 1024):
     return cfg, step, multi
 
 
+def child_main_widedeep(batch: int, steps: int) -> int:
+    """BENCH_MODEL=widedeep: Wide&Deep parameter-server CTR
+    (BASELINE configs[4]) with the HOST-PACED sparse transport —
+    pull -> compute -> push around a host-call-free compiled step, so
+    it runs on any TPU attachment including the tunneled remote chip
+    (the in-graph io_callback transport does not complete there,
+    PERF.md). Criteo geometry: 26 slots, embed 16, 400x400x400 tower,
+    1M-id space, PullPrefetcher overlap."""
+    import jax
+
+    from paddle_tpu.distributed.ps import sparse_table as st
+    from paddle_tpu.distributed.ps.host_paced import (SparseFeed,
+                                                      run_host_paced)
+    from paddle_tpu.framework import Executor, Scope
+    from paddle_tpu.models.ctr import build_wide_deep_program
+
+    SLOTS, DIM = 26, 16
+    dev = jax.devices()[0]
+    st.REGISTRY.clear()
+    main, startup, loss, _ = build_wide_deep_program(
+        num_slots=SLOTS, embed_dim=DIM, hidden_sizes=(400, 400, 400),
+        table_name="bench_emb", sparse_lr=0.05, dense_lr=0.01,
+        host_paced=True)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    feeds = [SparseFeed("ctr_emb", "bench_emb", DIM, lr=0.05),
+             SparseFeed("ctr_wide", "bench_emb_wide", 1, lr=0.05)]
+
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            ids = rng.randint(1, 1_000_000,
+                              (batch, SLOTS)).astype(np.int64)
+            y = (ids[:, 0] % 2 == 0).astype(np.float32)[:, None]
+            yield {"ids": ids, "label": y}
+
+    try:
+        # warmup: compile + materialize tables
+        run_host_paced(exe, main, scope, batches(3), feeds,
+                       fetch_list=[loss.name])
+        t0 = time.perf_counter()
+        outs = run_host_paced(exe, main, scope, batches(steps), feeds,
+                              fetch_list=[loss.name])
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            sys.stderr.write("OOM: " + msg[:300] + "\n")
+            return OOM_RC
+        raise
+
+    ex_per_sec = batch / dt
+    print(json.dumps({
+        "metric": "widedeep_host_paced_examples_per_sec",
+        "value": round(ex_per_sec, 1), "unit": "examples/s",
+        "vs_baseline": round(ex_per_sec / 4095.0, 4),
+        "step_time_ms": round(dt * 1000, 2), "batch": batch,
+        "slots": SLOTS, "embed_dim": DIM,
+        "loss": round(float(outs[-1][0]), 4),
+        "rows_live": st.REGISTRY.get("bench_emb").size(),
+        "device": getattr(dev, "device_kind", str(dev)),
+    }))
+    return 0
+
+
 # ResNet-50 fwd FLOPs per image at 224x224 (the standard 4.1 GFLOP
 # figure, He et al. accounting); scales with spatial area.
 RESNET50_FWD_FLOPS_224 = 4.089e9
@@ -250,7 +316,8 @@ def main() -> int:
     model_name = os.environ.get("BENCH_MODEL", "gpt2-medium")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
-    default_batch = "128" if model_name == "resnet50" else "8"
+    default_batch = {"resnet50": "128", "widedeep": "512"}.get(
+        model_name, "8")
     batch = int(os.environ.get("BENCH_BATCH", default_batch))
     if model_name == "resnet50":
         seq = int(os.environ.get("BENCH_IMG", "224"))
@@ -288,6 +355,9 @@ if __name__ == "__main__":
             sys.exit(child_main_resnet(int(sys.argv[i + 2]),
                                        int(sys.argv[i + 3]),
                                        int(sys.argv[i + 4])))
+        if name == "widedeep":
+            sys.exit(child_main_widedeep(int(sys.argv[i + 2]),
+                                         int(sys.argv[i + 4])))
         sys.exit(child_main(name, int(sys.argv[i + 2]),
                             int(sys.argv[i + 3]), int(sys.argv[i + 4])))
     sys.exit(main())

@@ -115,6 +115,20 @@ func loadLib() (*capi, error) {
 	return lib, libErr
 }
 
+func maxSize(n C.size_t) C.size_t {
+	if n == 0 {
+		return 1 // malloc(0) may return nil; keep pointers valid
+	}
+	return n
+}
+
+func maxInt(a, b int) int {
+	if a > b {
+		return a
+	}
+	return b
+}
+
 func lastError(l *capi) error {
 	msg := C.call_err(l.lastErr)
 	if msg == nil {
@@ -180,33 +194,54 @@ type Tensor struct {
 }
 
 // Run feeds the inputs (in the model's feed order) and returns the
-// fetched outputs (PD_PredictorRunFloat).
+// fetched outputs (PD_PredictorRunFloat). Input data and the pointer
+// arrays are staged through C-allocated memory: passing Go slices that
+// contain Go pointers to C violates the cgo pointer rules (panics
+// under the default cgocheck).
 func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 	l, err := loadLib()
 	if err != nil {
 		return nil, err
 	}
 	n := len(inputs)
-	inPtrs := make([]*C.float, n)
-	shapePtrs := make([]*C.int64_t, n)
-	ndims := make([]C.int, n)
+	if n == 0 {
+		return nil, errors.New("Run needs at least one input tensor")
+	}
+	ptrSize := C.size_t(unsafe.Sizeof(uintptr(0)))
+	inPtrs := (**C.float)(C.malloc(C.size_t(n) * ptrSize))
+	shapePtrs := (**C.int64_t)(C.malloc(C.size_t(n) * ptrSize))
+	ndims := (*C.int)(C.malloc(C.size_t(n) * C.size_t(C.sizeof_int)))
+	defer C.free(unsafe.Pointer(inPtrs))
+	defer C.free(unsafe.Pointer(shapePtrs))
+	defer C.free(unsafe.Pointer(ndims))
+	inSlice := unsafe.Slice(inPtrs, n)
+	shapeSlice := unsafe.Slice(shapePtrs, n)
+	ndimSlice := unsafe.Slice(ndims, n)
 	for i, t := range inputs {
+		nd := len(t.Shape)
+		dataBytes := C.size_t(len(t.Data)) * C.sizeof_float
+		buf := (*C.float)(C.malloc(maxSize(dataBytes)))
+		defer C.free(unsafe.Pointer(buf))
 		if len(t.Data) > 0 {
-			inPtrs[i] = (*C.float)(unsafe.Pointer(&t.Data[0]))
+			copy(unsafe.Slice((*float32)(unsafe.Pointer(buf)),
+				len(t.Data)), t.Data)
 		}
-		if len(t.Shape) > 0 {
-			shapePtrs[i] = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		shp := (*C.int64_t)(C.malloc(maxSize(
+			C.size_t(nd) * C.sizeof_int64_t)))
+		defer C.free(unsafe.Pointer(shp))
+		cshp := unsafe.Slice(shp, maxInt(nd, 1))
+		for d := 0; d < nd; d++ {
+			cshp[d] = C.int64_t(t.Shape[d])
 		}
-		ndims[i] = C.int(len(t.Shape))
+		inSlice[i] = buf
+		shapeSlice[i] = shp
+		ndimSlice[i] = C.int(nd)
 	}
 	var outs **C.float
 	var outShapes **C.int64_t
 	var outNdims *C.int
 	var nOut C.int
-	rc := C.call_run(l.run, p.c,
-		(**C.float)(unsafe.Pointer(&inPtrs[0])),
-		(**C.int64_t)(unsafe.Pointer(&shapePtrs[0])),
-		(*C.int)(unsafe.Pointer(&ndims[0])), C.int(n),
+	rc := C.call_run(l.run, p.c, inPtrs, shapePtrs, ndims, C.int(n),
 		&outs, &outShapes, &outNdims, &nOut)
 	if rc != 0 {
 		return nil, lastError(l)

@@ -116,13 +116,26 @@ def build_wide_deep_program(num_slots: int = 8, embed_dim: int = 8,
                             hidden_sizes: Sequence[int] = (64, 32),
                             table_name: str = "ctr_embedding",
                             sparse_lr: float = 0.1,
-                            dense_lr: float = 0.01):
-    """Static PS-tier Wide&Deep: sparse embedding via
-    distributed_lookup_table (pull from the host/remote table, push
-    handled by its grad op), dense tower trained with SGD on device.
+                            dense_lr: float = 0.01,
+                            host_paced: bool = False):
+    """Static PS-tier Wide&Deep: sparse embedding on the PS tier, dense
+    tower trained with SGD on device. Two sparse transports:
+
+    - default (in-graph): embedding pull/push rides the
+      distributed_lookup_table op's ordered io_callback inside the
+      compiled step — lowest host overhead when the runtime services
+      in-graph host calls;
+    - ``host_paced=True``: the embedding rows become plain DENSE feed
+      vars (``ctr_emb``/``ctr_wide``, stop_gradient=False) and their
+      gradients materialize as fetchable ``@GRAD`` vars — the
+      pull → compute → push loop then lives on the HOST
+      (ps/host_paced.py; downpour_worker.cc:726 structure). This is the
+      transport that works on any TPU attachment, including tunneled
+      chips where io_callback never completes (PERF.md).
 
     Returns (main, startup, loss_var, logit_var); feed ``ids``
-    [b, num_slots] int64 and ``label`` [b, 1] float32.
+    [b, num_slots] int64 and ``label`` [b, 1] float32 (plus the two row
+    feeds in host_paced mode).
     """
     import paddle_tpu.layers as L
     from ..framework import Program, program_guard, unique_name
@@ -133,11 +146,17 @@ def build_wide_deep_program(num_slots: int = 8, embed_dim: int = 8,
         blk = main.global_block()
         L.data("ids", [num_slots], dtype="int64")
         label = L.data("label", [1])
-        emb = blk.create_var("ctr_emb", shape=[-1, num_slots, embed_dim])
-        blk.append_op("distributed_lookup_table", {"Ids": "ids"},
-                      {"Out": "ctr_emb"},
-                      {"table_names": [table_name],
-                       "value_dim": embed_dim, "sparse_lr": sparse_lr})
+        if host_paced:
+            emb = L.data("ctr_emb", [num_slots, embed_dim])
+            emb.stop_gradient = False
+        else:
+            emb = blk.create_var("ctr_emb",
+                                 shape=[-1, num_slots, embed_dim])
+            blk.append_op("distributed_lookup_table", {"Ids": "ids"},
+                          {"Out": "ctr_emb"},
+                          {"table_names": [table_name],
+                           "value_dim": embed_dim,
+                           "sparse_lr": sparse_lr})
         deep = L.reshape(emb, [-1, num_slots * embed_dim])
         for h in hidden_sizes:
             deep = L.fc(deep, h, act="relu")
@@ -145,11 +164,15 @@ def build_wide_deep_program(num_slots: int = 8, embed_dim: int = 8,
         # wide order-1 path: its own dim-1 table summed straight into
         # the logit — the direct gradient route that lets the sparse
         # tier learn before the deep tower warms up
-        wide = blk.create_var("ctr_wide", shape=[-1, num_slots, 1])
-        blk.append_op("distributed_lookup_table", {"Ids": "ids"},
-                      {"Out": "ctr_wide"},
-                      {"table_names": [table_name + "_wide"],
-                       "value_dim": 1, "sparse_lr": sparse_lr})
+        if host_paced:
+            wide = L.data("ctr_wide", [num_slots, 1])
+            wide.stop_gradient = False
+        else:
+            wide = blk.create_var("ctr_wide", shape=[-1, num_slots, 1])
+            blk.append_op("distributed_lookup_table", {"Ids": "ids"},
+                          {"Out": "ctr_wide"},
+                          {"table_names": [table_name + "_wide"],
+                           "value_dim": 1, "sparse_lr": sparse_lr})
         wide_sum = L.reduce_sum(wide, dim=[1])
         logit = L.elementwise_add(deep_logit, wide_sum)
         loss = L.reduce_mean(
