@@ -71,6 +71,12 @@ GPT_CONFIGS = {
     # heads run at half MXU width; PERF.md "where the time goes")
     "gpt2-1p3b": GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
                            ffn_hidden_size=8192),
+    # largest ≥1B config that FITS one 16 GB v5e chip for training
+    # (fp32 params+grads, bf16 AdamW moments, per-block recompute):
+    # 1.3B's 14.7 GB of training state OOMs even at batch 1; dropping to
+    # 20 layers costs 2.4 GB — capacity analysis in PERF.md
+    "gpt2-1p1b": GPTConfig(hidden_size=2048, num_layers=20, num_heads=16,
+                           ffn_hidden_size=8192),
     "gpt2-xl": GPTConfig(hidden_size=1600, num_layers=48, num_heads=25,
                          ffn_hidden_size=6400),
 }

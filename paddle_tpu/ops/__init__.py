@@ -14,6 +14,7 @@ from . import attention_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import proposal_ops  # noqa: F401
+from . import delegate_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401

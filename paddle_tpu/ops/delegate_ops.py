@@ -1,0 +1,49 @@
+"""subgraph_delegate lowering — executes a delegated cluster.
+
+Engine-op analog (operators/tensorrt_engine_op.h: the reference's
+engine op deserializes its subgraph and hands execution to TensorRT).
+Here the default "inline" engine replays the sub-ops through the
+lowering registry INSIDE the enclosing trace — XLA keeps fusing across
+the boundary, so delegation costs nothing when no external engine is
+involved — and a bridge can take over real execution by registering a
+runner under its engine name (framework/subgraph.py
+register_delegate_engine)."""
+
+from __future__ import annotations
+
+import json
+
+from .registry import LoweringContext, execute, register
+
+
+def _run_inline(sub_ops, env, ctx):
+    for op in sub_ops:
+        ins = {slot: [env[n] for n in names]
+               for slot, names in op["inputs"].items()
+               if all(n in env for n in names)}
+        outs = execute(ctx, op["type"], ins, op["attrs"])
+        for slot, names in op["outputs"].items():
+            vals = outs.get(slot, [])
+            for n, v in zip(names, vals):
+                env[n] = v
+    return env
+
+
+@register("subgraph_delegate", not_differentiable=True)
+def _subgraph_delegate(ctx: LoweringContext, ins, attrs):
+    sub_ops = json.loads(attrs["sub_ops"])
+    in_names = list(attrs["input_names"])
+    out_names = list(attrs["output_names"])
+    env = dict(zip(in_names, ins["X"]))
+    engine = attrs.get("engine", "inline")
+    if engine != "inline":
+        from ..framework.subgraph import get_delegate_engine
+        runner = get_delegate_engine(engine)
+        if runner is None:
+            raise RuntimeError(
+                f"subgraph_delegate: engine {engine!r} is not "
+                "registered (framework.subgraph.register_delegate_engine)")
+        outs = runner(sub_ops, dict(env), ctx)
+        return {"Out": [outs[n] for n in out_names]}
+    env = _run_inline(sub_ops, env, ctx)
+    return {"Out": [env[n] for n in out_names]}

@@ -1,0 +1,153 @@
+"""Subgraph detection + engine delegation (framework/subgraph.py;
+reference ir/subgraph_detector.cc + tensorrt_engine_op.h pattern)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import (Executor, Program, Scope,
+                                  program_guard, unique_name)
+from paddle_tpu.framework.ir import IrGraph, new_pass
+from paddle_tpu.framework.subgraph import (SubgraphDetector,
+                                           register_delegate_engine)
+
+
+def _build_mixed_program(seed=3):
+    """relu -> relu -> sigmoid(unsupported) -> relu -> relu."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [8])
+        h = layers.relu(x)
+        h = layers.relu(h)
+        h = layers.sigmoid(h)
+        h = layers.relu(h)
+        out = layers.relu(h)
+    return main, startup, out
+
+
+def test_detector_splits_on_unsupported_bridge():
+    main, _, _ = _build_mixed_program()
+    g = IrGraph(main)
+    clusters = SubgraphDetector(
+        g, lambda n: n.type == "relu").detect(min_size=2)
+    # the sigmoid bridge forces TWO clusters of 2 relus each
+    assert len(clusters) == 2
+    assert all(len(c) == 2 for c in clusters)
+    assert all(n.type == "relu" for c in clusters for n in c)
+
+
+def test_detector_cycle_demotion():
+    """A supported pair whose only connection runs through an
+    unsupported op must NOT merge (contraction would create a cycle)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        a = layers.relu(x)             # supported
+        b = layers.sigmoid(a)          # unsupported bridge
+        c = layers.relu(b)             # supported
+        layers.relu(c)                 # supported, adjacent to c
+    g = IrGraph(main)
+    clusters = SubgraphDetector(
+        g, lambda n: n.type == "relu").detect(min_size=2)
+    for cl in clusters:
+        idxs = [n.idx for n in cl]
+        assert 0 not in idxs or 2 not in idxs, \
+            "cluster spans the unsupported bridge"
+
+
+def test_delegate_pass_outputs_match_original():
+    feed = {"x": np.random.RandomState(0).randn(2, 8).astype(np.float32)}
+
+    main, startup, out = _build_mixed_program()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    ref = exe.run(main, feed=feed, fetch_list=[out.name], scope=scope)[0]
+
+    p = new_pass("subgraph_delegate_pass",
+                 is_supported={"relu"}, min_subgraph_size=2)
+    fused = p.apply(IrGraph(main)).to_program()
+    types = [op.type for op in fused.global_block().ops]
+    assert types.count("subgraph_delegate") == 2
+    assert "relu" not in types
+
+    scope2, exe2 = Scope(), Executor()
+    exe2.run(startup, scope=scope2)
+    got = exe2.run(fused, feed=feed, fetch_list=[out.name],
+                   scope=scope2)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6)
+
+
+def test_delegate_carries_parameters_across_boundary():
+    """fc params are cluster-external inputs: the delegate must read
+    them from the scope like any var (engine-op weights contract)."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 7
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [6])
+        h = layers.fc(x, 5, act=None)
+        out = layers.relu(h)
+    feed = {"x": np.random.RandomState(1).randn(3, 6).astype(np.float32)}
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    ref = exe.run(main, feed=feed, fetch_list=[out.name], scope=scope)[0]
+
+    p = new_pass("subgraph_delegate_pass",
+                 is_supported={"mul", "elementwise_add", "relu"},
+                 min_subgraph_size=2)
+    fused = p.apply(IrGraph(main)).to_program()
+    assert [op.type for op in fused.global_block().ops].count(
+        "subgraph_delegate") == 1
+    got = exe.run(fused, feed=feed, fetch_list=[out.name], scope=scope)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6)
+
+
+def test_custom_engine_runner_invoked():
+    calls = {}
+
+    def engine(sub_ops, env, ctx):
+        calls["n_ops"] = len(sub_ops)
+        import jax.numpy as jnp
+        v = env[sub_ops[0]["inputs"]["X"][0]]
+        for _ in sub_ops:
+            v = jnp.maximum(v, 0)
+        # single external output contract for this test
+        return {sub_ops[-1]["outputs"]["Out"][0]: v}
+
+    register_delegate_engine("test_engine", engine)
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        h = layers.relu(x)
+        out = layers.relu(h)
+    p = new_pass("subgraph_delegate_pass", is_supported={"relu"},
+                 min_subgraph_size=2, engine="test_engine")
+    fused = p.apply(IrGraph(main)).to_program()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.array([[-1.0, 2.0, -3.0, 4.0]], np.float32)}
+    got = exe.run(fused, feed=feed, fetch_list=[out.name], scope=scope)[0]
+    np.testing.assert_allclose(np.asarray(got),
+                               [[0.0, 2.0, 0.0, 4.0]])
+    assert calls["n_ops"] == 2
+
+
+def test_unregistered_engine_raises():
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        h = layers.relu(x)
+        out = layers.relu(h)
+    p = new_pass("subgraph_delegate_pass", is_supported={"relu"},
+                 min_subgraph_size=2, engine="missing_engine")
+    fused = p.apply(IrGraph(main)).to_program()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    with pytest.raises(Exception, match="missing_engine"):
+        exe.run(fused, feed={"x": np.zeros((1, 4), np.float32)},
+                fetch_list=[out.name], scope=scope)
