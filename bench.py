@@ -74,6 +74,10 @@ def build_steps(model_name: str, seq: int = 1024):
     import dataclasses
     if os.environ.get("BENCH_RECOMPUTE") == "1":
         cfg = dataclasses.replace(cfg, recompute=True)
+    if os.environ.get("BENCH_GPT_LAYERS"):
+        # capacity-search override (PERF.md ≥1B analysis)
+        cfg = dataclasses.replace(
+            cfg, num_layers=int(os.environ["BENCH_GPT_LAYERS"]))
     if seq > cfg.max_position_embeddings:
         # long-seq configs need position rows to exist (the model raises
         # on out-of-range positions rather than NaN-ing)
@@ -100,10 +104,92 @@ def build_steps(model_name: str, seq: int = 1024):
         opt.step()
         return loss
 
-    step = jit.to_static(train_step, layers=[model], optimizers=[opt])
+    # BENCH_NO_RETAIN_GRADS=1: grads stay internal to the compiled step
+    # (set_to_none contract) — the ≥1B capacity lever
+    retain = os.environ.get("BENCH_NO_RETAIN_GRADS") != "1"
+    step = jit.to_static(train_step, layers=[model], optimizers=[opt],
+                         retain_grads=retain)
     multi = jit.to_static_multi_step(train_step, layers=[model],
-                                     optimizers=[opt])
+                                     optimizers=[opt],
+                                     retain_grads=retain)
     return cfg, step, multi
+
+
+def child_main_ernie(batch: int, seq: int, steps: int) -> int:
+    """BENCH_MODEL=ernie: ERNIE-base MLM+SOP pretraining step (BASELINE
+    configs[3]'s model family, single-chip perf point; the sharded
+    multi-chip regime is exercised by the dryrun's ZeRO+TP leg)."""
+    import dataclasses
+
+    import jax
+
+    from paddle_tpu import amp, jit
+    from paddle_tpu.models import ERNIE_CONFIGS, ErnieForPretraining
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    peak = detect_peak_flops(dev)
+    cfg = dataclasses.replace(ERNIE_CONFIGS["ernie-base"],
+                              hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0)
+    try:
+        model = ErnieForPretraining(cfg)
+        moment_dtype = (None if os.environ.get("BENCH_BF16_MOMENTS")
+                        == "0" else "bfloat16")
+        opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                    moment_dtype=moment_dtype)
+
+        def train_step(ids, mlm_labels, ns_labels):
+            with amp.auto_cast(level="O2"):
+                loss = model(ids, masked_lm_labels=mlm_labels,
+                             next_sentence_label=ns_labels)
+            model.clear_gradients()
+            loss.backward()
+            opt.step()
+            return loss
+
+        step = jit.to_static(train_step, layers=[model],
+                             optimizers=[opt])
+        multi = jit.to_static_multi_step(train_step, layers=[model],
+                                         optimizers=[opt])
+        rng = np.random.RandomState(0)
+        ids1 = rng.randint(3, cfg.vocab_size,
+                           (batch, seq)).astype(np.int32)
+        ns1 = rng.randint(0, 2, (batch,)).astype(np.int32)
+        for _ in range(2):
+            np.asarray(step(ids1, ids1, ns1).value)
+        ids = rng.randint(3, cfg.vocab_size,
+                          (steps, batch, seq)).astype(np.int32)
+        ns = rng.randint(0, 2, (steps, batch)).astype(np.int32)
+        np.asarray(multi(ids, ids, ns).value)
+        t0 = time.perf_counter()
+        losses = np.asarray(multi(ids, ids, ns).value)
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            sys.stderr.write("OOM: " + msg[:300] + "\n")
+            return OOM_RC
+        raise
+
+    h, f, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    fwd_per_tok = L * (8 * h * h + 4 * h * f + 4 * seq * h) + 2 * h * V
+    tokens_per_sec = batch * seq / dt
+    mfu = 3.0 * fwd_per_tok * tokens_per_sec / peak
+    if mfu > 1.0:
+        sys.stderr.write(f"implausible MFU {mfu*100:.1f}% — refusing\n")
+        return 3
+    print(json.dumps({
+        "metric": "ernie_base_mfu", "value": round(mfu * 100, 2),
+        "unit": "%", "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "step_time_ms": round(dt * 1000, 2), "batch": batch,
+        "seq": seq, "loss": round(float(losses[-1]), 4),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "peak_flops": peak,
+    }))
+    return 0
 
 
 def child_main_widedeep(batch: int, steps: int) -> int:
@@ -316,11 +402,13 @@ def main() -> int:
     model_name = os.environ.get("BENCH_MODEL", "gpt2-medium")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
-    default_batch = {"resnet50": "128", "widedeep": "512"}.get(
-        model_name, "8")
+    default_batch = {"resnet50": "128", "widedeep": "512",
+                     "ernie": "16"}.get(model_name, "8")
     batch = int(os.environ.get("BENCH_BATCH", default_batch))
     if model_name == "resnet50":
         seq = int(os.environ.get("BENCH_IMG", "224"))
+    if model_name == "ernie":
+        seq = int(os.environ.get("BENCH_SEQ", "512"))
 
     here = os.path.abspath(__file__)
     last_err = ""
@@ -358,6 +446,10 @@ if __name__ == "__main__":
         if name == "widedeep":
             sys.exit(child_main_widedeep(int(sys.argv[i + 2]),
                                          int(sys.argv[i + 4])))
+        if name == "ernie":
+            sys.exit(child_main_ernie(int(sys.argv[i + 2]),
+                                      int(sys.argv[i + 3]),
+                                      int(sys.argv[i + 4])))
         sys.exit(child_main(name, int(sys.argv[i + 2]),
                             int(sys.argv[i + 3]), int(sys.argv[i + 4])))
     sys.exit(main())

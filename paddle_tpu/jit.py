@@ -73,7 +73,8 @@ class _StateSpec:
 
 def to_static(function: Optional[Callable] = None, *, layers=None,
               optimizers=None, donate_state: bool = True, mesh=None,
-              param_rules=None, arg_specs=None, ast_convert: bool = False):
+              param_rules=None, arg_specs=None, ast_convert: bool = False,
+              retain_grads: bool = True):
     """Compile a dygraph function into one XLA computation.
 
     - forward-only: ``fast = to_static(model)`` or
@@ -95,6 +96,16 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
     converter over the function (the reference's ProgramTranslator AST
     mode): supported data-dependent ``if`` statements become traceable
     where-merges instead of tripping the traced-``__bool__`` guard.
+
+    ``retain_grads=False`` (capacity lever for billion-param training):
+    when the optimizer update runs INSIDE the step, gradients never
+    need to leave the computation — dropping them from the output state
+    lets XLA free each grad as soon as its parameter update consumes
+    it, instead of materializing all of them as step outputs. After the
+    call every ``p.grad`` is None (the reference's
+    clear_grad(set_to_none=True) semantics). Measured: peak HBM at 1B
+    scale drops by the full fp32-grads footprint (PERF.md ≥1B capacity
+    analysis).
     """
     if function is not None and isinstance(function, Layer) and layers is None:
         layer = function
@@ -133,6 +144,10 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
                     lambda t: t.value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
                 new_state = spec.snapshot()
+                if not retain_grads:
+                    # grads stay internal: XLA frees each one at its
+                    # consuming param update (set_to_none contract)
+                    new_state["grads"] = [None] * len(new_state["grads"])
                 if mesh is not None:
                     # pin fed-back state layouts in-graph (lazy opt
                     # accumulators make out_shardings unusable)
@@ -194,7 +209,8 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
 
 def to_static_multi_step(fn, *, layers, optimizers=None,
                          donate_state: bool = True, mesh=None,
-                         param_rules=None, arg_specs=None):
+                         param_rules=None, arg_specs=None,
+                         retain_grads: bool = True):
     """Compile K chained train steps into ONE XLA execution (lax.scan).
 
     The analog of the reference's ``train_from_dataset`` trainer loop
@@ -218,7 +234,12 @@ def to_static_multi_step(fn, *, layers, optimizers=None,
             out_arrays = jax.tree_util.tree_map(
                 lambda t: t.value if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
-            return spec.snapshot(), out_arrays
+            snap = spec.snapshot()
+            if not retain_grads:
+                # keep the scan carry grad-free: XLA frees each grad at
+                # its consuming update (same lever as to_static)
+                snap["grads"] = [None] * len(snap["grads"])
+            return snap, out_arrays
 
         def traced(state, args):
             new_state, outs = jax.lax.scan(body, state, args)
