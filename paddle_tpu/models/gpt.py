@@ -71,10 +71,10 @@ GPT_CONFIGS = {
     # heads run at half MXU width; PERF.md "where the time goes")
     "gpt2-1p3b": GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
                            ffn_hidden_size=8192),
-    # largest ≥1B config that FITS one 16 GB v5e chip for training
-    # (fp32 params+grads, bf16 AdamW moments, per-block recompute):
-    # 1.3B's 14.7 GB of training state OOMs even at batch 1; dropping to
-    # 20 layers costs 2.4 GB — capacity analysis in PERF.md
+    # 1.112B sibling: the largest config that trains at BATCH 8 on one
+    # 16 GB v5e chip (1.3B fits at batch 4) — needs the
+    # jit.to_static(retain_grads=False) grads-internal contract; full
+    # measured capacity curve in PERF.md
     "gpt2-1p1b": GPTConfig(hidden_size=2048, num_layers=20, num_heads=16,
                            ffn_hidden_size=8192),
     "gpt2-xl": GPTConfig(hidden_size=1600, num_layers=48, num_heads=25,
