@@ -55,7 +55,9 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
         if causal else pl.cdiv(seq_k, block_k)
 
     def body(kb, carry):
-        m_prev, l_prev, acc = carry                   # m/l: [bq, 2]
+        # m/l carried per head half as [bq, 1] (Mosaic-friendly: no
+        # repeat/reshape layout casts)
+        m1, m2, l1, l2, acc = carry
         kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
             jnp.float32)
         vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
@@ -76,24 +78,30 @@ def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
             col = kb * block_k + jnp.mod(jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 2 * block_k), 1), block_k)
             s2 = jnp.where(row >= col, s2, NEG_INF)
-        seg = s2.reshape(block_q, 2, block_k)
-        m_cur = jnp.max(seg, axis=2)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)               # [bq, 2]
-        p = jnp.exp(seg - m_new[:, :, None])
-        l_new = alpha * l_prev + jnp.sum(p, axis=2)
-        alpha_lanes = jnp.repeat(alpha, d, axis=1)    # [bq, 128]
-        acc = acc * alpha_lanes + jax.lax.dot_general(
-            p.reshape(block_q, 2 * block_k), v_bd,
+        s_a = s2[:, :block_k]
+        s_b = s2[:, block_k:]
+        m1n = jnp.maximum(m1, jnp.max(s_a, axis=1, keepdims=True))
+        m2n = jnp.maximum(m2, jnp.max(s_b, axis=1, keepdims=True))
+        a1 = jnp.exp(m1 - m1n)
+        a2 = jnp.exp(m2 - m2n)
+        p_a = jnp.exp(s_a - m1n)
+        p_b = jnp.exp(s_b - m2n)
+        l1n = a1 * l1 + jnp.sum(p_a, axis=1, keepdims=True)
+        l2n = a2 * l2 + jnp.sum(p_b, axis=1, keepdims=True)
+        scaled = jnp.concatenate([acc[:, :d] * a1, acc[:, d:] * a2], 1)
+        acc = scaled + jax.lax.dot_general(
+            jnp.concatenate([p_a, p_b], 1), v_bd,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc
+        return m1n, m2n, l1n, l2n, acc
 
-    m0 = jnp.full((block_q, 2), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 2), jnp.float32)
+    neg = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    zero = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d2), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.repeat(l, d, axis=1)).astype(o_ref.dtype)
+    m1, m2, l1, l2, acc = jax.lax.fori_loop(
+        0, hi, body, (neg, neg, zero, zero, acc0))
+    o_ref[0] = jnp.concatenate([acc[:, :d] / l1, acc[:, d:] / l2],
+                               1).astype(o_ref.dtype)
 
 
 def packed_flash_fwd(q, k, v, causal, scale, block_q, block_k):
