@@ -399,7 +399,15 @@ def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
 
 
 def main() -> int:
-    model_name = os.environ.get("BENCH_MODEL", "gpt2-medium")
+    # default flagship: the 1.112B d=128 config — the largest geometry
+    # that trains at batch 8 on one v5e chip (measured capacity curve in
+    # PERF.md); it needs the grads-internal contract + per-block
+    # recompute, which become defaults for it (override any of these
+    # with the usual env knobs)
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-1p1b")
+    if model_name == "gpt2-1p1b":
+        os.environ.setdefault("BENCH_RECOMPUTE", "1")
+        os.environ.setdefault("BENCH_NO_RETAIN_GRADS", "1")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     default_batch = {"resnet50": "128", "widedeep": "512",

@@ -31,14 +31,25 @@ def latest_bench():
         data["tail"].strip().splitlines()[-1])
 
 
+_FLAGSHIP_NAMES = {
+    "gpt2_345m_mfu": "GPT-2 345M",
+    "gpt2-medium_mfu": "GPT-2 345M",
+    "gpt2-1p1b_mfu": "GPT-2-class 1.1B (d=128)",
+    "gpt2-1p3b_mfu": "GPT-2-class 1.3B (d=128)",
+}
+
+
 def headline(parsed, src):
     toks = parsed.get("tokens_per_sec_per_chip")
+    name = _FLAGSHIP_NAMES.get(parsed.get("metric"),
+                               parsed.get("metric", "flagship"))
     return (
-        f"- GPT-2 345M training at **{parsed['value']:.2f}% MFU** "
+        f"- {name} training at **{parsed['value']:.2f}% MFU** "
         f"(batch {parsed['batch']}, seq {parsed['seq']}, bf16, bf16 AdamW "
-        f"moments; {toks / 1000:.1f}k tokens/s/chip) — above the 40% "
-        f"north-star target — via the Pallas flash-attention kernels + "
-        f"trace-once compiled train step. "
+        f"moments; {toks / 1000:.1f}k tokens/s/chip) — "
+        f"{parsed['vs_baseline']:.2f}x the 40% north-star target — via "
+        f"the Pallas flash-attention kernels + trace-once compiled train "
+        f"step. "
         f"[generated from {os.path.basename(src)}]"
     )
 
@@ -50,8 +61,8 @@ def main():
     args = p.parse_args()
 
     src, parsed = latest_bench()
-    if parsed.get("metric") != "gpt2_345m_mfu":
-        print(f"latest artifact is {parsed.get('metric')}, not the GPT "
+    if parsed.get("metric") not in _FLAGSHIP_NAMES:
+        print(f"latest artifact is {parsed.get('metric')}, not a GPT "
               "flagship; nothing to sync")
         return 0
     want = headline(parsed, src)
@@ -62,7 +73,7 @@ def main():
     # the generated bullet: starts "- GPT-2 345M training" and ends with
     # the "[generated from ...]" stamp (possibly wrapped over lines)
     pat = re.compile(
-        r"- GPT-2 345M training at[^\n]*(?:\n(?!-)[^\n]*)*")
+        r"- GPT[^\n]*training at[^\n]*(?:\n(?!-)[^\n]*)*")
     m = pat.search(text)
     if not m:
         raise SystemExit("README GPT headline bullet not found")
