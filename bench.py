@@ -405,7 +405,7 @@ def main() -> int:
     # recompute, which become defaults for it (override any of these
     # with the usual env knobs)
     model_name = os.environ.get("BENCH_MODEL", "gpt2-1p1b")
-    if model_name == "gpt2-1p1b":
+    if model_name in ("gpt2-1p1b", "gpt2-1p3b"):
         os.environ.setdefault("BENCH_RECOMPUTE", "1")
         os.environ.setdefault("BENCH_NO_RETAIN_GRADS", "1")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))

@@ -112,6 +112,7 @@ _ds_mod.imikolov = _compat_dataset.imikolov
 _ds_mod.cifar = _compat_dataset.cifar
 _ds_mod.conll05 = _compat_dataset.conll05
 _ds_mod.movielens = _compat_dataset.movielens
+_ds_mod.wmt14 = _compat_dataset.wmt14
 
 
 def __getattr__(name):

@@ -380,7 +380,46 @@ def _make_movielens():
     return mod
 
 
+def _make_wmt14():
+    """seq2seq fixture over the padded design: fixed-length (src, trg,
+    trg_next) id windows with a deterministic src->trg mapping so the
+    decoder is learnable."""
+    mod = _types.ModuleType("paddle_tpu.dataset.wmt14")
+    SRC_LEN, TRG_LEN = 8, 6
+
+    def get_dict(dict_size):
+        d = {f"w{i}": i for i in range(dict_size)}
+        return d, d
+
+    def _rows(n, dict_size, seed):
+        rng = np.random.RandomState(seed)
+        vocab = min(dict_size, 200)
+        tmap = np.random.RandomState(9).randint(2, vocab, vocab)
+        for _ in range(n):
+            src = rng.randint(2, vocab, SRC_LEN).astype(np.int64)
+            trg = np.concatenate([[1], tmap[src[:TRG_LEN - 1]]]) \
+                .astype(np.int64)              # <s> + mapped prefix
+            trg_next = np.concatenate([trg[1:], [0]]).astype(np.int64)
+            yield src, trg, trg_next
+
+    def train(dict_size):
+        def r():
+            yield from _rows(600, dict_size, seed=0)
+        return r
+
+    def test(dict_size):
+        def r():
+            yield from _rows(100, dict_size, seed=1)
+        return r
+
+    mod.get_dict = get_dict
+    mod.train = train
+    mod.test = test
+    return mod
+
+
 dataset = _types.ModuleType("paddle_tpu.dataset_compat")
+dataset.wmt14 = _make_wmt14()
 dataset.uci_housing = _make_uci_housing()
 dataset.mnist = _make_mnist()
 dataset.imikolov = _make_imikolov()

@@ -1,0 +1,162 @@
+"""DynamicRNN (layers/dynamic_rnn.py): the record-once/unroll-T design
+vs a hand-rolled per-step build — same ops, same params, same numbers.
+Reference: fluid.layers.DynamicRNN (layers/control_flow.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import (Executor, Program, Scope,
+                                  program_guard, unique_name)
+from paddle_tpu.initializer import NormalInitializer
+
+
+def _attr(name, seed):
+    return pt.ParamAttr(name=name,
+                        initializer=NormalInitializer(0.0, 0.5, seed))
+
+
+def _run(main, startup, feed, fetch):
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    return np.asarray(exe.run(main, feed=feed,
+                              fetch_list=[fetch.name], scope=scope)[0])
+
+
+def test_dynamic_rnn_matches_manual_unroll():
+    b, t, d, h = 3, 5, 4, 6
+    rng = np.random.RandomState(0)
+    seq = rng.randn(b, t, d).astype(np.float32)
+    boot = rng.randn(b, h).astype(np.float32)
+
+    # DynamicRNN build
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 11
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [t, d])
+        h0 = layers.data("h0", [h])
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            step = rnn.step_input(x)
+            mem = rnn.memory(init=h0)
+            new = layers.fc([mem, step], size=h, act="tanh",
+                            param_attr=[_attr("w_mem", 7),
+                                        _attr("w_in", 8)],
+                            bias_attr=_attr("b", 9))
+            rnn.update_memory(mem, new)
+            rnn.output(new)
+        out = rnn()
+        assert out.shape == (-1, t, h)
+        red = layers.reduce_sum(out, dim=None)
+    got = _run(main, startup, {"x": seq, "h0": boot}, out)
+
+    # hand-rolled twin with the SAME param names/seeds
+    main2, startup2 = Program(), Program()
+    main2.random_seed = startup2.random_seed = 11
+    with program_guard(main2, startup2), unique_name.guard():
+        x = layers.data("x", [t, d])
+        h0 = layers.data("h0", [h])
+        cur = h0
+        steps = []
+        for i in range(t):
+            sl = layers.squeeze(
+                layers.slice(x, axes=[1], starts=[i], ends=[i + 1]),
+                [1])
+            sl.shape = (-1, d)
+            cur = layers.fc([cur, sl], size=h, act="tanh",
+                            param_attr=[_attr("w_mem", 7),
+                                        _attr("w_in", 8)],
+                            bias_attr=_attr("b", 9))
+            steps.append(cur)
+        out2 = layers.stack(steps, axis=1)
+    want = _run(main2, startup2, {"x": seq, "h0": boot}, out2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_dynamic_rnn_trains():
+    """Gradients flow through the unrolled steps into the shared
+    weights (one parameter set, T uses)."""
+    b, t, d, h = 4, 4, 3, 5
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 3
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [t, d])
+        h0 = layers.data("h0", [h])
+        y = layers.data("y", [1])
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            step = rnn.step_input(x)
+            mem = rnn.memory(init=h0)
+            new = layers.fc([mem, step], size=h, act="tanh",
+                            param_attr=[pt.ParamAttr(name="wm"),
+                                        pt.ParamAttr(name="wi")])
+            rnn.update_memory(mem, new)
+            rnn.output(new)
+        outs = rnn()
+        last = layers.squeeze(
+            layers.slice(outs, axes=[1], starts=[t - 1], ends=[t]), [1])
+        last.shape = (-1, h)
+        pred = layers.fc(last, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(b, t, d).astype(np.float32)
+    h0b = np.zeros((b, h), np.float32)
+    yb = rng.randn(b, 1).astype(np.float32)
+    losses = [float(exe.run(main,
+                            feed={"x": xb, "h0": h0b, "y": yb},
+                            fetch_list=[loss.name], scope=scope)[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_dynamic_rnn_guardrails():
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [4, 3])
+        h0 = layers.data("h0", [5])
+        rnn = layers.DynamicRNN()
+        with pytest.raises(RuntimeError, match="block"):
+            rnn()
+        with rnn.block():
+            rnn.step_input(x)
+            mem = rnn.memory(init=h0)
+            rnn.output(mem)
+        with pytest.raises(RuntimeError, match="update_memory"):
+            rnn()
+
+
+def test_dynamic_rnn_implicit_static_input():
+    """Outer vars captured directly in the block (without
+    static_input) behave as implicit static inputs — the reference
+    DynamicRNN tolerance."""
+    b, t, d = 2, 3, 4
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 5
+    with program_guard(main, startup), unique_name.guard():
+        x = layers.data("x", [t, d])
+        ctx = layers.data("ctx", [d])
+        h0 = layers.data("h0", [d])
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            step = rnn.step_input(x)
+            mem = rnn.memory(init=h0)
+            new = layers.elementwise_add(
+                layers.elementwise_add(step, ctx), mem)  # ctx captured
+            rnn.update_memory(mem, new)
+            rnn.output(new)
+        out = rnn()
+    rng = np.random.RandomState(0)
+    xb = rng.randn(b, t, d).astype(np.float32)
+    cb = rng.randn(b, d).astype(np.float32)
+    hb = np.zeros((b, d), np.float32)
+    got = _run(main, startup, {"x": xb, "ctx": cb, "h0": hb}, out)
+    want = np.zeros((b, t, d), np.float32)
+    acc = hb.copy()
+    for i in range(t):
+        acc = xb[:, i] + cb + acc
+        want[:, i] = acc
+    np.testing.assert_allclose(got, want, rtol=1e-5)

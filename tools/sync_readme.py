@@ -41,15 +41,19 @@ _FLAGSHIP_NAMES = {
 
 def headline(parsed, src):
     toks = parsed.get("tokens_per_sec_per_chip")
-    name = _FLAGSHIP_NAMES.get(parsed.get("metric"),
-                               parsed.get("metric", "flagship"))
+    metric = parsed.get("metric")
+    name = _FLAGSHIP_NAMES.get(metric, metric or "flagship")
+    via = ("the Pallas flash-attention kernels + per-block recompute + "
+           "grads-internal trace-once compiled train step"
+           if "1p" in (metric or "") else
+           "the Pallas flash-attention kernels + trace-once compiled "
+           "train step")
     return (
         f"- {name} training at **{parsed['value']:.2f}% MFU** "
         f"(batch {parsed['batch']}, seq {parsed['seq']}, bf16, bf16 AdamW "
         f"moments; {toks / 1000:.1f}k tokens/s/chip) — "
         f"{parsed['vs_baseline']:.2f}x the 40% north-star target — via "
-        f"the Pallas flash-attention kernels + trace-once compiled train "
-        f"step. "
+        f"{via}. "
         f"[generated from {os.path.basename(src)}]"
     )
 
