@@ -532,6 +532,20 @@ def build_fluid_module():
     nets.img_conv_group = img_conv_group
     nets.sequence_conv_pool = sequence_conv_pool
     fluid.nets = nets
+
+    regularizer = _types.ModuleType("paddle_tpu.fluid.regularizer")
+
+    def _l2(regularization_coeff=0.0, **kw):
+        return _pt.optimizer.L2Decay(regularization_coeff)
+
+    def _l1(regularization_coeff=0.0, **kw):
+        return _pt.optimizer.L1Decay(regularization_coeff)
+
+    regularizer.L2DecayRegularizer = _l2
+    regularizer.L1DecayRegularizer = _l1
+    regularizer.L2Decay = _l2
+    regularizer.L1Decay = _l1
+    fluid.regularizer = regularizer
     fluid.core = _types.ModuleType("paddle_tpu.fluid.core")
     fluid.core.CPUPlace = CPUPlace
     fluid.core.CUDAPlace = CUDAPlace

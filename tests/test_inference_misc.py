@@ -86,7 +86,9 @@ def test_op_bench_cli():
          "--input", "X:64x64:float32", "--input", "Y:64x64:float32",
          "--repeat", "3", "--warmup", "1",
          "--flops", str(2 * 64**3)],
-        cwd=REPO, capture_output=True, text=True, timeout=120,
+        # generous: CI hosts run suites + benches concurrently and a
+        # cold jax import alone can take tens of seconds under load
+        cwd=REPO, capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-1500:]
     line = [ln for ln in proc.stdout.splitlines()
