@@ -77,6 +77,7 @@ class CompiledProgram:
         self._mesh = None
         self._data_axis = "dp"
         self._cache = {}
+        self._verified_programs = set()  # FLAGS_check_program memo
         self._nprng = np.random.RandomState(1234)
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
@@ -176,6 +177,22 @@ class _ParallelRunner:
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
+
+        from . import flags as _flags
+        if _flags.get_flag("check_program"):
+            # same one-time static verify as Executor._build — the
+            # SPMD path must fail with IR coordinates too
+            vkey = (id(program), program._version)
+            if vkey not in self.c._verified_programs:
+                from .framework.analysis import verify_program
+                verify_program(
+                    program,
+                    feeds=set(feed_arrays) | set(scope.all_var_names()),
+                    fetches=fetch_names,
+                ).raise_if_errors(
+                    f"FLAGS_check_program: first parallel compile of "
+                    f"{program!r}")
+                self.c._verified_programs.add(vkey)
 
         block = program.global_block()
         state_in, written = _collect_io(block, feed_arrays.keys(), scope)

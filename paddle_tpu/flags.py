@@ -46,6 +46,25 @@ def define_flag(name: str, default, help_str: str = ""):
                        "help": help_str}
 
 
+def _unknown_flag_error(names) -> ValueError:
+    """A typo must fail loudly — silently creating/ignoring flag state
+    hides misconfiguration (e.g. ``check_nan_if`` for ``check_nan_inf``).
+    Suggest the closest registered names."""
+    import difflib
+    with _lock:
+        known = sorted(_defs)
+    hints = []
+    for n in names:
+        close = difflib.get_close_matches(n, known, n=1)
+        if close:
+            hints.append(f"did you mean {close[0]!r}?")
+    hint = (" " + " ".join(hints)) if hints else ""
+    return ValueError(
+        f"unknown flag(s) {sorted(names)!r}.{hint} "
+        f"({len(known)} flags registered; "
+        f"paddle_tpu.flags.list_flags() enumerates them)")
+
+
 def get_flags(names):
     """Return {name: value} for a flag name or list of names."""
     if isinstance(names, str):
@@ -53,7 +72,7 @@ def get_flags(names):
     out = {}
     for name in names:
         if name not in _defs:
-            raise ValueError(f"unknown flag {name!r}")
+            raise _unknown_flag_error([name])
         with _lock:
             if name in _values:
                 out[name] = _values[name]
@@ -72,7 +91,7 @@ def set_flags(flags: Dict[str, Any]):
     global _version
     unknown = [n for n in flags if n not in _defs]
     if unknown:
-        raise ValueError(f"unknown flag(s) {unknown!r}")
+        raise _unknown_flag_error(unknown)
     coerced = {n: _coerce(v, _defs[n]["type"]) for n, v in flags.items()}
     with _lock:
         _values.update(coerced)
@@ -108,3 +127,14 @@ define_flag("pallas_flash_block_q", 512,
             "largest power-of-two divisor of seq).")
 define_flag("pallas_flash_block_k", 512,
             "Flash-attention k-block size (tuning knob).")
+define_flag("check_program", False,
+            "Run the static Program verifier (framework/analysis.py) "
+            "once per program at its first executor/compiler compile; "
+            "ERROR diagnostics abort the run with block/op locations "
+            "instead of an opaque tracer error. Default off in "
+            "production; tests/conftest.py turns it on for the suite.")
+define_flag("check_ir_passes", False,
+            "Verify the Program IR after every pass in a "
+            "PassManager.apply pipeline; a failure names the offending "
+            "pass. The safety net for IR-rewriting passes (fusion, "
+            "sharding, recompute).")

@@ -8,3 +8,6 @@ from .executor import Executor
 from .backward import append_backward, gradients
 from . import unique_name
 from . import ir
+from . import analysis
+from .analysis import (Diagnostic, ProgramVerifyError, VerifyResult,
+                       verify_program)

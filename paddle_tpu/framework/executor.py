@@ -160,6 +160,10 @@ class Executor:
         # state through the scope should enable it.
         self.donate_state = donate_state
         self._cache: Dict[Any, Any] = {}
+        # (id(program), version) pairs already verified under
+        # FLAGS_check_program — the verify is once per program, not once
+        # per (feed-shape, fetch-set) compile
+        self._verified_programs: set = set()
         # serialize cache-miss builds: concurrent hogwild workers racing
         # a miss must not duplicate minutes of XLA compilation
         self._build_lock = threading.Lock()
@@ -410,7 +414,26 @@ class Executor:
             raw = int(self._nprng.randint(0, 2**31 - 1))
         return jax.random.PRNGKey(raw)
 
+    def _verify_once(self, program: Program, feed_arrays, fetch_names,
+                     scope):
+        """FLAGS_check_program hook: static-verify the program at its
+        first compile (framework/analysis.py), so a malformed IR fails
+        with block/op coordinates instead of a tracer error. Names held
+        by the scope count as feeds — state residency is a runtime
+        property the static check must not second-guess."""
+        key = (id(program), program._version)
+        if key in self._verified_programs:
+            return
+        from .analysis import verify_program
+        feeds = set(feed_arrays) | set(scope.all_var_names())
+        verify_program(program, feeds=feeds,
+                       fetches=fetch_names).raise_if_errors(
+            f"FLAGS_check_program: first compile of {program!r}")
+        self._verified_programs.add(key)
+
     def _build(self, program: Program, feed_arrays, fetch_names, scope):
+        if _flags.get_flag("check_program"):
+            self._verify_once(program, feed_arrays, fetch_names, scope)
         block = program.global_block()
         state_in, written = _collect_io(block, feed_arrays.keys(), scope)
         runner = _BlockRunner(program)

@@ -221,14 +221,19 @@ def registered_passes() -> List[str]:
 
 
 def apply_pass(program: Program, name: str, **attrs) -> Program:
-    """One-shot: Program -> graph -> pass -> Program."""
-    graph = IrGraph(program)
-    new_pass(name, **attrs).apply(graph)
-    return graph.to_program()
+    """One-shot: Program -> graph -> pass -> Program (honors
+    FLAGS_check_ir_passes like any one-pass PassManager pipeline)."""
+    return PassManager([new_pass(name, **attrs)]).apply(program)
 
 
 class PassManager:
-    """Ordered pass pipeline (ir_pass_manager / PassBuilder analog)."""
+    """Ordered pass pipeline (ir_pass_manager / PassBuilder analog).
+
+    Under ``FLAGS_check_ir_passes`` the Program IR is verified
+    (framework/analysis.py) before the first pass and after every pass;
+    a failing verification raises with the name of the offending pass —
+    the bisection step the reference does by hand with
+    GraphViz dumps per pass."""
 
     def __init__(self, passes: Sequence = ()):
         self._passes: List[Pass] = [
@@ -243,10 +248,31 @@ class PassManager:
         return list(self._passes)
 
     def apply(self, program: Program) -> Program:
+        from .. import flags as _flags
+        check = bool(_flags.get_flag("check_ir_passes"))
         graph = IrGraph(program)
+        if check:
+            # verify the input too: a program broken BEFORE the
+            # pipeline must not be pinned on the first pass
+            self._verify(graph, None)
         for p in self._passes:
             p.apply(graph)
+            if check:
+                self._verify(graph, p.name)
         return graph.to_program()
+
+    @staticmethod
+    def _verify(graph: IrGraph, pass_name: Optional[str]):
+        from .analysis import verify_program
+        result = verify_program(graph._program)
+        if not result.ok():
+            for d in result.diagnostics:
+                d.pass_name = pass_name
+            where = (f"IR pass {pass_name!r} broke the program"
+                     if pass_name else
+                     "program was already invalid before the first pass")
+            result.raise_if_errors(
+                f"{where} (FLAGS_check_ir_passes=true)")
 
 
 # ---------------------------------------------------------------------------

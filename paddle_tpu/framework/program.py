@@ -492,6 +492,17 @@ class Program:
         h = hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
         return h
 
+    def verify(self, feeds: Sequence[str] = (), fetches=None,
+               checks=None):
+        """Run the static analysis suite (framework/analysis.py) over
+        this program; returns a ``VerifyResult`` of structured
+        ``Diagnostic`` records — it never raises on findings (call
+        ``.raise_if_errors()`` for the fail-fast form the executor and
+        PassManager integrations use)."""
+        from .analysis import verify_program
+        return verify_program(self, feeds=feeds, fetches=fetches,
+                              checks=checks)
+
     def __repr__(self):
         nops = sum(len(b.ops) for b in self.blocks)
         return f"Program(blocks={len(self.blocks)}, ops={nops})"

@@ -11,6 +11,13 @@ through jax.config.update before any backend is touched.
 
 import os
 
+# The static Program verifier runs at first compile for every program
+# the suite executes (FLAGS_check_program is read from the env at first
+# access; default off in production, on under tests). The book programs
+# in test_book.py thereby double as the verifier's end-to-end positive
+# sweep — see tests/test_program_verifier.py.
+os.environ.setdefault("FLAGS_check_program", "1")
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()

@@ -28,6 +28,25 @@ def test_flags_get_set_and_unknown():
         flags.set_flags({"no_such_flag": 1})
 
 
+def test_verifier_flags_registered():
+    got = flags.get_flags(["check_program", "check_ir_passes"])
+    assert set(got) == {"check_program", "check_ir_passes"}
+    # default off in production; conftest turns check_program on for the
+    # suite via the FLAGS_ env override, so only assert the type here
+    assert all(isinstance(v, bool) for v in got.values())
+
+
+def test_unknown_flag_suggests_closest_name():
+    with pytest.raises(ValueError) as ei:
+        flags.set_flags({"check_programs": True})
+    msg = str(ei.value)
+    assert "check_programs" in msg
+    assert "did you mean 'check_program'?" in msg
+    with pytest.raises(ValueError) as ei:
+        flags.get_flags(["check_nan_if"])
+    assert "did you mean 'check_nan_inf'?" in str(ei.value)
+
+
 def test_flags_env_override(monkeypatch):
     flags.define_flag("test_only_env_flag", 7, "test")
     monkeypatch.setenv("FLAGS_test_only_env_flag", "13")

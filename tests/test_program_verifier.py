@@ -1,0 +1,355 @@
+"""Static program verifier (framework/analysis.py).
+
+Negative fixtures: each class of IR corruption is flagged with the
+correct check name, block index, and op index. Positive sweep: the
+eight graph-only book builders (tools/book_programs.py) verify with
+zero errors — the verifier's zero-false-positive bar. The end-to-end
+leg of the sweep is tests/test_book.py itself: conftest.py defaults
+FLAGS_check_program on, so every book program is verified at its first
+executor compile.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import flags
+from paddle_tpu.framework import (Executor, Program, ProgramVerifyError,
+                                  Scope, verify_program)
+from paddle_tpu.framework import ir
+from paddle_tpu.framework.analysis import ANALYSIS_CHECKS
+
+
+def _find(result, check, severity=None):
+    return [d for d in result.diagnostics
+            if d.check == check
+            and (severity is None or d.severity == severity)]
+
+
+# ---------------------------------------------------------------------
+# negative fixtures — one corruption class per test
+# ---------------------------------------------------------------------
+
+
+def test_undefined_input_var():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    blk.create_var("z")
+    blk.append_op("elementwise_add", {"X": "y", "Y": "ghost"},
+                  {"Out": "z"})
+    result = prog.verify()
+    (d,) = _find(result, "dataflow.def-before-use", "error")
+    assert (d.block_idx, d.op_idx, d.var) == (0, 1, "ghost")
+
+
+def test_unregistered_op_type():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"})
+    blk.append_op("definitely_not_an_op", {"X": "y"}, {"Out": "z"})
+    blk.create_var("z")
+    result = prog.verify()
+    (d,) = _find(result, "structural.registered-ops", "error")
+    assert (d.block_idx, d.op_idx) == (0, 1)
+
+
+def test_derived_grad_op_is_not_unregistered():
+    """`<fw>_grad` with a registered forward op gets a vjp-derived
+    lowering (registry.execute) — not an unregistered-op error."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("tanh", {"X": "x"}, {"Out": "y"})
+    blk.create_var("y@GRAD", is_data=True)
+    blk.create_var("x@GRAD")
+    blk.append_op("tanh_grad", {"Out": ["y"], "Out@GRAD": ["y@GRAD"]},
+                  {"X@GRAD": ["x@GRAD"]})
+    assert not _find(prog.verify(), "structural.registered-ops")
+
+
+def test_dangling_sub_block_index():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("c", is_data=True)
+    blk.append_op("while", {"Condition": "c"}, {}, {"sub_block": 7})
+    result = prog.verify()
+    diags = _find(result, "structural.sub-blocks", "error")
+    assert any((d.block_idx, d.op_idx) == (0, 0) and "7" in d.message
+               for d in diags)
+
+
+def test_cyclic_sub_block_graph():
+    prog = Program()
+    b0 = prog.global_block()
+    b1 = prog._create_block()      # block 1, parent 0
+    prog._rollback()
+    b0.create_var("c", is_data=True)
+    b0.append_op("while", {"Condition": "c"}, {}, {"sub_block": 1})
+    # corruption: the nested block points back at its ancestor
+    b1.append_op("while", {"Condition": "c"}, {}, {"sub_block": 0})
+    result = prog.verify()
+    assert any("cyclic" in d.message
+               for d in _find(result, "structural.sub-blocks", "error"))
+
+
+def test_bad_slot_shape_and_dtype():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    op = blk.append_op("scale", {"X": "x"}, {"Out": "y"})
+    op.inputs["X"] = "x"            # corruption: string, not list
+    # create_var normalizes dtypes up front, so corrupt after the fact
+    blk.create_var("w").dtype = "float13"
+    result = prog.verify()
+    (d,) = _find(result, "structural.slot-shape", "error")
+    assert (d.block_idx, d.op_idx) == (0, 0)
+    (d,) = _find(result, "structural.dtypes", "error")
+    assert d.var == "w"
+
+
+def test_write_after_write():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 3.0})
+    blk.create_var("z")
+    blk.append_op("scale", {"X": "y"}, {"Out": "z"})
+    result = prog.verify()
+    (d,) = _find(result, "dataflow.write-after-write", "warning")
+    assert (d.block_idx, d.op_idx, d.var) == (0, 1, "y")
+    assert not result.errors
+
+
+def test_dead_op_only_with_fetches():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    blk.create_var("dead")
+    blk.append_op("scale", {"X": "x"}, {"Out": "dead"}, {"scale": 3.0})
+    # without fetch roots the check is skipped — any var may be a fetch
+    assert not _find(prog.verify(), "dataflow.dead-code")
+    result = prog.verify(fetches=["y"])
+    dead_ops = [d for d in _find(result, "dataflow.dead-code", "warning")
+                if d.op_idx is not None]
+    assert [(d.block_idx, d.op_idx) for d in dead_ops] == [(0, 1)]
+    assert not result.errors
+
+
+def test_grad_pairing():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("x@GRAD", is_data=True)
+    blk.create_var("orphan@GRAD", is_data=True)
+    blk.create_var("s")
+    blk.append_op("elementwise_add",
+                  {"X": "x@GRAD", "Y": "orphan@GRAD"}, {"Out": "s"})
+    result = prog.verify()
+    (d,) = _find(result, "gradient.grad-pairing", "error")
+    assert (d.block_idx, d.op_idx, d.var) == (0, 0, "orphan@GRAD")
+
+
+def test_registry_contract():
+    prog = Program()
+    blk = prog.global_block()
+    for n in ("W", "Ids", "Out@GRAD", "W@GRAD", "Ids@GRAD", "Mask",
+              "X", "Out", "X@GRAD"):
+        blk.create_var(n, is_data=True)
+    # c_embedding declares no_grad_slots=("Ids",): an integer-id slot
+    # must not get a gradient output
+    blk.append_op(
+        "c_embedding_grad",
+        {"W": ["W"], "Ids": ["Ids"], "Out@GRAD": ["Out@GRAD"]},
+        {"W@GRAD": ["W@GRAD"], "Ids@GRAD": ["Ids@GRAD"]})
+    # dropout declares grad_needs_outputs=("Mask",): the saved mask must
+    # be wired into the grad op
+    blk.append_op("dropout_grad", {"Out@GRAD": ["Out@GRAD"]},
+                  {"X@GRAD": ["X@GRAD"]})
+    result = prog.verify(checks=["gradient.registry-contract"])
+    (err,) = result.errors
+    assert (err.op_idx, err.var) == (0, "Ids@GRAD")
+    assert "no_grad_slots" in err.message
+    (warn,) = result.warnings
+    assert warn.op_idx == 1 and "Mask" in warn.message
+
+
+def test_unknown_check_name_rejected():
+    with pytest.raises(ValueError, match="no-such-check"):
+        verify_program(Program(), checks=["no-such-check"])
+
+
+def test_clean_program_and_check_registry():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    result = prog.verify(fetches=["y"])
+    assert result.ok() and not result.diagnostics
+    assert "program verifies clean" in result.summary()
+    # every registered check ran — the registry is the single source of
+    # truth for README generation and the `checks=` selector
+    assert set(ANALYSIS_CHECKS) >= {
+        "structural.registered-ops", "structural.slot-shape",
+        "structural.sub-blocks", "structural.dtypes",
+        "dataflow.def-before-use", "dataflow.write-after-write",
+        "dataflow.dead-code", "gradient.grad-pairing",
+        "gradient.registry-contract"}
+
+
+# ---------------------------------------------------------------------
+# executor integration (FLAGS_check_program)
+# ---------------------------------------------------------------------
+
+
+def test_executor_rejects_broken_program_at_first_compile():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("elementwise_add", {"X": "x", "Y": "ghost"},
+                  {"Out": "y"})
+    exe = Executor()
+    old = flags.get_flag("check_program")
+    try:
+        flags.set_flags({"check_program": True})
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(prog, feed={"x": np.ones((2,), np.float32)},
+                    fetch_list=["y"], scope=Scope())
+        assert "ghost" in str(ei.value)
+        assert "FLAGS_check_program" in str(ei.value)
+    finally:
+        flags.set_flags({"check_program": old})
+
+
+def test_executor_verify_honors_scope_state():
+    """Scope-resident vars count as defined: a program reading a var the
+    caller materialized in the scope (but no op produces) must pass."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("w", persistable=True)
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("elementwise_add", {"X": "x", "Y": "w"}, {"Out": "y"})
+    scope = Scope()
+    scope.set_var("w", np.full((2,), 3.0, np.float32))
+    exe = Executor()
+    old = flags.get_flag("check_program")
+    try:
+        flags.set_flags({"check_program": True})
+        (out,) = exe.run(prog, feed={"x": np.ones((2,), np.float32)},
+                         fetch_list=["y"], scope=scope)
+    finally:
+        flags.set_flags({"check_program": old})
+    np.testing.assert_allclose(out, 4.0)
+
+
+# ---------------------------------------------------------------------
+# PassManager integration (FLAGS_check_ir_passes)
+# ---------------------------------------------------------------------
+
+
+@ir.register_pass("_test_drop_producer_pass")
+def _drop_producer(graph):
+    # deliberately corrupt the IR: drop the op that produces 'y'
+    del graph._program.global_block().ops[0]
+
+
+def _two_op_program():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True)
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    blk.create_var("z")
+    blk.append_op("scale", {"X": "y"}, {"Out": "z"}, {"scale": 3.0})
+    return prog
+
+
+def test_broken_ir_pass_is_named():
+    prog = _two_op_program()
+    pm = ir.PassManager(["_test_drop_producer_pass"])
+    old = flags.get_flag("check_ir_passes")
+    try:
+        flags.set_flags({"check_ir_passes": False})
+        pm.apply(prog)  # unchecked: corruption passes through silently
+        flags.set_flags({"check_ir_passes": True})
+        with pytest.raises(ProgramVerifyError) as ei:
+            pm.apply(prog)
+    finally:
+        flags.set_flags({"check_ir_passes": old})
+    msg = str(ei.value)
+    assert "_test_drop_producer_pass" in msg
+    assert "def-before-use" in msg
+    assert all(d.pass_name == "_test_drop_producer_pass"
+               for d in ei.value.result.diagnostics)
+
+
+def test_pre_broken_program_not_blamed_on_first_pass():
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "nowhere"}, {"Out": "y"})
+    old = flags.get_flag("check_ir_passes")
+    try:
+        flags.set_flags({"check_ir_passes": True})
+        with pytest.raises(ProgramVerifyError) as ei:
+            ir.PassManager(["fuse_elewise_add_act_pass"]).apply(prog)
+    finally:
+        flags.set_flags({"check_ir_passes": old})
+    msg = str(ei.value)
+    assert "already invalid before the first pass" in msg
+    assert "fuse_elewise_add_act_pass" not in msg
+
+
+def test_real_pass_pipeline_stays_clean_under_check():
+    """The shipped passes must not trip the verifier on a real program."""
+    prog = _two_op_program()
+    old = flags.get_flag("check_ir_passes")
+    try:
+        flags.set_flags({"check_ir_passes": True})
+        out = ir.PassManager(
+            ["fuse_elewise_add_act_pass",
+             "delete_dropout_op_pass"]).apply(prog)
+    finally:
+        flags.set_flags({"check_ir_passes": old})
+    assert verify_program(out).ok()
+
+
+# ---------------------------------------------------------------------
+# positive sweep — the eight book programs verify clean
+# ---------------------------------------------------------------------
+
+
+def test_book_programs_verify_clean():
+    from tools.book_programs import build_all
+    names = []
+    for name, main, startup, fetches in build_all():
+        names.append(name)
+        result = main.verify(fetches=fetches)
+        assert result.ok(), f"{name} main: {result.summary()}"
+        assert not result.warnings, f"{name} main: {result.summary()}"
+        sresult = startup.verify()
+        assert sresult.ok(), f"{name} startup: {sresult.summary()}"
+        # startup warnings are allowed — and for programs sharing one
+        # embedding table they are a true positive: each layer re-runs
+        # the shared param's initializer (write-after-write)
+        for d in sresult.warnings:
+            assert d.check == "dataflow.write-after-write", str(d)
+    assert len(names) == 8

@@ -1,0 +1,476 @@
+"""Graph-only builders for the eight book programs.
+
+Each builder constructs the same Program IR as the corresponding
+end-to-end test in tests/test_book.py — layers, backward pass, and
+optimizer update ops included — but stops before the training loop, so
+building all eight takes well under a second and never touches the
+executor.  They exist so tools/lint_program.py (and the CI lint step)
+can run the static verifier in paddle_tpu/framework/analysis.py over
+realistic whole-model IR, including nested DynamicRNN sub-blocks,
+without paying for training.  tests/test_program_verifier.py asserts
+every builder verifies clean; keep a builder's geometry in sync with
+its test_book.py twin when either changes.
+
+Each builder returns (main_program, startup_program, fetch_names);
+fetch_names are the variables the training loop would fetch, which the
+verifier uses as dead-code roots.
+"""
+
+from collections import OrderedDict
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import Program, program_guard, unique_name
+
+fluid = paddle.fluid
+
+BOOK_BUILDERS = OrderedDict()
+
+
+def _register(fn):
+    BOOK_BUILDERS[fn.__name__] = fn
+    return fn
+
+
+@_register
+def fit_a_line():
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.001).minimize(avg_cost)
+    return main, startup, [avg_cost.name]
+
+
+@_register
+def recognize_digits_conv():
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        conv_pool_1 = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=20, pool_size=2,
+            pool_stride=2, act="relu")
+        conv_pool_1 = fluid.layers.batch_norm(conv_pool_1)
+        conv_pool_2 = fluid.nets.simple_img_conv_pool(
+            input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
+            pool_stride=2, act="relu")
+        prediction = fluid.layers.fc(input=conv_pool_2, size=10,
+                                     act='softmax')
+        loss = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        fluid.optimizer.Adam(learning_rate=0.001).minimize(avg_loss)
+    return main, startup, [avg_loss.name, acc.name]
+
+
+@_register
+def word2vec():
+    EMBED_SIZE, HIDDEN_SIZE = 32, 256
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        word_dict = paddle.dataset.imikolov.build_dict()
+        dict_size = len(word_dict)
+        words = [fluid.layers.data(name=n, shape=[1], dtype='int64')
+                 for n in ('firstw', 'secondw', 'thirdw', 'forthw',
+                           'nextw')]
+
+        def emb(w):
+            return fluid.layers.embedding(
+                input=w, size=[dict_size, EMBED_SIZE], dtype='float32',
+                is_sparse=True, param_attr='shared_w')
+
+        concat_embed = fluid.layers.concat(
+            input=[emb(w) for w in words[:4]], axis=1)
+        hidden1 = fluid.layers.fc(input=concat_embed, size=HIDDEN_SIZE,
+                                  act='sigmoid')
+        predict_word = fluid.layers.fc(input=hidden1, size=dict_size,
+                                       act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict_word,
+                                          label=words[4])
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+    return main, startup, [avg_cost.name]
+
+
+@_register
+def image_classification():
+    def conv_bn_layer(input, ch_out, filter_size, stride, padding,
+                      act='relu', bias_attr=False):
+        tmp = fluid.layers.conv2d(input=input, filter_size=filter_size,
+                                  num_filters=ch_out, stride=stride,
+                                  padding=padding, act=None,
+                                  bias_attr=bias_attr)
+        return fluid.layers.batch_norm(input=tmp, act=act)
+
+    def shortcut(input, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return input
+
+    def basicblock(input, ch_in, ch_out, stride):
+        tmp = conv_bn_layer(input, ch_out, 3, stride, 1)
+        tmp = conv_bn_layer(tmp, ch_out, 3, 1, 1, act=None,
+                            bias_attr=True)
+        short = shortcut(input, ch_in, ch_out, stride)
+        return fluid.layers.elementwise_add(x=tmp, y=short, act='relu')
+
+    def layer_warp(block_func, input, ch_in, ch_out, count, stride):
+        tmp = block_func(input, ch_in, ch_out, stride)
+        for _ in range(1, count):
+            tmp = block_func(tmp, ch_out, ch_out, 1)
+        return tmp
+
+    depth = 8
+    n = (depth - 2) // 6
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                                   dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        conv1 = conv_bn_layer(input=images, ch_out=16, filter_size=3,
+                              stride=1, padding=1)
+        res1 = layer_warp(basicblock, conv1, 16, 16, n, 1)
+        res2 = layer_warp(basicblock, res1, 16, 32, n, 2)
+        res3 = layer_warp(basicblock, res2, 32, 64, n, 2)
+        pool = fluid.layers.pool2d(input=res3, pool_size=8,
+                                   pool_type='avg', pool_stride=1)
+        predict = fluid.layers.fc(input=pool, size=10, act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=predict, label=label)
+        fluid.optimizer.Adam(learning_rate=0.001).minimize(avg_cost)
+    return main, startup, [avg_cost.name, acc.name]
+
+
+@_register
+def label_semantic_roles():
+    word_dict, verb_dict, label_dict = paddle.dataset.conll05.get_dict()
+    word_dict_len, label_dict_len = len(word_dict), len(label_dict)
+    pred_dict_len = len(verb_dict)
+    mark_dict_len, word_dim, mark_dim = 2, 16, 5
+    hidden_dim, depth, maxlen = 64, 4, 12
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        names = ['word_data', 'ctx_n2_data', 'ctx_n1_data', 'ctx_0_data',
+                 'ctx_p1_data', 'ctx_p2_data', 'verb_data', 'mark_data']
+        feeds = [fluid.layers.data(name=n, shape=[maxlen], dtype='int64')
+                 for n in names]
+        target = fluid.layers.data(name='target', shape=[maxlen],
+                                   dtype='int64')
+        seq_len = fluid.layers.data(name='seq_len', shape=[],
+                                    dtype='int64')
+        (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate,
+         mark) = feeds
+
+        predicate_embedding = fluid.layers.embedding(
+            input=predicate, size=[pred_dict_len, word_dim],
+            dtype='float32', param_attr='vemb')
+        mark_embedding = fluid.layers.embedding(
+            input=mark, size=[mark_dict_len, mark_dim], dtype='float32')
+        emb_layers = [
+            fluid.layers.embedding(
+                size=[word_dict_len, word_dim], input=x,
+                param_attr=fluid.ParamAttr(name='emb'))
+            for x in (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2)]
+        emb_layers += [predicate_embedding, mark_embedding]
+
+        hidden_0 = fluid.layers.sums(input=[
+            fluid.layers.fc(input=emb, size=hidden_dim,
+                            num_flatten_dims=2)
+            for emb in emb_layers])
+        lstm_0, _ = fluid.layers.dynamic_lstm(
+            input=hidden_0, size=hidden_dim, sequence_length=seq_len,
+            candidate_activation='relu', gate_activation='sigmoid',
+            cell_activation='sigmoid')
+
+        input_tmp = [hidden_0, lstm_0]
+        for i in range(1, depth):
+            mix_hidden = fluid.layers.sums(input=[
+                fluid.layers.fc(input=input_tmp[0], size=hidden_dim,
+                                num_flatten_dims=2),
+                fluid.layers.fc(input=input_tmp[1], size=hidden_dim,
+                                num_flatten_dims=2)])
+            lstm, _ = fluid.layers.dynamic_lstm(
+                input=mix_hidden, size=hidden_dim,
+                sequence_length=seq_len,
+                candidate_activation='relu', gate_activation='sigmoid',
+                cell_activation='sigmoid', is_reverse=((i % 2) == 1))
+            input_tmp = [mix_hidden, lstm]
+
+        feature_out = fluid.layers.sums(input=[
+            fluid.layers.fc(input=input_tmp[0], size=label_dict_len,
+                            num_flatten_dims=2, act='tanh'),
+            fluid.layers.fc(input=input_tmp[1], size=label_dict_len,
+                            num_flatten_dims=2, act='tanh')])
+
+        transition = fluid.layers.create_parameter(
+            shape=[label_dict_len + 2, label_dict_len], dtype='float32',
+            name='crfw')
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=feature_out, label=target, param_attr=transition,
+            length=seq_len)
+        avg_cost = fluid.layers.mean(crf_cost)
+        crf_decode = fluid.layers.crf_decoding(
+            input=feature_out, param_attr=transition, length=seq_len)
+
+        fluid.optimizer.SGD(
+            learning_rate=fluid.layers.exponential_decay(
+                learning_rate=0.01, decay_steps=100000,
+                decay_rate=0.5, staircase=True)).minimize(avg_cost)
+    return main, startup, [avg_cost.name, crf_decode.name]
+
+
+@_register
+def recommender_system():
+    layers, nets = fluid.layers, fluid.nets
+    IS_SPARSE = True
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        seq4_len = layers.data(name='seq4_len', shape=[], dtype='int64')
+
+        USR_DICT_SIZE = paddle.dataset.movielens.max_user_id() + 1
+        uid = layers.data(name='user_id', shape=[1], dtype='int64')
+        usr_emb = layers.embedding(
+            input=uid, dtype='float32', size=[USR_DICT_SIZE, 32],
+            param_attr='user_table', is_sparse=IS_SPARSE)
+        usr_fc = layers.fc(input=usr_emb, size=32)
+
+        usr_gender_id = layers.data(name='gender_id', shape=[1],
+                                    dtype='int64')
+        usr_gender_emb = layers.embedding(
+            input=usr_gender_id, size=[2, 16],
+            param_attr='gender_table', is_sparse=IS_SPARSE)
+        usr_gender_fc = layers.fc(input=usr_gender_emb, size=16)
+
+        USR_AGE_DICT_SIZE = len(paddle.dataset.movielens.age_table)
+        usr_age_id = layers.data(name='age_id', shape=[1], dtype="int64")
+        usr_age_emb = layers.embedding(
+            input=usr_age_id, size=[USR_AGE_DICT_SIZE, 16],
+            is_sparse=IS_SPARSE, param_attr='age_table')
+        usr_age_fc = layers.fc(input=usr_age_emb, size=16)
+
+        USR_JOB_DICT_SIZE = paddle.dataset.movielens.max_job_id() + 1
+        usr_job_id = layers.data(name='job_id', shape=[1], dtype="int64")
+        usr_job_emb = layers.embedding(
+            input=usr_job_id, size=[USR_JOB_DICT_SIZE, 16],
+            param_attr='job_table', is_sparse=IS_SPARSE)
+        usr_job_fc = layers.fc(input=usr_job_emb, size=16)
+
+        usr = layers.fc(
+            input=layers.concat(
+                input=[usr_fc, usr_gender_fc, usr_age_fc, usr_job_fc],
+                axis=-1),
+            size=200, act="tanh")
+        usr = layers.reshape(usr, [-1, 200])
+
+        MOV_DICT_SIZE = paddle.dataset.movielens.max_movie_id() + 1
+        mov_id = layers.data(name='movie_id', shape=[1], dtype='int64')
+        mov_emb = layers.embedding(
+            input=mov_id, dtype='float32', size=[MOV_DICT_SIZE, 32],
+            param_attr='movie_table', is_sparse=IS_SPARSE)
+        mov_fc = layers.fc(input=mov_emb, size=32)
+
+        CATEGORY_DICT_SIZE = len(
+            paddle.dataset.movielens.movie_categories())
+        category_id = layers.data(name='category_id', shape=[4],
+                                  dtype='int64')
+        mov_categories_emb = layers.embedding(
+            input=category_id, size=[CATEGORY_DICT_SIZE, 32],
+            is_sparse=IS_SPARSE)
+        mov_categories_hidden = layers.sequence_pool(
+            input=mov_categories_emb, pool_type="sum",
+            sequence_length=seq4_len)
+
+        MOV_TITLE_DICT_SIZE = len(
+            paddle.dataset.movielens.get_movie_title_dict())
+        mov_title_id = layers.data(name='movie_title', shape=[4],
+                                   dtype='int64')
+        mov_title_emb = layers.embedding(
+            input=mov_title_id, size=[MOV_TITLE_DICT_SIZE, 32],
+            is_sparse=IS_SPARSE)
+        mov_title_conv = nets.sequence_conv_pool(
+            input=mov_title_emb, num_filters=32, filter_size=3,
+            act="tanh", pool_type="sum", sequence_length=seq4_len)
+
+        mov = layers.fc(
+            input=layers.concat(
+                input=[mov_fc, mov_categories_hidden, mov_title_conv],
+                axis=-1),
+            size=200, act="tanh")
+
+        inference = layers.cos_sim(X=usr, Y=mov)
+        scale_infer = layers.scale(x=inference, scale=5.0)
+        label = layers.data(name='score', shape=[1], dtype='float32')
+        square_cost = layers.square_error_cost(input=scale_infer,
+                                               label=label)
+        avg_cost = layers.mean(square_cost)
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(avg_cost)
+    return main, startup, [avg_cost.name]
+
+
+@_register
+def rnn_encoder_decoder():
+    dict_size, hidden_dim, embedding_dim = 200, 32, 16
+    encoder_size = decoder_size = hidden_dim
+    SRC_LEN, TRG_LEN = 8, 6
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        def bi_lstm_encoder(input_seq, hidden_size, seq_len):
+            input_forward_proj = fluid.layers.fc(
+                input=input_seq, size=hidden_size * 4,
+                num_flatten_dims=2, bias_attr=True)
+            forward, _ = fluid.layers.dynamic_lstm(
+                input=input_forward_proj, size=hidden_size * 4,
+                sequence_length=seq_len, use_peepholes=False)
+            input_backward_proj = fluid.layers.fc(
+                input=input_seq, size=hidden_size * 4,
+                num_flatten_dims=2, bias_attr=True)
+            backward, _ = fluid.layers.dynamic_lstm(
+                input=input_backward_proj, size=hidden_size * 4,
+                is_reverse=True, sequence_length=seq_len,
+                use_peepholes=False)
+            forward_last = fluid.layers.sequence_last_step(
+                input=forward, sequence_length=seq_len)
+            backward_first = fluid.layers.sequence_first_step(
+                input=backward, sequence_length=seq_len)
+            return forward_last, backward_first
+
+        def lstm_step(x_t, hidden_t_prev, cell_t_prev, size):
+            def linear(inputs):
+                return fluid.layers.fc(input=inputs, size=size,
+                                       bias_attr=True)
+
+            forget_gate = fluid.layers.sigmoid(
+                linear([hidden_t_prev, x_t]))
+            input_gate = fluid.layers.sigmoid(
+                linear([hidden_t_prev, x_t]))
+            output_gate = fluid.layers.sigmoid(
+                linear([hidden_t_prev, x_t]))
+            cell_tilde = fluid.layers.tanh(linear([hidden_t_prev, x_t]))
+            cell_t = fluid.layers.sums(input=[
+                fluid.layers.elementwise_mul(x=forget_gate,
+                                             y=cell_t_prev),
+                fluid.layers.elementwise_mul(x=input_gate,
+                                             y=cell_tilde)])
+            hidden_t = fluid.layers.elementwise_mul(
+                x=output_gate, y=fluid.layers.tanh(cell_t))
+            return hidden_t, cell_t
+
+        src_word_idx = fluid.layers.data(name='source_sequence',
+                                         shape=[SRC_LEN], dtype='int64')
+        src_len = fluid.layers.data(name='src_len', shape=[],
+                                    dtype='int64')
+        src_embedding = fluid.layers.embedding(
+            input=src_word_idx, size=[dict_size, embedding_dim],
+            dtype='float32')
+        src_forward_last, src_backward_first = bi_lstm_encoder(
+            src_embedding, encoder_size, src_len)
+        encoded_vector = fluid.layers.concat(
+            input=[src_forward_last, src_backward_first], axis=1)
+        decoder_boot = fluid.layers.fc(input=src_backward_first,
+                                       size=decoder_size,
+                                       bias_attr=False, act='tanh')
+        trg_word_idx = fluid.layers.data(name='target_sequence',
+                                         shape=[TRG_LEN], dtype='int64')
+        trg_embedding = fluid.layers.embedding(
+            input=trg_word_idx, size=[dict_size, embedding_dim],
+            dtype='float32')
+
+        rnn = fluid.layers.DynamicRNN()
+        cell_init = fluid.layers.fill_constant_batch_size_like(
+            input=decoder_boot, value=0.0, shape=[-1, decoder_size],
+            dtype='float32')
+        cell_init.stop_gradient = False
+        with rnn.block():
+            current_word = rnn.step_input(trg_embedding)
+            context_in = rnn.static_input(encoded_vector)
+            hidden_mem = rnn.memory(init=decoder_boot, need_reorder=True)
+            cell_mem = rnn.memory(init=cell_init)
+            decoder_inputs = fluid.layers.concat(
+                input=[context_in, current_word], axis=1)
+            h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem,
+                             decoder_size)
+            rnn.update_memory(hidden_mem, h)
+            rnn.update_memory(cell_mem, c)
+            out = fluid.layers.fc(input=h, size=dict_size,
+                                  bias_attr=True, act='softmax')
+            rnn.output(out)
+        prediction = rnn()
+
+        label = fluid.layers.data(name='label_sequence',
+                                  shape=[TRG_LEN], dtype='int64')
+        flat_pred = fluid.layers.reshape(prediction, [-1, dict_size])
+        flat_label = fluid.layers.reshape(label, [-1, 1])
+        cost = fluid.layers.cross_entropy(input=flat_pred,
+                                          label=flat_label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adagrad(learning_rate=0.05).minimize(avg_cost)
+    return main, startup, [avg_cost.name]
+
+
+@_register
+def machine_translation_train():
+    pd = fluid.layers
+    dict_size, hidden_dim, word_dim = 200, 32, 16
+    decoder_size = hidden_dim
+    SRC_LEN, TRG_LEN = 8, 6
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        src_word_id = pd.data(name="src_word_id", shape=[SRC_LEN],
+                              dtype='int64')
+        src_len = pd.data(name="src_len", shape=[], dtype='int64')
+        src_embedding = pd.embedding(
+            input=src_word_id, size=[dict_size, word_dim],
+            dtype='float32', is_sparse=True,
+            param_attr=fluid.ParamAttr(name='vemb'))
+        fc1 = pd.fc(input=src_embedding, size=hidden_dim * 4,
+                    num_flatten_dims=2, act='tanh')
+        lstm_hidden0, _ = pd.dynamic_lstm(
+            input=fc1, size=hidden_dim * 4, sequence_length=src_len)
+        context = pd.sequence_last_step(input=lstm_hidden0,
+                                        sequence_length=src_len)
+
+        trg_language_word = pd.data(name="target_language_word",
+                                    shape=[TRG_LEN], dtype='int64')
+        trg_embedding = pd.embedding(
+            input=trg_language_word, size=[dict_size, word_dim],
+            dtype='float32', is_sparse=True,
+            param_attr=fluid.ParamAttr(name='vemb'))
+        rnn = pd.DynamicRNN()
+        with rnn.block():
+            current_word = rnn.step_input(trg_embedding)
+            pre_state = rnn.memory(init=context)
+            current_state = pd.fc(
+                input=[current_word, pre_state], size=decoder_size,
+                act='tanh')
+            current_score = pd.fc(input=current_state, size=dict_size,
+                                  act='softmax')
+            rnn.update_memory(pre_state, current_state)
+            rnn.output(current_score)
+        rnn_out = rnn()
+
+        label = pd.data(name="target_language_next_word",
+                        shape=[TRG_LEN], dtype='int64')
+        cost = pd.cross_entropy(
+            input=pd.reshape(rnn_out, [-1, dict_size]),
+            label=pd.reshape(label, [-1, 1]))
+        avg_cost = pd.mean(cost)
+        fluid.optimizer.Adagrad(
+            learning_rate=0.05,
+            regularization=fluid.regularizer.L2DecayRegularizer(
+                regularization_coeff=1e-4)).minimize(avg_cost)
+    return main, startup, [avg_cost.name]
+
+
+def build_all():
+    """Yield (name, main, startup, fetch_names) for all eight programs."""
+    for name, builder in BOOK_BUILDERS.items():
+        main, startup, fetches = builder()
+        yield name, main, startup, fetches

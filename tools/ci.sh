@@ -4,18 +4,21 @@
 # pure-python+native-extension tree):
 #
 #   1. import smoke (the package must import with no toolchain at all)
-#   2. full test suite on the virtual 8-device CPU mesh
-#   3. op coverage gate (>= 80% of the reference forward-op surface)
-#   4. API-freeze check (public signature snapshot diff)
-#   5. multi-chip dry-run (GSPMD train step on N virtual devices)
-#   6. README headline vs latest bench artifact (no drift)
+#   2. lint: static program verifier over the eight book programs +
+#      op-registry grad-contract diff vs the committed baseline
+#   3. full test suite on the virtual 8-device CPU mesh
+#   4. op coverage gate (>= 80% of the reference forward-op surface)
+#   5. API-freeze check (public signature snapshot diff)
+#   6. multi-chip dry-run (GSPMD train step on N virtual devices)
+#   7. README headline vs latest bench artifact (no drift)
 #
-# Usage: tools/ci.sh [quick]   — `quick` skips the full suite (smoke only)
+# Usage: tools/ci.sh [quick]   — `quick` skips the full suite; lint and
+# the other static gates still run
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/6 import smoke"
+echo "== 1/7 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -24,25 +27,29 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
+echo "== 2/7 lint (program verifier + op-desc compat)"
+JAX_PLATFORMS=cpu python tools/lint_program.py --books
+JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
+
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 2/6 test suite (virtual 8-device CPU mesh)"
+  echo "== 3/7 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 2/6 test suite: SKIPPED (quick mode)"
+  echo "== 3/7 test suite: SKIPPED (quick mode)"
 fi
 
-echo "== 3/6 op coverage gate"
+echo "== 4/7 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 4/6 API freeze"
+echo "== 5/7 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -61,14 +68,14 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 5/6 multi-chip dry run"
+echo "== 6/7 multi-chip dry run"
 python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('   8-device GSPMD train step ok')
 "
 
-echo "== 6/6 README headline sync"
+echo "== 7/7 README headline sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

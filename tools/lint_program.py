@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Lint Fluid programs with the static verifier (framework/analysis.py).
+
+Two input modes:
+
+    python tools/lint_program.py prog.json [more.json ...]
+        Each file is a serialized Program (Program.to_json()); dead-code
+        analysis is skipped because a serialized program carries no
+        fetch list.
+
+    python tools/lint_program.py --books
+        Build the eight book programs (tools/book_programs.py) and lint
+        each main+startup pair, with the training fetches as dead-code
+        roots. This is the CI lint gate's zero-false-positive sweep.
+
+Exit status 1 if any program has errors; --strict also fails on
+warnings. --verbose prints every diagnostic of clean programs too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def lint_one(label, program, feeds=(), fetches=None, strict=False,
+             verbose=False):
+    """Verify one program; print diagnostics; return True if it passes."""
+    result = program.verify(feeds=feeds, fetches=fetches)
+    failed = bool(result.errors) or (strict and result.warnings)
+    shown = result.diagnostics if (failed or verbose) else ()
+    for d in shown:
+        print(f"  {d}")
+    print(f"{'FAIL' if failed else 'ok'}: {label} — {result.summary()}")
+    return not failed
+
+
+def lint_books(strict, verbose):
+    from tools.book_programs import build_all
+    ok = True
+    for name, main, startup, fetches in build_all():
+        ok &= lint_one(f"{name} (main)", main, fetches=fetches,
+                       strict=strict, verbose=verbose)
+        ok &= lint_one(f"{name} (startup)", startup, strict=strict,
+                       verbose=verbose)
+    return ok
+
+
+def lint_files(paths, strict, verbose):
+    from paddle_tpu.framework import Program
+    ok = True
+    for path in paths:
+        with open(path) as f:
+            program = Program.from_json(f.read())
+        ok &= lint_one(path, program, strict=strict, verbose=verbose)
+    return ok
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "lint_program",
+        description="Static checks over serialized or book programs.")
+    p.add_argument("files", nargs="*",
+                   help="serialized Program JSON files to lint")
+    p.add_argument("--books", action="store_true",
+                   help="lint the eight book programs instead of files")
+    p.add_argument("--strict", action="store_true",
+                   help="treat warnings as fatal too")
+    p.add_argument("--verbose", action="store_true",
+                   help="print diagnostics even for passing programs")
+    args = p.parse_args(argv)
+    if args.books == bool(args.files):
+        p.error("pass either JSON files or --books (exactly one)")
+    if args.books:
+        ok = lint_books(args.strict, args.verbose)
+    else:
+        ok = lint_files(args.files, args.strict, args.verbose)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

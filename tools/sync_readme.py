@@ -1,14 +1,17 @@
 #!/usr/bin/env python
-"""Regenerate README headline numbers from the latest BENCH_r*.json.
+"""Regenerate README's generated fragments from their sources of truth.
 
 Three rounds in a row the hand-written README headline drifted from the
-measured artifact; this makes the artifact the single source of truth:
+measured artifact; this makes the artifacts the single source of truth:
 
-    python tools/sync_readme.py          # rewrite the GPT headline line
+    python tools/sync_readme.py          # rewrite generated fragments
     python tools/sync_readme.py --check  # exit 1 on drift (CI gate)
 
-The GPT flagship bullet between the BEGIN/END markers is generated;
-everything else in README.md stays hand-written.
+Two fragments are generated, everything else stays hand-written:
+  - the GPT flagship headline bullet (from the latest BENCH_r*.json)
+  - the "Static program checks" list between the
+    `<!-- BEGIN GENERATED: verifier-checks -->` markers (from
+    framework/analysis.py:ANALYSIS_CHECKS + the registered flags)
 """
 
 import argparse
@@ -58,22 +61,14 @@ def headline(parsed, src):
     )
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--check", action="store_true",
-                   help="fail on drift instead of rewriting")
-    args = p.parse_args()
-
+def sync_headline(text, check):
+    """Returns (new_text, drift_message_or_None)."""
     src, parsed = latest_bench()
     if parsed.get("metric") not in _FLAGSHIP_NAMES:
         print(f"latest artifact is {parsed.get('metric')}, not a GPT "
-              "flagship; nothing to sync")
-        return 0
+              "flagship; headline left alone")
+        return text, None
     want = headline(parsed, src)
-
-    readme = os.path.join(REPO, "README.md")
-    with open(readme) as f:
-        text = f.read()
     # the generated bullet: starts "- GPT-2 345M training" and ends with
     # the "[generated from ...]" stamp (possibly wrapped over lines)
     pat = re.compile(
@@ -88,16 +83,86 @@ def main():
         want, width=76, initial_indent="", subsequent_indent="  "))
     if current.strip() == wrapped.strip():
         print("README headline in sync")
-        return 0
-    if args.check:
-        print("README headline DRIFTS from the bench artifact:\n"
-              f"  readme: {' '.join(current.split())[:100]}...\n"
-              f"  artifact: {' '.join(wrapped.split())[:100]}...")
-        return 1
-    text = text[:m.start()] + wrapped + text[m.end():]
-    with open(readme, "w") as f:
-        f.write(text)
+        return text, None
+    if check:
+        return text, (
+            "README headline DRIFTS from the bench artifact:\n"
+            f"  readme: {' '.join(current.split())[:100]}...\n"
+            f"  artifact: {' '.join(wrapped.split())[:100]}...")
     print(f"README headline updated from {os.path.basename(src)}")
+    return text[:m.start()] + wrapped + text[m.end():], None
+
+
+_CHECKS_BEGIN = "<!-- BEGIN GENERATED: verifier-checks -->"
+_CHECKS_END = "<!-- END GENERATED: verifier-checks -->"
+_VERIFIER_FLAGS = ("check_program", "check_ir_passes")
+
+
+def render_checks_block():
+    """The verifier-check list, from the live check registry + flags."""
+    import textwrap
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu import flags
+    from paddle_tpu.framework.analysis import ANALYSIS_CHECKS
+
+    def bullet(head, body):
+        return "\n".join(textwrap.wrap(
+            f"- {head} — {body}", width=76, subsequent_indent="  "))
+
+    lines = ["Checks (`Program.verify(checks=[...])` selects a subset):",
+             ""]
+    lines += [bullet(f"`{name}`", cd.description)
+              for name, cd in ANALYSIS_CHECKS.items()]
+    lines += ["", "Flags:", ""]
+    defs = flags.list_flags()
+    for name in _VERIFIER_FLAGS:
+        d = defs[name]
+        lines.append(bullet(
+            f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
+    return "\n".join(lines)
+
+
+def sync_checks_block(text, check):
+    """Returns (new_text, drift_message_or_None)."""
+    try:
+        b = text.index(_CHECKS_BEGIN) + len(_CHECKS_BEGIN)
+        e = text.index(_CHECKS_END)
+    except ValueError:
+        raise SystemExit("README verifier-checks markers not found")
+    current = text[b:e].strip("\n")
+    want = render_checks_block()
+    if current == want:
+        print("README verifier-checks block in sync")
+        return text, None
+    if check:
+        return text, ("README verifier-checks block DRIFTS from "
+                      "framework/analysis.py — rerun tools/sync_readme.py")
+    print("README verifier-checks block regenerated")
+    return text[:b] + "\n" + want + "\n" + text[e:], None
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--check", action="store_true",
+                   help="fail on drift instead of rewriting")
+    args = p.parse_args()
+
+    readme = os.path.join(REPO, "README.md")
+    with open(readme) as f:
+        text = f.read()
+    orig = text
+    drifts = []
+    for sync in (sync_headline, sync_checks_block):
+        text, drift = sync(text, args.check)
+        if drift:
+            drifts.append(drift)
+    if drifts:
+        print("\n".join(drifts))
+        return 1
+    if text != orig:
+        with open(readme, "w") as f:
+            f.write(text)
     return 0
 
 
