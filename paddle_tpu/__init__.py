@@ -43,6 +43,7 @@ from .hapi import Model
 from . import monitor
 from . import profiler
 from . import incubate
+from . import resilience
 from . import reader
 from . import inference
 from . import enforce

@@ -14,6 +14,8 @@ from typing import List
 
 from ..dygraph.tape import run_op
 from ..dygraph.tensor import Tensor
+from ..resilience.injector import fault_point, injector_active
+from ..resilience.retry import RetryPolicy
 
 
 class ReduceOp:
@@ -25,8 +27,19 @@ class ReduceOp:
 
 
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: int = 0):
-    out = run_op(f"c_allreduce_{op}", {"X": [tensor]},
-                 {"ring_id": group})["Out"][0]
+    def _attempt():
+        # chaos hook: an injected `drop` stands in for an ICI/ring
+        # transport hiccup; in eager mode the reduce is side-effect
+        # free until set_value, so replaying the attempt is safe
+        fault_point("collective.allreduce")
+        return run_op(f"c_allreduce_{op}", {"X": [tensor]},
+                      {"ring_id": group})["Out"][0]
+    if injector_active():
+        out = RetryPolicy.from_flags(
+            site="collective.allreduce",
+            retry_on=(ConnectionError,)).call(_attempt)
+    else:
+        out = _attempt()
     tensor.set_value(out.value)
     return out
 

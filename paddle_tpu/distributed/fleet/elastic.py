@@ -24,7 +24,8 @@ import os
 import time
 from typing import Callable, Optional, Sequence
 
-from ...incubate.checkpoint import CheckpointSaver
+from ... import monitor as _monitor
+from ...incubate.checkpoint import CheckpointCorruptError, CheckpointSaver
 
 
 class ElasticStatus:
@@ -98,6 +99,7 @@ class ElasticManager:
                 ctx.join()
                 return ElasticStatus.COMPLETED
             self.restarts += 1
+            _monitor.stat_add("STAT_elastic_restarts")
             if self.restarts > self._max_restarts:
                 return ElasticStatus.FAILED
             self._fails_at_size += 1
@@ -105,15 +107,24 @@ class ElasticManager:
                     and self.nprocs > self._min_nprocs):
                 self.nprocs -= 1
                 self._fails_at_size = 0
+                _monitor.stat_add("STAT_elastic_scale_in")
             self.generation += 1
 
 
 def resume_epoch(ckpt_root: str, name: str = "elastic_ckpt") -> int:
-    """First epoch a restarted worker should run (last saved + 1, or 0)
-    — the auto_checkpoint.py `_get_last_epoch` analog."""
+    """First epoch a restarted worker should run (last saved VALID
+    epoch + 1, or 0) — the auto_checkpoint.py `_get_last_epoch` analog.
+    A corrupt latest checkpoint resolves to the previous valid one
+    (replaying an epoch beats resuming from state that won't load);
+    all-corrupt resolves to 0."""
     saver = CheckpointSaver(ckpt_root, name=name)
-    latest = saver.latest()
-    return 0 if latest is None else int(latest) + 1
+    try:
+        _state, meta = saver.load()
+    except CheckpointCorruptError:
+        return 0
+    if meta is None:
+        return 0
+    return int(meta.get("epoch", meta["number"])) + 1
 
 
 __all__ = ["ElasticManager", "ElasticStatus", "resume_epoch"]

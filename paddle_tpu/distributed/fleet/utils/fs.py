@@ -15,9 +15,25 @@ import shutil
 import subprocess
 from typing import List, Optional, Tuple
 
+from ....resilience.injector import fault_point
+from ....resilience.retry import RetryPolicy
+
 
 class ExecuteError(Exception):
     pass
+
+
+def _write_guard(fn, *args, retry_on=(OSError, ConnectionError)):
+    """Run one mutating fs operation through the shared resilience
+    plane: the ``fs.write`` fault site fires first (chaos specs), then
+    RetryPolicy absorbs transient failures (flaky NFS/GCS-fuse — the
+    checkpoint tiers all write through here). Non-transient OSErrors
+    (FileNotFoundError etc.) pass straight through."""
+    def attempt():
+        fault_point("fs.write")
+        return fn(*args)
+    return RetryPolicy.from_flags(site="fs.write",
+                                  retry_on=retry_on).call(attempt)
 
 
 class FSFileExistsError(Exception):
@@ -76,16 +92,18 @@ class LocalFS(FS):
         return os.path.exists(path)
 
     def mkdirs(self, path):
-        os.makedirs(path, exist_ok=True)
+        _write_guard(lambda: os.makedirs(path, exist_ok=True))
 
     def delete(self, path):
-        if self.is_dir(path):
-            shutil.rmtree(path)
-        elif self.is_file(path):
-            os.remove(path)
+        def _do():
+            if self.is_dir(path):
+                shutil.rmtree(path)
+            elif self.is_file(path):
+                os.remove(path)
+        _write_guard(_do)
 
     def rename(self, src, dst):
-        os.rename(src, dst)
+        _write_guard(os.rename, src, dst)
 
     def mv(self, src, dst, overwrite: bool = False):
         if not self.is_exist(src):
@@ -94,21 +112,23 @@ class LocalFS(FS):
             if not overwrite:
                 raise FSFileExistsError(dst)
             self.delete(dst)
-        shutil.move(src, dst)
+        _write_guard(shutil.move, src, dst)
 
     def touch(self, path, exist_ok: bool = True):
         if self.is_exist(path):
             if not exist_ok:
                 raise FSFileExistsError(path)
             return
-        with open(path, "a"):
-            pass
+        def _do():
+            with open(path, "a"):
+                pass
+        _write_guard(_do)
 
     def upload(self, local_path, fs_path):
-        shutil.copy(local_path, fs_path)
+        _write_guard(shutil.copy, local_path, fs_path)
 
     def download(self, fs_path, local_path):
-        shutil.copy(fs_path, local_path)
+        _write_guard(shutil.copy, fs_path, local_path)
 
 
 class HDFSClient(FS):
@@ -166,20 +186,27 @@ class HDFSClient(FS):
         except ExecuteError:
             return False
 
+    def _run_write(self, *cmd) -> str:
+        """Mutating commands go through the fs.write site + retry (a
+        flaky namenode answer shouldn't abort a checkpoint); probes
+        like ``-test`` stay un-retried — their failures ARE answers."""
+        return _write_guard(self._run, *cmd,
+                            retry_on=(ExecuteError, OSError))
+
     def mkdirs(self, path):
-        self._run("-mkdir", "-p", str(path))
+        self._run_write("-mkdir", "-p", str(path))
 
     def delete(self, path):
-        self._run("-rm", "-r", "-f", str(path))
+        self._run_write("-rm", "-r", "-f", str(path))
 
     def rename(self, src, dst):
-        self._run("-mv", str(src), str(dst))
+        self._run_write("-mv", str(src), str(dst))
 
     def upload(self, local_path, fs_path):
-        self._run("-put", "-f", str(local_path), str(fs_path))
+        self._run_write("-put", "-f", str(local_path), str(fs_path))
 
     def download(self, fs_path, local_path):
-        self._run("-get", str(fs_path), str(local_path))
+        self._run_write("-get", str(fs_path), str(local_path))
 
 
 __all__ = ["ExecuteError", "FS", "FSFileExistsError",

@@ -73,10 +73,20 @@ class NativePSServer:
 
 
 def make_server(endpoint: str, server_index: int = 0,
-                num_servers: int = 1, prefer_native: bool = True):
-    """Native server when the toolchain allows, Python otherwise."""
+                num_servers: int = 1,
+                prefer_native: Optional[bool] = None):
+    """Native server when the toolchain allows, Python otherwise.
+    ``prefer_native`` defaults to FLAGS_ps_prefer_native; the
+    ``ps.server.start`` fault site forces the fallback path
+    deterministically (an injected error stands in for a missing
+    toolchain), so tests cover it on machines WITH g++."""
+    from ... import flags as _flags
+    from ...resilience.injector import fault_point
+    if prefer_native is None:
+        prefer_native = bool(_flags.get_flag("ps_prefer_native"))
     if prefer_native:
         try:
+            fault_point("ps.server.start")
             return NativePSServer(endpoint, server_index, num_servers)
         except (RuntimeError, OSError):
             pass

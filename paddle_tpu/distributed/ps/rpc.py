@@ -25,6 +25,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ... import flags as _flags
+from ...resilience.injector import fault_point
+from ...resilience.retry import RetryError, RetryPolicy
 from .sparse_table import SparseTable
 
 # ops
@@ -160,7 +163,8 @@ class PSServer:
         # last heartbeat monotonic time
         self._heartbeats: Dict[int, float] = {}
         self._hb_lock = threading.Lock()
-        self.heartbeat_timeout = 30.0
+        self.heartbeat_timeout = float(
+            _flags.get_flag("ps_heartbeat_timeout"))
         self._tcp = _TCPServer((host, int(port)), _Handler)
         self._tcp.ps_server = self
         self._thread: Optional[threading.Thread] = None
@@ -285,44 +289,63 @@ class PSServer:
         return self.tables[name]
 
 
+# ops safe to replay on a dropped/ambiguous connection: reads, liveness,
+# rendezvous, and create (server-side "if not exists"). PUSH and LOAD
+# mutate table state — a replay could apply a gradient twice, so they
+# keep fail-fast semantics and leave dedup to a higher tier.
+_IDEMPOTENT_OPS = frozenset({OP_CREATE, OP_PULL, OP_SIZE, OP_STATE,
+                             OP_BARRIER, OP_HEARTBEAT, OP_WORKER_STATUS})
+
+
 class PSClient:
     """Scatter-gather client over all servers (grpc_client.cc analog).
-    One persistent connection per server, guarded per-connection."""
+    One persistent connection per server, guarded per-connection.
+    Idempotent ops retry transparently through RetryPolicy
+    (FLAGS_retry_*); connection loss mid-call drops and re-dials the
+    socket, so a restarted server is picked up on the next attempt."""
 
     def __init__(self, endpoints: Sequence[str]):
         self.endpoints = list(endpoints)
         self._socks: List[Optional[socket.socket]] = \
             [None] * len(self.endpoints)
         self._locks = [threading.Lock() for _ in self.endpoints]
+        self._closed = False
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
-            import time
             host, port = self.endpoints[i].rsplit(":", 1)
-            # retry with backoff: workers routinely start before their
-            # servers finish binding (grpc channels do the same)
-            deadline = time.monotonic() + 30.0
-            while True:
-                try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=5)
-                    break
-                except ConnectionRefusedError:
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(0.2)
+            # workers routinely start before their servers finish
+            # binding (grpc channels re-dial the same way)
+            connect = RetryPolicy(
+                max_attempts=1000, base_delay=0.2, max_delay=1.0,
+                deadline=float(_flags.get_flag("ps_connect_timeout")),
+                retry_on=(ConnectionRefusedError,),
+                site="ps.rpc.connect")
+            s = connect.call(socket.create_connection,
+                             (host, int(port)), timeout=5)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # longer than the server's worst-case in-handler park (the
             # 60s barrier wait) so a slow barrier can't strand a reply
             # that the next request would then read as its own
-            s.settimeout(90)
+            s.settimeout(float(_flags.get_flag("ps_socket_timeout")))
             self._socks[i] = s
         return self._socks[i]
 
     def _call(self, i: int, op: int, payload: bytes) -> bytes:
+        if op in _IDEMPOTENT_OPS:
+            policy = RetryPolicy.from_flags(
+                site="ps.rpc.call",
+                retry_on=(OSError, EOFError, ConnectionError))
+            return policy.call(self._call_once, i, op, payload)
+        return self._call_once(i, op, payload)
+
+    def _call_once(self, i: int, op: int, payload: bytes) -> bytes:
+        if self._closed:
+            raise RuntimeError("PSClient is closed")
         with self._locks[i]:
             sock = self._sock(i)
             try:
+                fault_point("ps.rpc.call")
                 _send_msg(sock, op, payload)
                 rop, resp = _recv_msg(sock)
             except (OSError, EOFError):
@@ -330,6 +353,8 @@ class PSClient:
                 # its reply later, which would desync the next call
                 try:
                     sock.close()
+                except OSError:
+                    pass
                 finally:
                     self._socks[i] = None
                 raise
@@ -339,12 +364,27 @@ class PSClient:
         return resp
 
     def close(self):
+        """Idempotent; safe concurrently with in-flight calls (they
+        surface a clean 'PSClient is closed' instead of using a socket
+        whose fd may be recycled) and during interpreter shutdown."""
+        self._closed = True
         for i, s in enumerate(self._socks):
             if s is not None:
                 try:
                     s.close()
+                except OSError:
+                    pass
                 finally:
                     self._socks[i] = None
+
+    def __del__(self):
+        # interpreter teardown: modules/attrs may be half-dead — never
+        # let a stray OSError escape a finalizer
+        try:
+            if getattr(self, "_socks", None) is not None:
+                self.close()
+        except Exception:
+            pass
 
     # -- table ops ---------------------------------------------------------
     def create_table(self, name: str, value_dim: int,

@@ -138,3 +138,45 @@ define_flag("check_ir_passes", False,
             "PassManager.apply pipeline; a failure names the offending "
             "pass. The safety net for IR-rewriting passes (fusion, "
             "sharding, recompute).")
+
+# Resilience plane (paddle_tpu/resilience): fault injection + retry +
+# guardian knobs. All deterministic so chaos runs replay exactly.
+define_flag("fault_spec", "",
+            "Deterministic fault-injection spec, "
+            "'site:kind[@trigger];...' (grammar in "
+            "resilience/injector.py). Empty = every fault_point is a "
+            "no-op. PADDLE_TPU_FAULT_SPEC is honored when the flag is "
+            "unset.")
+define_flag("fault_seed", 0,
+            "Seed for probabilistic fault triggers and retry jitter — "
+            "same spec + seed replays the same faults.")
+define_flag("retry_max_attempts", 5,
+            "RetryPolicy: attempts before giving up (first try "
+            "included).")
+define_flag("retry_base_delay", 0.05,
+            "RetryPolicy: first backoff delay in seconds (doubles per "
+            "retry).")
+define_flag("retry_max_delay", 2.0,
+            "RetryPolicy: per-retry backoff cap in seconds.")
+define_flag("retry_deadline", 30.0,
+            "RetryPolicy: wall-clock budget in seconds across all "
+            "attempts of one call.")
+define_flag("guardian_max_skip", 3,
+            "TrainGuardian: consecutive NaN/Inf steps tolerated as "
+            "batch skips before rolling back to the latest "
+            "checkpoint.")
+define_flag("ps_heartbeat_timeout", 30.0,
+            "Seconds without a heartbeat before a PS server reports a "
+            "worker dead (heart_beat_monitor analog; was hardcoded in "
+            "ps/rpc.py).")
+define_flag("ps_connect_timeout", 30.0,
+            "Deadline in seconds for a PS client to reach a server "
+            "that is still binding its port (workers routinely start "
+            "first).")
+define_flag("ps_socket_timeout", 90.0,
+            "PS client socket timeout in seconds; must exceed the "
+            "server's worst-case in-handler park (the 60 s barrier "
+            "wait) so a slow barrier can't strand a reply.")
+define_flag("ps_prefer_native", True,
+            "make_server: try the C++ PS server first, falling back "
+            "to the Python one when the toolchain is unavailable.")

@@ -31,6 +31,7 @@ import numpy as np
 
 from .. import flags as _flags
 from ..ops import registry as _reg
+from ..resilience.injector import fault_point
 from .program import Program, Variable, default_main_program
 from .scope import Scope, global_scope
 
@@ -184,6 +185,14 @@ class Executor:
         return_numpy: bool = True,
     ):
         program = program or default_main_program()
+        # chaos hook: `exec.step:nan@N` simulates the N-th step hitting
+        # a NaN batch (what FLAGS_check_nan_inf would raise); `error`/
+        # `preempt` kinds raise straight through. TrainGuardian's
+        # skip/rollback policy is tested against exactly this site.
+        kind = fault_point("exec.step")
+        if kind == "nan":
+            raise NanInfError(
+                "injected non-finite step (fault spec 'exec.step:nan')")
         # CompiledProgram front door (analog of _run_parallel dispatch)
         if hasattr(program, "_compile_for_executor"):
             return program._compile_for_executor(self).run(

@@ -21,21 +21,49 @@ import json
 import os
 import shutil
 import time
+import warnings
+import zipfile
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from .. import monitor as _monitor
+from ..resilience.injector import fault_point
+from ..resilience.retry import RetryPolicy
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Every candidate checkpoint failed validation."""
 
 
 class CheckpointSaver:
     """Numbered checkpoint dirs with retention (checkpoint_saver.py:53).
 
     Layout: ``<root>/<name>/<step>/{meta.json, state.npz}``.
-    """
+
+    Resilience contract: ``save`` publishes atomically (write to
+    ``<step>.tmp``, ``os.replace``) and retries transient IO errors;
+    orphaned ``.tmp`` dirs from a mid-save death are swept on init;
+    ``load`` VALIDATES the archive + meta and falls back to the
+    previous numbered checkpoint on corruption instead of crashing
+    (counted as ``STAT_ckpt_load_fallback``)."""
 
     def __init__(self, root: str, name: str = "checkpoint",
                  max_num: int = 3):
         self.dir = os.path.join(root, name)
         self.max_num = max_num
+        self._sweep_tmp()
+
+    def _sweep_tmp(self):
+        """Remove ``<step>.tmp`` debris a preempted save left behind —
+        it was never published, so deleting it can't lose state."""
+        if not os.path.isdir(self.dir):
+            return
+        for d in os.listdir(self.dir):
+            if d.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.dir, d),
+                              ignore_errors=True)
+                _monitor.stat_add("STAT_ckpt_tmp_swept")
 
     def _numbers(self) -> List[int]:
         if not os.path.isdir(self.dir):
@@ -50,6 +78,15 @@ class CheckpointSaver:
 
     def save(self, state: Dict[str, np.ndarray], number: int,
              meta: Optional[dict] = None) -> str:
+        """Atomic numbered save, retried on transient IO failure
+        (FLAGS_retry_*). The ``ckpt.save`` fault site can inject an IO
+        error (exercises the retry) or ``corrupt`` (publishes a
+        deliberately broken archive for load-fallback tests)."""
+        return RetryPolicy.from_flags(site="ckpt.save").call(
+            self._save_once, state, number, meta)
+
+    def _save_once(self, state, number, meta):
+        kind = fault_point("ckpt.save")  # may raise InjectedIOError
         path = os.path.join(self.dir, str(number))
         tmp = path + ".tmp"
         os.makedirs(tmp, exist_ok=True)
@@ -58,6 +95,11 @@ class CheckpointSaver:
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"number": number, "time": time.time(),
                        **(meta or {})}, f)
+        if kind == "corrupt":
+            # chaos spec: what a torn write past the atomic-publish
+            # window looks like (e.g. bit rot on the stored archive)
+            with open(os.path.join(tmp, "state.npz"), "wb") as f:
+                f.write(b"not a zip archive")
         if os.path.isdir(path):
             shutil.rmtree(path)
         os.replace(tmp, path)  # atomic publish: partial writes invisible
@@ -74,17 +116,49 @@ class CheckpointSaver:
         nums = self._numbers()
         return nums[-1] if nums else None
 
-    def load(self, number: Optional[int] = None):
-        """-> (state dict, meta dict) of `number` (default latest)."""
-        number = self.latest() if number is None else number
-        if number is None:
-            return None, None
+    def _load_one(self, number: int):
         path = os.path.join(self.dir, str(number))
         data = np.load(os.path.join(path, "state.npz"))
-        state = {k: data[k] for k in data.files}
+        state = {k: data[k] for k in data.files}  # forces a full read
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
+        if not isinstance(meta, dict) or "number" not in meta:
+            raise ValueError(f"meta.json of checkpoint {number} is "
+                             f"missing the 'number' field")
         return state, meta
+
+    def load(self, number: Optional[int] = None):
+        """-> (state dict, meta dict) of `number` (default latest).
+
+        A corrupt candidate (unreadable npz, bad/missing meta.json)
+        falls back to the next older numbered checkpoint with a
+        warning; (None, None) when no checkpoints exist at all;
+        CheckpointCorruptError when candidates exist but none load."""
+        nums = self._numbers()
+        if number is not None:
+            path = os.path.join(self.dir, str(number))
+            if not os.path.isdir(path):
+                raise FileNotFoundError(
+                    f"checkpoint {number} not found under {self.dir}")
+            candidates = [n for n in reversed(nums) if n <= number]
+        else:
+            candidates = list(reversed(nums))
+        if not candidates:
+            return None, None
+        errors = []
+        for n in candidates:
+            try:
+                return self._load_one(n)
+            except (OSError, ValueError, KeyError,
+                    zipfile.BadZipFile, json.JSONDecodeError) as e:
+                errors.append((n, e))
+                _monitor.stat_add("STAT_ckpt_load_fallback")
+                warnings.warn(
+                    f"checkpoint {n} under {self.dir} is corrupt "
+                    f"({e!r}); falling back to the previous one")
+        raise CheckpointCorruptError(
+            f"no loadable checkpoint under {self.dir}: "
+            + "; ".join(f"{n}: {e!r}" for n, e in errors))
 
 
 def _scope_state(scope) -> Dict[str, np.ndarray]:
@@ -123,14 +197,21 @@ class _EpochRange:
         self.scope = scope
         self.saver = CheckpointSaver(root, name, max_num)
         self.save_every = save_every
-        latest = self.saver.latest()
         self.start_epoch = 0
-        if latest is not None:
-            state, meta = self.saver.load(latest)
+        try:
+            # validated load: a corrupt latest falls back to the
+            # previous epoch snapshot (replaying one epoch beats dying)
+            state, meta = self.saver.load()
+        except CheckpointCorruptError as e:
+            warnings.warn(f"auto_checkpoint: {e}; restarting from "
+                          f"epoch 0")
+            state, meta = None, None
+        if state is not None:
             import jax.numpy as jnp
             for k, v in state.items():
                 scope.set_var(k, jnp.asarray(v))
-            self.start_epoch = int(meta.get("epoch", latest)) + 1
+            self.start_epoch = int(
+                meta.get("epoch", meta["number"])) + 1
         self.restored = self.start_epoch > 0
 
     def __iter__(self):

@@ -24,6 +24,10 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from ..resilience.injector import (InjectedFault, fault_point,
+                                   injector_active)
+from ..resilience.retry import RetryPolicy
+
 
 class Dataset:
     """Map-style dataset: implement __getitem__ and __len__."""
@@ -169,6 +173,22 @@ class _WorkerPool:
         with self.lock:
             return next(self.work, None)
 
+    def _process(self, payload):
+        """One work item through the chaos plane: the
+        ``dataloader.worker`` site can inject a transient fault, which
+        RetryPolicy replays (injected faults only — a REAL loader error
+        still fails fast and propagates to the consumer). Zero overhead
+        when no fault spec is installed."""
+        if not injector_active():
+            return self.fn(payload)
+
+        def attempt():
+            fault_point("dataloader.worker")
+            return self.fn(payload)
+        return RetryPolicy.from_flags(
+            site="dataloader.worker",
+            retry_on=(InjectedFault,), giveup_on=()).call(attempt)
+
     def _run(self):
         while True:
             item = self._next_work()
@@ -176,7 +196,7 @@ class _WorkerPool:
                 break
             tick, payload = item
             try:
-                result = self.fn(payload)
+                result = self._process(payload)
             except BaseException as e:  # propagate to consumer
                 with self.cv:
                     self.error = e

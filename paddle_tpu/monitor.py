@@ -33,6 +33,14 @@ def stats() -> Dict[str, int]:
         return dict(_stats)
 
 
+def stats_with_prefix(prefix: str) -> Dict[str, int]:
+    """Counters under one namespace, e.g. ``stats_with_prefix
+    ("STAT_fault_")`` — how the chaos suite asserts every injection and
+    every recovery was actually observed, not just survived."""
+    with _lock:
+        return {k: v for k, v in _stats.items() if k.startswith(prefix)}
+
+
 def reset():
     with _lock:
         _stats.clear()

@@ -1,0 +1,34 @@
+"""Resilience plane: deterministic fault injection + unified recovery.
+
+Three pieces (ISSUE 2; CheckFreq/Varuna-style preemption tolerance):
+
+- :mod:`injector` — named ``fault_point(site)`` hooks driven by a
+  seeded, deterministic spec (``FLAGS_fault_spec`` /
+  ``PADDLE_TPU_FAULT_SPEC``), a no-op when unset. Lets CI *prove* the
+  recovery paths below instead of assuming them.
+- :mod:`retry` — ``RetryPolicy``: exponential backoff + deterministic
+  jitter + deadline, the ONE retry loop shared by PS RPC, fs, and
+  checkpoint IO (replaces the bespoke connect-retry in ps/rpc.py).
+- :mod:`guardian` — ``TrainGuardian``: training-step supervisor that
+  skips NaN batches, rolls back to the latest valid checkpoint after
+  repeated failures, and watches the PS heartbeat map for dead workers.
+
+Every injected fault and every recovery action increments a
+``paddle_tpu.monitor`` counter (``STAT_fault_*`` / ``STAT_retry_*`` /
+``STAT_guardian_*``), so chaos tests assert observability, not just
+survival.
+"""
+
+from .injector import (FAULT_SITE_DOCS, FAULT_SITES, FaultInjector,
+                       InjectedDrop, InjectedFault, InjectedIOError,
+                       InjectedPreemption, fault_point, fault_scope,
+                       injector_active)
+from .retry import RetryError, RetryPolicy
+from .guardian import TrainGuardian
+
+__all__ = [
+    "FAULT_SITE_DOCS", "FAULT_SITES", "FaultInjector", "InjectedDrop",
+    "InjectedFault", "InjectedIOError", "InjectedPreemption", "RetryError",
+    "RetryPolicy", "TrainGuardian", "fault_point", "fault_scope",
+    "injector_active",
+]

@@ -1,0 +1,140 @@
+"""TrainGuardian — a training-step supervisor.
+
+Composes the pieces the repo already had but never joined: the
+executor's NaN/Inf scan (``NanInfError``), the numbered checkpoint tier
+(``CheckpointSaver``), and the PS heartbeat map (``worker_status``).
+
+Policy (CheckFreq-style: recovery must be cheap and bounded):
+
+- a step that raises ``NanInfError`` is SKIPPED (the batch is lost, the
+  params keep their pre-step values — the executor writes scope state
+  back only on success);
+- more than ``max_skip`` CONSECUTIVE bad steps means the params
+  themselves are likely poisoned → ROLL BACK to the latest valid
+  checkpoint and keep training;
+- ``checkpoint_every`` good steps snapshot the scope, so a rollback
+  loses a bounded amount of work;
+- ``dead_workers()`` reads the PS servers' heartbeat view so a
+  supervisor (ElasticManager) can restart the pod instead of hanging.
+
+Counters: ``STAT_guardian_skipped``, ``STAT_guardian_rollbacks``,
+``STAT_guardian_checkpoints``, ``STAT_guardian_dead_workers``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+
+
+class RollbackError(RuntimeError):
+    """Rollback was required but no valid checkpoint exists."""
+
+
+class TrainGuardian:
+    """Wrap ``Executor.run`` for one training program.
+
+    >>> guard = TrainGuardian(exe, main, scope, saver=saver,
+    ...                       checkpoint_every=10)
+    >>> for step, feed in enumerate(batches):
+    ...     out = guard.step(feed, fetch_list=[loss])  # None == skipped
+    """
+
+    def __init__(self, executor, program, scope,
+                 saver=None, max_skip: Optional[int] = None,
+                 checkpoint_every: int = 0,
+                 ps_client=None,
+                 expected_workers: Optional[Sequence[int]] = None):
+        self.executor = executor
+        self.program = program
+        self.scope = scope
+        self.saver = saver
+        self.max_skip = int(_flags.get_flag("guardian_max_skip")
+                            if max_skip is None else max_skip)
+        self.checkpoint_every = int(checkpoint_every)
+        self.ps_client = ps_client
+        self.expected_workers = list(expected_workers or [])
+        self.steps_done = 0
+        self.skipped = 0
+        self.rollbacks = 0
+        self.consecutive_bad = 0
+
+    # -- the step wrapper --------------------------------------------------
+    def step(self, feed: Optional[Dict[str, Any]] = None,
+             fetch_list: Optional[Sequence[Any]] = None):
+        """One guarded training step. Returns the fetches, or None when
+        the batch was skipped (NaN) or spent on a rollback."""
+        from ..framework.executor import NanInfError
+        try:
+            out = self.executor.run(self.program, feed=feed,
+                                    fetch_list=fetch_list,
+                                    scope=self.scope)
+        except NanInfError:
+            self.skipped += 1
+            self.consecutive_bad += 1
+            _monitor.stat_add("STAT_guardian_skipped")
+            if self.consecutive_bad > self.max_skip:
+                self.rollback()
+            return None
+        self.consecutive_bad = 0
+        self.steps_done += 1
+        if (self.saver is not None and self.checkpoint_every > 0
+                and self.steps_done % self.checkpoint_every == 0):
+            self._snapshot()
+        return out
+
+    # -- checkpoint plumbing -----------------------------------------------
+    def _scope_state(self) -> Dict[str, np.ndarray]:
+        return {n: np.asarray(self.scope.find_var(n))
+                for n in self.scope.all_var_names()}
+
+    def _snapshot(self):
+        self.saver.save(self._scope_state(), self.steps_done,
+                        meta={"step": self.steps_done})
+        _monitor.stat_add("STAT_guardian_checkpoints")
+
+    def rollback(self):
+        """Restore the scope from the latest VALID checkpoint (the
+        saver falls back past corrupt ones). Raises RollbackError when
+        none exists — silently training on from poisoned params would
+        be worse than crashing."""
+        if self.saver is None:
+            raise RollbackError(
+                f"{self.consecutive_bad} consecutive bad steps and no "
+                f"CheckpointSaver to roll back to")
+        state, meta = self.saver.load()
+        if state is None:
+            raise RollbackError(
+                f"{self.consecutive_bad} consecutive bad steps and no "
+                f"checkpoint under {self.saver.dir!r}")
+        import jax.numpy as jnp
+        for k, v in state.items():
+            self.scope.set_var(k, jnp.asarray(v))
+        self.steps_done = int((meta or {}).get("step", self.steps_done))
+        self.consecutive_bad = 0
+        self.rollbacks += 1
+        _monitor.stat_add("STAT_guardian_rollbacks")
+        return meta
+
+    # -- PS liveness -------------------------------------------------------
+    def dead_workers(self, timeout: float = 0.0) -> Dict[int, dict]:
+        """{worker_id: status} for expected workers the PS heartbeat
+        map reports dead (or has never seen). Empty dict == healthy.
+        Counts each detection so chaos tests can assert the watchdog
+        actually looked."""
+        if self.ps_client is None:
+            return {}
+        status = self.ps_client.worker_status(timeout=timeout)
+        dead = {}
+        for wid in self.expected_workers:
+            entry = status.get(str(wid))
+            if entry is None or not entry.get("alive", False):
+                dead[int(wid)] = entry or {"alive": False,
+                                           "age_sec": None}
+        if dead:
+            _monitor.stat_add("STAT_guardian_dead_workers", len(dead))
+        return dead

@@ -1,0 +1,113 @@
+"""RetryPolicy — the one retry loop for everything that talks to the
+outside world (PS RPC, filesystem shells, checkpoint archives).
+
+Exponential backoff with deterministic jitter and a wall-clock
+deadline. Before this existed every caller grew its own bespoke loop
+(PSClient._sock's hardcoded 30 s connect spin); now the knobs are flags
+(``FLAGS_retry_*``) and every retry increments ``STAT_retry_<site>`` so
+chaos tests can assert the recovery actually ran.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+
+
+class RetryError(Exception):
+    """Raised when a policy exhausts attempts/deadline; chains the last
+    underlying failure (``raise ... from last``)."""
+
+
+# OSError subclasses that describe the *request*, not the transport —
+# retrying them can only waste the deadline hiding a real bug
+_NON_TRANSIENT = (FileNotFoundError, FileExistsError, IsADirectoryError,
+                  NotADirectoryError, PermissionError)
+
+
+class RetryPolicy:
+    """``policy.call(fn, *args)`` — run fn, retrying transient failures.
+
+    - ``retry_on``: exception classes considered transient
+    - ``giveup_on``: subclasses of those that are NOT (checked first)
+    - backoff: ``base_delay * 2**attempt`` capped at ``max_delay``,
+      each scaled by ``1 + jitter*u`` with u drawn from a PRNG seeded
+      by (site, FLAGS_fault_seed) — deterministic under test specs
+    - ``deadline``: seconds of wall clock after which the policy stops
+      retrying even with attempts left
+    """
+
+    def __init__(self, max_attempts: Optional[int] = None,
+                 base_delay: Optional[float] = None,
+                 max_delay: Optional[float] = None,
+                 deadline: Optional[float] = None,
+                 jitter: float = 0.25,
+                 retry_on: Tuple[Type[BaseException], ...] =
+                 (OSError, EOFError, ConnectionError),
+                 giveup_on: Tuple[Type[BaseException], ...] =
+                 _NON_TRANSIENT,
+                 site: str = "",
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        g = _flags.get_flags(["retry_max_attempts", "retry_base_delay",
+                              "retry_max_delay", "retry_deadline",
+                              "fault_seed"])
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else g["retry_max_attempts"])
+        self.base_delay = float(base_delay if base_delay is not None
+                                else g["retry_base_delay"])
+        self.max_delay = float(max_delay if max_delay is not None
+                               else g["retry_max_delay"])
+        self.deadline = float(deadline if deadline is not None
+                              else g["retry_deadline"])
+        self.jitter = float(jitter)
+        self.retry_on = retry_on
+        self.giveup_on = giveup_on
+        self.site = site
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = random.Random(f"{g['fault_seed']}:{site}")
+
+    @classmethod
+    def from_flags(cls, site: str, **overrides) -> "RetryPolicy":
+        """Flag-configured policy for a named site (the common path)."""
+        return cls(site=site, **overrides)
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry number ``attempt`` (0-based)."""
+        d = min(self.max_delay, self.base_delay * (2.0 ** attempt))
+        return d * (1.0 + self.jitter * self._rng.random())
+
+    def call(self, fn: Callable, *args, **kwargs):
+        start = self._clock()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.giveup_on:
+                raise
+            except self.retry_on as e:
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    break
+                delay = self.backoff(attempt)
+                if self._clock() + delay - start > self.deadline:
+                    break
+                _monitor.stat_add(
+                    f"STAT_retry_{self.site or 'anonymous'}")
+                self._sleep(delay)
+        raise RetryError(
+            f"{self.site or 'operation'} failed after "
+            f"{self.max_attempts} attempts / "
+            f"{self._clock() - start:.1f}s (last: {last!r})") from last
+
+    def wrap(self, fn: Callable) -> Callable:
+        """Decorator form: ``guarded = policy.wrap(fn)``."""
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped

@@ -25,6 +25,14 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection suite (tools/ci.sh gate)")
 # float32 matmuls at full precision for numerical test parity
 jax.config.update("jax_default_matmul_precision", "highest")
 # allow float64 — OpTest numerical grad checks run in fp64 like the

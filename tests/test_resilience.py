@@ -1,0 +1,471 @@
+"""Resilience plane units: fault-spec grammar + determinism,
+RetryPolicy backoff/deadline/giveup semantics, TrainGuardian policy,
+and the per-layer wiring (fs, dataloader, checkpoint, PS flags,
+make_server fallback).
+
+Everything here is deterministic — seeded probabilistic triggers, fake
+clocks/sleeps where timing matters — so the chaos plane itself is
+tier-1 testable. The heavier end-to-end recovery runs live in
+tests/test_chaos.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.incubate.checkpoint import (CheckpointCorruptError,
+                                            CheckpointSaver)
+from paddle_tpu.resilience import (FAULT_SITES, FaultInjector,
+                                   InjectedDrop, InjectedFault,
+                                   InjectedIOError, RetryError,
+                                   RetryPolicy, TrainGuardian,
+                                   fault_point, fault_scope,
+                                   injector_active)
+from paddle_tpu.resilience import injector as injector_mod
+from paddle_tpu.resilience.guardian import RollbackError
+
+pytestmark = pytest.mark.chaos
+
+_RESTORE_FLAGS = ("fault_spec", "fault_seed", "retry_max_attempts",
+                  "retry_base_delay", "retry_max_delay",
+                  "retry_deadline", "guardian_max_skip")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    saved = pt.get_flags(list(_RESTORE_FLAGS))
+    monitor.reset()
+    injector_mod.reset()
+    yield
+    pt.set_flags(saved)
+    injector_mod.reset()
+    monitor.reset()
+
+
+# -- spec grammar --------------------------------------------------------
+
+def test_spec_grammar_triggers():
+    inj = FaultInjector("a.site:nan@2;b.site:corrupt;c.site:skip@1+")
+    # @2: fires exactly on the third call (0-based)
+    assert [inj.check("a.site") for _ in range(4)] == [
+        None, None, "nan", None]
+    # no trigger: every call
+    assert [inj.check("b.site") for _ in range(2)] == [
+        "corrupt", "corrupt"]
+    # @1+: every call from the second on
+    assert [inj.check("c.site") for _ in range(3)] == [
+        None, "skip", "skip"]
+    # unknown site never fires
+    assert inj.check("other.site") is None
+
+
+def test_spec_raising_kinds():
+    inj = FaultInjector("x:drop;y:error")
+    with pytest.raises(ConnectionResetError):
+        inj.check("x")
+    with pytest.raises(OSError):
+        inj.check("y")
+    # both are InjectedFault, so retry layers can opt in by class
+    with pytest.raises(InjectedFault):
+        inj.check("x")
+    with pytest.raises(InjectedFault):
+        inj.check("y")
+
+
+def test_spec_malformed_fails_loudly():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultInjector("a.site:explode")
+    with pytest.raises(ValueError, match="malformed fault rule"):
+        FaultInjector("justasite")
+    with pytest.raises(ValueError, match="probability"):
+        FaultInjector("a.site:drop@1.5")
+
+
+def test_probabilistic_trigger_deterministic_per_seed():
+    def firing_pattern(seed):
+        inj = FaultInjector("s:skip@0.4", seed=seed)
+        return [inj.check("s") is not None for _ in range(30)]
+
+    a, b, c = firing_pattern(1), firing_pattern(1), firing_pattern(2)
+    assert a == b, "same seed must replay the same faults"
+    assert a != c, "different seed must differ"
+    assert 0 < sum(a) < 30
+
+
+def test_fault_point_noop_without_spec():
+    assert not injector_active()
+    for site in FAULT_SITES:
+        assert fault_point(site) is None
+    assert monitor.stats_with_prefix("STAT_fault_") == {}
+
+
+def test_fault_scope_installs_and_restores():
+    with fault_scope("exec.step:nan@0"):
+        assert injector_active()
+        assert fault_point("exec.step") == "nan"
+    assert not injector_active()
+    assert fault_point("exec.step") is None
+
+
+def test_env_spec_honored_when_flag_unset(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", "exec.step:nan@0")
+    injector_mod.reset()
+    assert fault_point("exec.step") == "nan"
+    monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC")
+    injector_mod.reset()
+    assert fault_point("exec.step") is None
+
+
+def test_fired_faults_are_counted():
+    with fault_scope("exec.step:nan@0;exec.step:nan@1"):
+        fault_point("exec.step")
+        fault_point("exec.step")
+    assert monitor.stat_get("STAT_fault_exec.step") == 2
+
+
+# -- RetryPolicy ---------------------------------------------------------
+
+def _nosleep_policy(**kw):
+    kw.setdefault("sleep", lambda d: None)
+    return RetryPolicy(**kw)
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    p = _nosleep_policy(max_attempts=5, site="unit")
+    assert p.call(flaky) == "ok"
+    assert calls[0] == 3
+    assert monitor.stat_get("STAT_retry_unit") == 2
+
+
+def test_retry_exhaustion_raises_retry_error_with_cause():
+    def always():
+        raise EOFError("down")
+
+    p = _nosleep_policy(max_attempts=3, site="unit")
+    with pytest.raises(RetryError) as ei:
+        p.call(always)
+    assert isinstance(ei.value.__cause__, EOFError)
+    # last attempt is not followed by a sleep/counter
+    assert monitor.stat_get("STAT_retry_unit") == 2
+
+
+def test_retry_gives_up_on_non_transient_oserror():
+    calls = [0]
+
+    def missing():
+        calls[0] += 1
+        raise FileNotFoundError("/nope")
+
+    p = _nosleep_policy(max_attempts=5, site="unit")
+    with pytest.raises(FileNotFoundError):
+        p.call(missing)
+    assert calls[0] == 1, "non-transient errors must not be retried"
+
+
+def test_retry_deadline_stops_early():
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(d):
+        now[0] += d
+
+    def always():
+        raise ConnectionResetError("down")
+
+    p = RetryPolicy(max_attempts=100, base_delay=1.0, max_delay=10.0,
+                    deadline=5.0, jitter=0.0, site="unit",
+                    sleep=sleep, clock=clock)
+    with pytest.raises(RetryError, match="attempts"):
+        p.call(always)
+    # 1 + 2 = 3s slept; the next 4s delay would pass the 5s deadline
+    assert now[0] == pytest.approx(3.0)
+
+
+def test_backoff_growth_cap_and_jitter_determinism():
+    p1 = RetryPolicy(base_delay=0.1, max_delay=0.4, jitter=0.0,
+                     site="s")
+    assert [p1.backoff(i) for i in range(4)] == pytest.approx(
+        [0.1, 0.2, 0.4, 0.4])
+    p2 = RetryPolicy(base_delay=0.1, max_delay=0.4, jitter=0.5,
+                     site="s")
+    p3 = RetryPolicy(base_delay=0.1, max_delay=0.4, jitter=0.5,
+                     site="s")
+    assert [p2.backoff(i) for i in range(4)] == pytest.approx(
+        [p3.backoff(i) for i in range(4)]), "jitter is seeded"
+    assert all(p2.backoff(0) >= 0.1 for _ in range(3))
+
+
+def test_retry_defaults_come_from_flags():
+    pt.set_flags({"retry_max_attempts": 7, "retry_base_delay": 0.125})
+    p = RetryPolicy.from_flags(site="s")
+    assert p.max_attempts == 7
+    assert p.base_delay == 0.125
+
+
+# -- fs wiring -----------------------------------------------------------
+
+def test_localfs_write_retries_injected_error(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    pt.set_flags({"retry_base_delay": 0.001})
+    fs = LocalFS()
+    with fault_scope("fs.write:error@0"):
+        fs.mkdirs(str(tmp_path / "sub"))  # first attempt injected away
+    assert (tmp_path / "sub").is_dir()
+    assert monitor.stat_get("STAT_fault_fs.write") == 1
+    assert monitor.stat_get("STAT_retry_fs.write") == 1
+
+
+def test_localfs_real_missing_file_fails_fast(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    fs = LocalFS()
+    with pytest.raises(FileNotFoundError):
+        fs.rename(str(tmp_path / "missing"), str(tmp_path / "dst"))
+    assert monitor.stat_get("STAT_retry_fs.write") == 0
+
+
+# -- dataloader wiring ---------------------------------------------------
+
+def test_dataloader_worker_retries_injected_faults():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Ten(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32)
+
+    pt.set_flags({"retry_base_delay": 0.001})
+    with fault_scope("dataloader.worker:error@0.3", seed=5):
+        loader = DataLoader(Ten(), batch_size=2, num_workers=2)
+        batches = [np.asarray(b) for b in loader]
+    assert len(batches) == 5
+    # in-order contract survives the chaos
+    assert [int(b.ravel()[0]) for b in batches] == [0, 2, 4, 6, 8]
+    assert monitor.stat_get("STAT_fault_dataloader.worker") > 0
+    assert monitor.stat_get("STAT_retry_dataloader.worker") > 0
+
+
+# -- checkpoint satellites ----------------------------------------------
+
+def test_saver_sweeps_orphaned_tmp_dirs(tmp_path):
+    d = tmp_path / "ck"
+    (d / "3.tmp").mkdir(parents=True)
+    (d / "3.tmp" / "state.npz").write_bytes(b"partial")
+    s = CheckpointSaver(str(tmp_path), "ck")
+    assert not (d / "3.tmp").exists()
+    assert monitor.stat_get("STAT_ckpt_tmp_swept") == 1
+    assert s._numbers() == []
+
+
+def test_load_falls_back_past_corrupt_checkpoint(tmp_path):
+    s = CheckpointSaver(str(tmp_path), "ck", max_num=5)
+    s.save({"w": np.full(2, 1.0)}, 1)
+    s.save({"w": np.full(2, 2.0)}, 2)
+    # real corruption, not injected: truncate the archive
+    (tmp_path / "ck" / "2" / "state.npz").write_bytes(b"garbage")
+    with pytest.warns(UserWarning, match="corrupt"):
+        state, meta = s.load()
+    assert meta["number"] == 1
+    np.testing.assert_allclose(state["w"], 1.0)
+    assert monitor.stat_get("STAT_ckpt_load_fallback") == 1
+
+
+def test_load_validates_meta_json(tmp_path):
+    s = CheckpointSaver(str(tmp_path), "ck", max_num=5)
+    s.save({"w": np.zeros(2)}, 1)
+    s.save({"w": np.ones(2)}, 2)
+    (tmp_path / "ck" / "2" / "meta.json").write_text("{not json")
+    with pytest.warns(UserWarning, match="corrupt"):
+        _, meta = s.load()
+    assert meta["number"] == 1
+
+
+def test_load_all_corrupt_raises(tmp_path):
+    s = CheckpointSaver(str(tmp_path), "ck")
+    s.save({"w": np.zeros(2)}, 0)
+    (tmp_path / "ck" / "0" / "state.npz").write_bytes(b"x")
+    with pytest.warns(UserWarning):
+        with pytest.raises(CheckpointCorruptError):
+            s.load()
+
+
+def test_load_missing_explicit_number_still_raises(tmp_path):
+    s = CheckpointSaver(str(tmp_path), "ck")
+    s.save({"w": np.zeros(2)}, 0)
+    with pytest.raises(FileNotFoundError):
+        s.load(99)
+
+
+def test_save_retries_injected_io_error(tmp_path):
+    pt.set_flags({"retry_base_delay": 0.001})
+    s = CheckpointSaver(str(tmp_path), "ck")
+    with fault_scope("ckpt.save:error@0"):
+        s.save({"w": np.full(2, 7.0)}, 0)
+    state, meta = s.load()
+    np.testing.assert_allclose(state["w"], 7.0)
+    assert monitor.stat_get("STAT_retry_ckpt.save") == 1
+
+
+def test_injected_corrupt_save_is_detected_on_load(tmp_path):
+    s = CheckpointSaver(str(tmp_path), "ck", max_num=5)
+    s.save({"w": np.full(2, 1.0)}, 0)
+    with fault_scope("ckpt.save:corrupt@0"):
+        s.save({"w": np.full(2, 2.0)}, 1)
+    with pytest.warns(UserWarning, match="corrupt"):
+        state, meta = s.load()
+    assert meta["number"] == 0
+
+
+# -- guardian units ------------------------------------------------------
+
+class _NanExecutor:
+    """Executor stub: raises NanInfError for scripted step indexes."""
+
+    def __init__(self, bad_steps):
+        self.bad = set(bad_steps)
+        self.calls = 0
+
+    def run(self, program, feed=None, fetch_list=None, scope=None):
+        from paddle_tpu.framework.executor import NanInfError
+        i = self.calls
+        self.calls += 1
+        if i in self.bad:
+            raise NanInfError(f"scripted NaN at {i}")
+        return [np.float32(i)]
+
+
+class _DictScope:
+    def __init__(self, vals):
+        self.vals = dict(vals)
+
+    def all_var_names(self):
+        return list(self.vals)
+
+    def find_var(self, n):
+        return self.vals[n]
+
+    def set_var(self, n, v):
+        self.vals[n] = v
+
+
+def test_guardian_skips_then_rolls_back(tmp_path):
+    scope = _DictScope({"w": np.float64(0.0)})
+    saver = CheckpointSaver(str(tmp_path), "g", max_num=3)
+    exe = _NanExecutor(bad_steps={3, 6, 7, 8})
+    guard = TrainGuardian(exe, None, scope, saver=saver, max_skip=1,
+                          checkpoint_every=2)
+    for i in range(12):
+        scope.vals["w"] = np.float64(i)  # the "training"
+        guard.step({})
+    # 3 skipped alone; 6,7 trip the rollback; 8 is a fresh skip
+    assert guard.skipped == 4
+    assert guard.rollbacks == 1
+    assert monitor.stat_get("STAT_guardian_skipped") == 4
+    assert monitor.stat_get("STAT_guardian_rollbacks") == 1
+    assert monitor.stat_get("STAT_guardian_checkpoints") >= 2
+
+
+def test_guardian_without_saver_raises_on_rollback():
+    exe = _NanExecutor(bad_steps={0, 1, 2, 3})
+    guard = TrainGuardian(exe, None, _DictScope({}), max_skip=2)
+    guard.step({})
+    guard.step({})
+    with pytest.raises(RollbackError):
+        for _ in range(4):
+            guard.step({})
+
+
+def test_guardian_max_skip_default_from_flag(tmp_path):
+    pt.set_flags({"guardian_max_skip": 9})
+    guard = TrainGuardian(_NanExecutor(set()), None, _DictScope({}))
+    assert guard.max_skip == 9
+
+
+class _StatusClient:
+    def __init__(self, status):
+        self.status = status
+
+    def worker_status(self, server=0, timeout=0.0):
+        return self.status
+
+
+def test_guardian_dead_worker_detection():
+    guard = TrainGuardian(
+        _NanExecutor(set()), None, _DictScope({}),
+        ps_client=_StatusClient({
+            "0": {"alive": True, "age_sec": 0.1},
+            "1": {"alive": False, "age_sec": 99.0}}),
+        expected_workers=[0, 1, 2])
+    dead = guard.dead_workers()
+    assert set(dead) == {1, 2}  # 1 stale, 2 never seen
+    assert monitor.stat_get("STAT_guardian_dead_workers") == 2
+    healthy = TrainGuardian(
+        _NanExecutor(set()), None, _DictScope({}),
+        ps_client=_StatusClient({"0": {"alive": True}}),
+        expected_workers=[0])
+    assert healthy.dead_workers() == {}
+
+
+# -- PS flags + make_server fallback ------------------------------------
+
+def test_ps_timeouts_read_from_flags():
+    import socket as _socket
+    from paddle_tpu.distributed.ps.rpc import PSServer
+    pt.set_flags({"ps_heartbeat_timeout": 5.5})
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = PSServer(f"127.0.0.1:{port}")
+    try:
+        assert srv.heartbeat_timeout == 5.5
+    finally:
+        srv._tcp.server_close()
+    pt.set_flags({"ps_heartbeat_timeout": 30.0})
+    flag_defs = pt._flags_module.list_flags()
+    for name in ("ps_connect_timeout", "ps_socket_timeout",
+                 "ps_heartbeat_timeout", "ps_prefer_native"):
+        assert name in flag_defs and flag_defs[name]["help"]
+
+
+def test_make_server_fault_forces_python_fallback():
+    import socket as _socket
+    from paddle_tpu.distributed.ps.native_server import make_server
+    from paddle_tpu.distributed.ps.rpc import PSServer
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    with fault_scope("ps.server.start:error"):
+        srv = make_server(f"127.0.0.1:{port}")
+    try:
+        assert isinstance(srv, PSServer), \
+            "injected toolchain failure must fall back to Python"
+        assert monitor.stat_get("STAT_fault_ps.server.start") == 1
+    finally:
+        srv.stop()
+
+
+def test_psclient_double_close_and_del_are_safe():
+    from paddle_tpu.distributed.ps.rpc import PSClient
+    c = PSClient(["127.0.0.1:1"])
+    c.close()
+    c.close()  # idempotent
+    c.__del__()  # never raises, even with sockets already gone
+    with pytest.raises(RuntimeError, match="closed"):
+        c._call(0, 2, b"")

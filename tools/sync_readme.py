@@ -7,11 +7,14 @@ measured artifact; this makes the artifacts the single source of truth:
     python tools/sync_readme.py          # rewrite generated fragments
     python tools/sync_readme.py --check  # exit 1 on drift (CI gate)
 
-Two fragments are generated, everything else stays hand-written:
+Three fragments are generated, everything else stays hand-written:
   - the GPT flagship headline bullet (from the latest BENCH_r*.json)
   - the "Static program checks" list between the
     `<!-- BEGIN GENERATED: verifier-checks -->` markers (from
     framework/analysis.py:ANALYSIS_CHECKS + the registered flags)
+  - the "Fault tolerance" section between the
+    `<!-- BEGIN GENERATED: fault-tolerance -->` markers (from
+    resilience/injector.py:FAULT_SITES + the registered flags)
 """
 
 import argparse
@@ -142,6 +145,84 @@ def sync_checks_block(text, check):
     return text[:b] + "\n" + want + "\n" + text[e:], None
 
 
+_FAULT_BEGIN = "<!-- BEGIN GENERATED: fault-tolerance -->"
+_FAULT_END = "<!-- END GENERATED: fault-tolerance -->"
+_FAULT_FLAGS = ("fault_spec", "fault_seed", "retry_max_attempts",
+                "retry_base_delay", "retry_max_delay", "retry_deadline",
+                "guardian_max_skip", "ps_heartbeat_timeout",
+                "ps_connect_timeout", "ps_socket_timeout")
+
+
+def render_fault_block():
+    """Fault-injection sites + resilience flags, from the live
+    registries (resilience/injector.py and paddle_tpu/flags.py)."""
+    import textwrap
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu import flags
+    from paddle_tpu.resilience import FAULT_SITE_DOCS
+
+    def bullet(head, body):
+        return "\n".join(textwrap.wrap(
+            f"- {head} — {body}", width=76, subsequent_indent="  "))
+
+    lines = [
+        "A fault spec is a `;`-separated list of `site:kind[@trigger]`",
+        "rules (e.g. `ps.rpc.call:drop@0.05;exec.step:nan@17`), installed",
+        "via `FLAGS_fault_spec` or `PADDLE_TPU_FAULT_SPEC`; unset means",
+        "every `fault_point` is a no-op. Triggers: absent = every call,",
+        "`@N` = exactly the N-th call (0-based), `@N+` = from the N-th",
+        "on, `@p` (float with a dot) = probability p from a PRNG seeded",
+        "by (`FLAGS_fault_seed`, site, rule index) — the same spec +",
+        "seed always injects the same faults. Kinds: `drop` (connection",
+        "loss), `error` (OSError), `preempt` (SystemExit, the in-process",
+        "preemption analog), `kill` (hard `os._exit`), and the",
+        "caller-interpreted `nan` / `corrupt` / `skip`.",
+        "",
+        "Injection sites:",
+        "",
+    ]
+    lines += [bullet(f"`{site}`", doc)
+              for site, doc in FAULT_SITE_DOCS.items()]
+    lines += [
+        "",
+        "Every injected fault counts `STAT_fault_<site>`, every retry",
+        "`STAT_retry_<site>`, and every guardian recovery a",
+        "`STAT_guardian_*` counter (`paddle_tpu.monitor`), so the chaos",
+        "suite (`pytest -m chaos`, tools/ci.sh step 4) asserts recovery",
+        "was observed, not just survived.",
+        "",
+        "Flags:",
+        "",
+    ]
+    defs = flags.list_flags()
+    for name in _FAULT_FLAGS:
+        d = defs[name]
+        lines.append(bullet(
+            f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
+    return "\n".join(lines)
+
+
+def sync_fault_block(text, check):
+    """Returns (new_text, drift_message_or_None)."""
+    try:
+        b = text.index(_FAULT_BEGIN) + len(_FAULT_BEGIN)
+        e = text.index(_FAULT_END)
+    except ValueError:
+        raise SystemExit("README fault-tolerance markers not found")
+    current = text[b:e].strip("\n")
+    want = render_fault_block()
+    if current == want:
+        print("README fault-tolerance block in sync")
+        return text, None
+    if check:
+        return text, ("README fault-tolerance block DRIFTS from "
+                      "resilience/injector.py + flags — rerun "
+                      "tools/sync_readme.py")
+    print("README fault-tolerance block regenerated")
+    return text[:b] + "\n" + want + "\n" + text[e:], None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--check", action="store_true",
@@ -153,7 +234,7 @@ def main():
         text = f.read()
     orig = text
     drifts = []
-    for sync in (sync_headline, sync_checks_block):
+    for sync in (sync_headline, sync_checks_block, sync_fault_block):
         text, drift = sync(text, args.check)
         if drift:
             drifts.append(drift)
