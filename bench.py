@@ -335,6 +335,92 @@ def child_main_resnet(batch: int, img: int, steps: int) -> int:
     return 0
 
 
+def child_main_serving(batch: int, seq: int, steps: int) -> int:
+    """BENCH_MODEL=serving: continuous-batching decode throughput.
+
+    ``batch`` = engine slots, ``seq`` = per-slot KV capacity, ``steps``
+    = requests per slot (steps*batch mixed-length requests total).
+    Reports generated tokens/s plus p50/p99 submit-to-finish latency;
+    ``vs_baseline`` is the speedup over serving the same requests one
+    at a time through ``greedy_search`` (the pre-engine path), unless
+    BENCH_SERVING_COMPARE=0 skips that run.
+    """
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPT_CONFIGS, GPTForCausalLM
+    from paddle_tpu.models.generation import greedy_search
+    from paddle_tpu.serving import ServingEngine
+
+    dev = jax.devices()[0]
+    gpt = os.environ.get("BENCH_SERVING_GPT", "gpt2-medium")
+    new_tokens = int(os.environ.get("BENCH_SERVING_NEW_TOKENS", "32"))
+    nreq = steps * batch
+    try:
+        pt.seed(0)
+        cfg = GPT_CONFIGS[gpt]
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(0)
+        max_prompt = max(4, min(64, seq - new_tokens))
+
+        def prompts(n, r):
+            return [r.randint(1, cfg.vocab_size,
+                              size=r.randint(4, max_prompt + 1)).tolist()
+                    for _ in range(n)]
+
+        def serve(ps):
+            eng = ServingEngine(model, max_slots=batch, max_len=seq,
+                                max_queue=len(ps) + batch)
+            reqs = [eng.submit(p, max_new_tokens=new_tokens) for p in ps]
+            eng.run_until_idle()
+            return reqs
+
+        # warmup fleet: every prefill bucket + the decode step compile
+        # outside the timed window
+        serve(prompts(2 * batch, np.random.RandomState(1)))
+        ps = prompts(nreq, rng)
+        t0 = time.perf_counter()
+        reqs = serve(ps)
+        dt = time.perf_counter() - t0
+        assert all(r.state == "done" for r in reqs)
+        toks = sum(len(r.tokens) for r in reqs)
+        lat = sorted(r.latency for r in reqs)
+        seq_dt = None
+        if os.environ.get("BENCH_SERVING_COMPARE", "1") != "0":
+            sub = ps[:batch]   # sequential sample; compiled b=1 warmup
+            greedy_search(model, np.asarray([sub[0]]),
+                          max_new_tokens=new_tokens, cache_len=seq)
+            t0 = time.perf_counter()
+            for p in sub:
+                greedy_search(model, np.asarray([p]),
+                              max_new_tokens=new_tokens, cache_len=seq)
+            seq_dt = (time.perf_counter() - t0) / len(sub)
+    except Exception as e:
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            sys.stderr.write("OOM: " + msg[:300] + "\n")
+            return OOM_RC
+        raise
+
+    tokens_per_sec = toks / dt
+    req_dt = dt / nreq   # engine wall time amortized per request
+    speedup = round(seq_dt / req_dt, 2) if seq_dt else 1.0
+    print(json.dumps({
+        "metric": "serving_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": speedup,   # vs one-request-at-a-time greedy
+        "p50_latency_ms": round(lat[len(lat) // 2] * 1000, 1),
+        "p99_latency_ms": round(
+            lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1000, 1),
+        "requests": nreq, "slots": batch, "max_len": seq,
+        "new_tokens": new_tokens, "model": gpt,
+        "device": getattr(dev, "device_kind", str(dev)),
+    }))
+    return 0
+
+
 def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
     """Measure one (model, batch, seq, steps) config; print the JSON line.
 
@@ -417,6 +503,10 @@ def main() -> int:
         seq = int(os.environ.get("BENCH_IMG", "224"))
     if model_name == "ernie":
         seq = int(os.environ.get("BENCH_SEQ", "512"))
+    if model_name == "serving":
+        # seq = slot KV capacity; steps = requests per slot
+        seq = int(os.environ.get("BENCH_SEQ", "256"))
+        steps = int(os.environ.get("BENCH_STEPS", "4"))
 
     here = os.path.abspath(__file__)
     last_err = ""
@@ -458,6 +548,10 @@ if __name__ == "__main__":
             sys.exit(child_main_ernie(int(sys.argv[i + 2]),
                                       int(sys.argv[i + 3]),
                                       int(sys.argv[i + 4])))
+        if name == "serving":
+            sys.exit(child_main_serving(int(sys.argv[i + 2]),
+                                        int(sys.argv[i + 3]),
+                                        int(sys.argv[i + 4])))
         sys.exit(child_main(name, int(sys.argv[i + 2]),
                             int(sys.argv[i + 3]), int(sys.argv[i + 4])))
     sys.exit(main())

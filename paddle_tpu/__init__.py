@@ -46,6 +46,7 @@ from . import incubate
 from . import resilience
 from . import reader
 from . import inference
+from . import serving
 from . import enforce
 from . import trainer_desc
 from . import slim

@@ -180,3 +180,27 @@ define_flag("ps_socket_timeout", 90.0,
 define_flag("ps_prefer_native", True,
             "make_server: try the C++ PS server first, falling back "
             "to the Python one when the toolchain is unavailable.")
+
+# Serving plane (paddle_tpu/serving): continuous-batching inference
+# engine geometry + admission control. Constructor arguments override;
+# the flags are the deployment-config surface.
+define_flag("serving_max_slots", 8,
+            "ServingEngine: KV-cache slots = max in-flight requests "
+            "decoded per step (the fixed decode batch axis).")
+define_flag("serving_max_len", 256,
+            "ServingEngine: per-slot KV capacity (prompt + generated); "
+            "must not exceed the model's max_position_embeddings.")
+define_flag("serving_max_queue", 64,
+            "ServingEngine admission control: waiting requests beyond "
+            "this are rejected with QueueFullError (backpressure; "
+            "counted as STAT_serving_rejected).")
+define_flag("serving_prefill_buckets", "16,32,64,128",
+            "Comma-separated prompt-length buckets: prefill pads each "
+            "prompt to the smallest bucket >= its length, so prefill "
+            "compiles once per bucket instead of once per length.")
+define_flag("serving_max_new_tokens", 32,
+            "ServingEngine: default per-request new-token budget when "
+            "submit() does not specify one.")
+define_flag("serving_idle_wait", 0.05,
+            "ServingEngine background loop: seconds to wait for new "
+            "submissions when no request is queued or in flight.")

@@ -3,10 +3,18 @@
 Capability analog of the reference's beam-search machinery
 (operators/beam_search_op.cc, beam_search_decode_op.cc and fluid
 layers/rnn.py BeamSearchDecoder) — redesigned without LoD: the beam is a
-dense [batch*beam] axis, KV caches ride along it, and each step is ordinary
-top-k over [batch, beam*vocab] scores. Decoding loops on the host (the
-per-step compiled model is the hot path, as in any autoregressive
-serving stack).
+dense [batch*beam] axis, KV caches ride along it, and each step is
+ordinary top-k over [batch, beam*vocab] scores.
+
+Decoding runs on a **fixed-capacity padded KV cache** (the model's
+``cache_pos`` path): every per-step call has ONE shape —
+``tokens [b], positions [b], cache [b, h, capacity, d]`` — so the
+jitted step function compiles exactly once and serves every step of
+every request at that shape. The old concat-cache loop grew the key
+axis each step, forcing an XLA recompile per generated token.
+``decode_step(model)`` exposes the per-model compiled step (and its
+trace counter, asserted ==1 in tests); ``paddle_tpu.serving`` drives
+the same step function with slots on the batch axis.
 """
 
 from __future__ import annotations
@@ -15,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..dygraph.tape import no_grad
@@ -26,56 +35,133 @@ def _t(x, dtype=jnp.int32):
                                                   stop_gradient=True)
 
 
+def decode_step(model):
+    """The per-model compiled decode step for fixed-capacity caches.
+
+    Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn`` maps
+    ``(tokens [b] i32, pos [b] i32, caches [(k, v) arrays])`` to
+    ``(next_tokens [b] i32, last_logits [b, V], new_caches)``: it writes
+    each row's token at that row's cache offset, attends under the
+    position mask, and returns the greedy argmax plus the raw logits
+    (for sampling/beam callers). ``traces["count"]`` increments once per
+    XLA trace — the compile-count==1 contract is asserted in tests.
+
+    Cached on the model instance, keyed by the flag-plane version so a
+    ``set_flags`` retraces (same contract as jit.to_static). Parameters
+    are closed over as constants: decoding assumes frozen weights.
+    """
+    from .. import flags as _flags
+    ent = getattr(model, "_decode_step_cache", None)
+    if ent is not None and ent["flags_version"] == _flags.version():
+        return ent
+    traces = {"count": 0}
+
+    def _step(tokens, pos, caches):
+        traces["count"] += 1
+        with no_grad():
+            tcaches = [(Tensor(k, stop_gradient=True),
+                        Tensor(v, stop_gradient=True)) for k, v in caches]
+            logits, newc = model(_t(tokens[:, None]), cache=tcaches,
+                                 cache_pos=pos)
+        lg = logits.value[:, -1]
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return nxt, lg, [(c[0].value, c[1].value) for c in newc]
+
+    ent = {"fn": jax.jit(_step), "traces": traces,
+           "flags_version": _flags.version()}
+    model._decode_step_cache = ent
+    return ent
+
+
+def _prefill(model, ids: np.ndarray, capacity: int):
+    """Eager prompt pass into a fresh fixed cache. Returns
+    (last_logits [b, V] jnp, caches [(k, v) jnp arrays])."""
+    cfg = model.gpt.cfg
+    if capacity > cfg.max_position_embeddings:
+        raise ValueError(
+            f"cache capacity {capacity} exceeds max_position_embeddings="
+            f"{cfg.max_position_embeddings}; raise it in the GPTConfig "
+            "or shorten prompt/max_new_tokens")
+    b, s0 = ids.shape
+    if s0 > capacity:
+        raise ValueError(f"prompt length {s0} exceeds cache capacity "
+                         f"{capacity}")
+    cache = model.gpt.gen_fixed_cache(b, capacity)
+    logits, cache = model(_t(ids), cache=cache, cache_pos=0)
+    return logits.value[:, -1], [(kv[0].value, kv[1].value)
+                                 for kv in cache]
+
+
 @no_grad()
 def greedy_search(model, input_ids, max_new_tokens: int = 16,
-                  eos_token_id: Optional[int] = None):
-    """Greedy decode with KV cache; returns [b, s+new] ids (numpy)."""
+                  eos_token_id: Optional[int] = None,
+                  cache_len: Optional[int] = None):
+    """Greedy decode with the fixed-capacity KV cache; returns
+    [b, s+new] ids (numpy). ``cache_len`` pins the cache capacity
+    (default prompt+max_new) — serving equivalence tests pass the
+    engine's ``max_len`` so both sides run the identical computation."""
     ids = np.asarray(input_ids)
-    b = ids.shape[0]
-    cache = model.gpt.gen_cache(b)
-    logits, cache = model(_t(ids), cache=cache)
+    b, s0 = ids.shape
+    cap = int(cache_len if cache_len is not None
+              else s0 + max_new_tokens)
+    if cap < s0 + max_new_tokens:
+        raise ValueError(
+            f"cache_len {cap} < prompt {s0} + max_new_tokens "
+            f"{max_new_tokens}")
+    logits, arrays = _prefill(model, ids, cap)
+    step = decode_step(model)["fn"]
     out = [ids]
     done = np.zeros(b, bool)
-    cur = np.asarray(jnp.argmax(logits.value[:, -1], -1)).reshape(b, 1)
-    for step in range(max_new_tokens):
+    cur = np.asarray(jnp.argmax(logits, -1)).reshape(b, 1)
+    pos = jnp.full((b,), s0, jnp.int32)
+    for t in range(max_new_tokens):
         if eos_token_id is not None:
             cur = np.where(done[:, None], eos_token_id, cur)
             done |= (cur[:, 0] == eos_token_id)
-        out.append(cur)
+        out.append(cur.astype(ids.dtype))
         if eos_token_id is not None and done.all():
             break
-        if step == max_new_tokens - 1:
+        if t == max_new_tokens - 1:
             break
-        logits, cache = model(_t(cur), cache=cache,
-                              position_offset=ids.shape[1] + step)
-        cur = np.asarray(jnp.argmax(logits.value[:, -1], -1)).reshape(b, 1)
+        nxt, _, arrays = step(jnp.asarray(cur[:, 0], jnp.int32), pos,
+                              arrays)
+        pos = pos + 1
+        cur = np.asarray(nxt).reshape(b, 1)
     return np.concatenate(out, axis=1)
 
 
 @no_grad()
 def sample(model, input_ids, max_new_tokens: int = 16,
-           temperature: float = 1.0, top_k: int = 0, seed: int = 0):
-    """Temperature / top-k sampling decode."""
-    import jax
-
+           temperature: float = 1.0, top_k: int = 0, seed: int = 0,
+           cache_len: Optional[int] = None):
+    """Temperature / top-k sampling decode (fixed-capacity cache; the
+    same compiled step as greedy — sampling happens on its logits)."""
     ids = np.asarray(input_ids)
-    b = ids.shape[0]
-    cache = model.gpt.gen_cache(b)
-    logits, cache = model(_t(ids), cache=cache)
+    b, s0 = ids.shape
+    cap = int(cache_len if cache_len is not None
+              else s0 + max_new_tokens)
+    if cap < s0 + max_new_tokens:
+        raise ValueError(
+            f"cache_len {cap} < prompt {s0} + max_new_tokens "
+            f"{max_new_tokens}")
+    lg, arrays = _prefill(model, ids, cap)
+    step = decode_step(model)["fn"]
     rng = jax.random.PRNGKey(seed)
     out = [ids]
-    for step in range(max_new_tokens):
-        lg = logits.value[:, -1] / max(temperature, 1e-6)
+    pos = jnp.full((b,), s0, jnp.int32)
+    for t in range(max_new_tokens):
+        lg = lg / max(temperature, 1e-6)
         if top_k > 0:
             kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
             lg = jnp.where(lg < kth, jnp.finfo(lg.dtype).min, lg)
         rng, sub = jax.random.split(rng)
         cur = np.asarray(jax.random.categorical(sub, lg)).reshape(b, 1)
-        out.append(cur)
-        if step == max_new_tokens - 1:
+        out.append(cur.astype(ids.dtype))
+        if t == max_new_tokens - 1:
             break
-        logits, cache = model(_t(cur), cache=cache,
-                              position_offset=ids.shape[1] + step)
+        _, lg, arrays = step(jnp.asarray(cur[:, 0], jnp.int32), pos,
+                             arrays)
+        pos = pos + 1
     return np.concatenate(out, axis=1)
 
 
@@ -83,39 +169,45 @@ def sample(model, input_ids, max_new_tokens: int = 16,
 def beam_search(model, input_ids, beam_size: int = 4,
                 max_new_tokens: int = 16,
                 length_penalty: float = 1.0,
-                eos_token_id: Optional[int] = None):
+                eos_token_id: Optional[int] = None,
+                cache_len: Optional[int] = None):
     """Beam search decode; returns (ids [b, s+new], scores [b]).
 
-    The beam lives on a dense batch*beam axis (no LoD): caches expand
-    once after the prompt,每 step is log-softmax + top-k over
-    [b, beam*vocab], then a gather re-orders the beam axis of every
-    cache tensor (the beam_search_op "select parents" step).
+    The beam lives on a dense batch*beam axis (no LoD): fixed caches
+    expand once after the prompt, each step is log-softmax + top-k over
+    [b, beam*vocab], then a row gather re-orders the beam axis of every
+    cache array (the beam_search_op "select parents" step).
     """
     ids = np.asarray(input_ids)
     b, s0 = ids.shape
     k = beam_size
-    import jax
+    cap = int(cache_len if cache_len is not None
+              else s0 + max_new_tokens)
+    if cap < s0 + max_new_tokens:
+        raise ValueError(
+            f"cache_len {cap} < prompt {s0} + max_new_tokens "
+            f"{max_new_tokens}")
 
-    cache = model.gpt.gen_cache(b)
-    logits, cache = model(_t(ids), cache=cache)
-    lp = np.asarray(jax.nn.log_softmax(logits.value[:, -1], axis=-1))
+    logits, arrays = _prefill(model, ids, cap)
+    step = decode_step(model)["fn"]
+    lp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
     vocab = lp.shape[-1]
     # seed beams with the top-k first tokens
     top = np.argsort(-lp, axis=-1)[:, :k]                   # [b, k]
     scores = np.take_along_axis(lp, top, -1)                # [b, k]
     tokens = top.reshape(b * k, 1)
-    # expand caches along the beam axis
-    cache = [(Tensor(jnp.repeat(kv[0].value, k, axis=0),
-                     stop_gradient=True),
-              Tensor(jnp.repeat(kv[1].value, k, axis=0),
-                     stop_gradient=True)) for kv in cache]
+    # expand caches along the beam axis (rows are independent slots)
+    arrays = [(jnp.repeat(kv[0], k, axis=0), jnp.repeat(kv[1], k, axis=0))
+              for kv in arrays]
     seqs = np.concatenate([np.repeat(ids, k, axis=0), tokens], axis=1)
     done = np.zeros((b, k), bool)
+    pos = jnp.full((b * k,), s0, jnp.int32)
 
-    for step in range(1, max_new_tokens):
-        logits, cache = model(_t(tokens), cache=cache,
-                              position_offset=s0 + step - 1)
-        lg = np.asarray(logits.value[:, -1])                # [b*k, V]
+    for t in range(1, max_new_tokens):
+        _, lg, arrays = step(jnp.asarray(tokens[:, 0], jnp.int32), pos,
+                             arrays)
+        pos = pos + 1
+        lg = np.asarray(lg)                                 # [b*k, V]
         lg = lg - lg.max(-1, keepdims=True)
         lp = lg - np.log(np.exp(lg).sum(-1, keepdims=True))
         lp = lp.reshape(b, k, vocab)
@@ -133,9 +225,7 @@ def beam_search(model, input_ids, beam_size: int = 4,
         # reorder beam-major state by parent
         gidx = (np.arange(b)[:, None] * k + parent).reshape(-1)
         seqs = np.concatenate([seqs[gidx], tok.reshape(b * k, 1)], 1)
-        cache = [(Tensor(kv[0].value[gidx], stop_gradient=True),
-                  Tensor(kv[1].value[gidx], stop_gradient=True))
-                 for kv in cache]
+        arrays = [(kv[0][gidx], kv[1][gidx]) for kv in arrays]
         if eos_token_id is not None:
             done = np.take_along_axis(done, parent, 1) | \
                 (tok == eos_token_id)

@@ -21,6 +21,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from ..dygraph.layers import Layer, LayerList
 from ..dygraph.tape import run_op
 from ..dygraph.tensor import Tensor
@@ -99,13 +101,46 @@ class GPTAttention(Layer):
                                weight_attr=wo)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, cache_pos=None):
         cfg = self.cfg
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
         qkv = qkv.transpose([2, 0, 3, 1, 4])  # [3, b, h, s, d]
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if cache is not None and cache_pos is not None:
+            # fixed-capacity (slotted) KV cache: `cache` is a
+            # preallocated [b, h, max_len, d] pair and the new keys are
+            # written in place at each row's own offset, so every
+            # decode step has ONE shape and XLA compiles it once. The
+            # per-row position mask stands in for the causal structure.
+            # Inference-only by construction (writes bypass the tape).
+            import jax
+
+            from ..ops.attention_ops import decode_attention_mask
+            kc, vc = cache[0].value, cache[1].value
+            pos = jnp.asarray(cache_pos, jnp.int32)
+            if pos.ndim == 0:
+                pos = jnp.broadcast_to(pos, (b,))
+
+            def _write(buf, new, p):
+                # all start indices must share a dtype (x64 mode makes
+                # bare 0 an int64)
+                z = jnp.zeros((), jnp.int32)
+                return jax.lax.dynamic_update_slice(buf, new, (z, p, z))
+
+            kc = jax.vmap(_write)(kc, k.value, pos)
+            vc = jax.vmap(_write)(vc, v.value, pos)
+            mask = decode_attention_mask(pos, s, kc.shape[2], kc.dtype)
+            cache = (Tensor(kc, stop_gradient=True),
+                     Tensor(vc, stop_gradient=True))
+            out = run_op("fused_attention_qkv",
+                         {"Q": [q], "K": [cache[0]], "V": [cache[1]],
+                          "Mask": [Tensor(mask, stop_gradient=True)]},
+                         {"causal": False})["Out"][0]
+            out = out.transpose([0, 2, 1, 3]).reshape(
+                [b, s, cfg.hidden_size])
+            return self.dropout(self.out_proj(out)), cache
         if cache is not None:
             k = run_op("concat", {"X": [cache[0], k]}, {"axis": 2})["Out"][0]
             v = run_op("concat", {"X": [cache[1], v]}, {"axis": 2})["Out"][0]
@@ -133,11 +168,11 @@ class GPTBlock(Layer):
                           weight_attr=wo)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, cache_pos=None):
         if cache is None:
             x = x + self.attn(self.ln1(x))
         else:
-            a, cache = self.attn(self.ln1(x), cache)
+            a, cache = self.attn(self.ln1(x), cache, cache_pos=cache_pos)
             x = x + a
         x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)),
                                              approximate=True)))
@@ -159,20 +194,40 @@ class GPTModel(Layer):
                                  for _ in range(cfg.num_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids, cache=None, position_offset=0):
+    def forward(self, input_ids, cache=None, position_offset=0,
+                cache_pos=None):
         s = input_ids.shape[1]
-        if position_offset + s > self.cfg.max_position_embeddings:
-            # out-of-range position gathers would silently produce NaN
-            # embeddings (jnp.take fill mode) — fail with guidance instead
-            raise ValueError(
-                f"sequence length {position_offset + s} exceeds "
-                f"max_position_embeddings={self.cfg.max_position_embeddings}"
-                "; raise it in the GPTConfig (dataclasses.replace) or "
-                "truncate the input")
-        import jax.numpy as jnp
-        pos = Tensor(jnp.arange(position_offset, position_offset + s,
-                                dtype=jnp.int32)[None, :],
-                     stop_gradient=True)
+        if cache_pos is not None:
+            # fixed-capacity cache mode: positions come from each row's
+            # cache write offset (int, or a [b] vector for slotted
+            # serving where every row is at a different length). Traced
+            # offsets can't be range-checked here — the callers
+            # (generation.py / serving.engine) validate capacity against
+            # max_position_embeddings up front.
+            if isinstance(cache_pos, int) and \
+                    cache_pos + s > self.cfg.max_position_embeddings:
+                raise ValueError(
+                    f"sequence length {cache_pos + s} exceeds "
+                    f"max_position_embeddings="
+                    f"{self.cfg.max_position_embeddings}")
+            p = jnp.asarray(cache_pos, jnp.int32)
+            p = p[None] if p.ndim == 0 else p
+            pos = Tensor(p[:, None] + jnp.arange(s, dtype=jnp.int32)[None],
+                         stop_gradient=True)
+        else:
+            if position_offset + s > self.cfg.max_position_embeddings:
+                # out-of-range position gathers would silently produce
+                # NaN embeddings (jnp.take fill mode) — fail with
+                # guidance instead
+                raise ValueError(
+                    f"sequence length {position_offset + s} exceeds "
+                    f"max_position_embeddings="
+                    f"{self.cfg.max_position_embeddings}"
+                    "; raise it in the GPTConfig (dataclasses.replace) "
+                    "or truncate the input")
+            pos = Tensor(jnp.arange(position_offset, position_offset + s,
+                                    dtype=jnp.int32)[None, :],
+                         stop_gradient=True)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         new_caches = []
@@ -185,14 +240,23 @@ class GPTModel(Layer):
                 else:
                     x = blk(x)
             else:
-                x, c = blk(x, cache[i])
+                x, c = blk(x, cache[i], cache_pos=cache_pos)
                 new_caches.append(c)
         x = self.ln_f(x)
         return x if cache is None else (x, new_caches)
 
     def gen_cache(self, batch_size):
-        import jax.numpy as jnp
         z = Tensor(jnp.zeros((batch_size, self.cfg.num_heads, 0,
+                              self.cfg.head_dim), jnp.float32),
+                   stop_gradient=True)
+        return [(z, z) for _ in range(self.cfg.num_layers)]
+
+    def gen_fixed_cache(self, batch_size, max_len):
+        """Preallocated fixed-capacity KV cache: one [b, h, max_len, d]
+        zero pair per layer. Used with ``cache_pos`` so every decode
+        step sees a single shape (compiles once); serving stacks slots
+        on the batch axis."""
+        z = Tensor(jnp.zeros((batch_size, self.cfg.num_heads, max_len,
                               self.cfg.head_dim), jnp.float32),
                    stop_gradient=True)
         return [(z, z) for _ in range(self.cfg.num_layers)]
@@ -207,13 +271,14 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(cfg)
 
     def forward(self, input_ids, labels=None, cache=None,
-                position_offset=0):
+                position_offset=0, cache_pos=None):
         if cache is None:
             # forward the offset: chunked-prefill callers without a cache
             # must get real positions (and the out-of-range guard)
             h = self.gpt(input_ids, position_offset=position_offset)
         else:
-            h, cache = self.gpt(input_ids, cache, position_offset)
+            h, cache = self.gpt(input_ids, cache, position_offset,
+                                cache_pos=cache_pos)
         # tied LM head: h @ wte.T
         logits = run_op("matmul_v2",
                         {"X": [h], "Y": [self.gpt.wte.weight]},

@@ -2,15 +2,21 @@
 
 ``STAT_ADD("STAT_total_feasign_num_in_mem", n)`` style counters used by
 the dataset/PS tiers for observability; thread-safe, exported as a dict.
+``stat_time(name)`` adds a minimal latency facility on the same
+registry: phase timings (serving prefill/decode, checkpoint IO) land in
+``stats()`` as ``<name>_calls`` / ``<name>_ms`` without a separate
+metrics stack.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import Dict
 
 _lock = threading.Lock()
-_stats: Dict[str, int] = {}
+_stats: Dict[str, float] = {}
 
 
 def stat_add(name: str, value: int = 1):
@@ -28,7 +34,24 @@ def stat_get(name: str) -> int:
         return _stats.get(name, 0)
 
 
-def stats() -> Dict[str, int]:
+@contextlib.contextmanager
+def stat_time(name: str):
+    """``with stat_time("STAT_serving_prefill"): ...`` — records one
+    call and its wall-clock milliseconds as ``<name>_calls`` (int) and
+    ``<name>_ms`` (float total) alongside the ordinary counters, so
+    ``stats()["STAT_serving_prefill_ms"] /
+    stats()["STAT_serving_prefill_calls"]`` is the mean latency."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with _lock:
+            _stats[name + "_calls"] = int(_stats.get(name + "_calls", 0)) + 1
+            _stats[name + "_ms"] = _stats.get(name + "_ms", 0.0) + dt_ms
+
+
+def stats() -> Dict[str, float]:
     with _lock:
         return dict(_stats)
 

@@ -27,6 +27,24 @@ _logger = logging.getLogger(__name__)
 _warned_fallback = False
 
 
+def decode_attention_mask(pos, q_len: int, capacity: int,
+                          dtype=jnp.float32):
+    """Additive attention mask for the fixed-capacity KV-cache decode
+    path: query i (absolute position ``pos[b] + i``) may attend cache
+    entry j iff ``j <= pos[b] + i``. Entries past the valid length —
+    prefill padding, stale rows from a retired slot — get
+    ``finfo.min``, which the softmax turns into an exact 0 probability,
+    so a [max_slots, heads, max_len, d] cache behaves like each slot's
+    true-length cache. Returns [b, 1, q_len, capacity].
+    """
+    pos = jnp.asarray(pos, jnp.int32)
+    qpos = pos[:, None] + jnp.arange(q_len, dtype=jnp.int32)  # [b, q]
+    valid = jnp.arange(capacity, dtype=jnp.int32)[None, None, :] \
+        <= qpos[:, :, None]                                   # [b, q, C]
+    neg = jnp.asarray(jnp.finfo(dtype).min, dtype)
+    return jnp.where(valid, jnp.zeros((), dtype), neg)[:, None]
+
+
 def _composed_attention(q, k, v, mask, causal, scale):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:

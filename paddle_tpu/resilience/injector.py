@@ -70,6 +70,15 @@ FAULT_SITE_DOCS: Dict[str, str] = {
     "dataloader.worker": "io.DataLoader background worker, per item "
                          "(injected faults retried; real errors "
                          "fail fast)",
+    "serving.submit": "ServingEngine.submit admission — a raising kind "
+                      "rejects that submission before it is queued "
+                      "(the backpressure path); in-flight requests are "
+                      "untouched",
+    "serving.step": "ServingEngine scheduler, once per prefill attempt "
+                    "and per decode attempt — drop/error are retried "
+                    "via RetryPolicy (exhaustion sheds the affected "
+                    "requests), `skip` sheds the request being "
+                    "prefilled or skips one decode iteration",
 }
 FAULT_SITES: Tuple[str, ...] = tuple(FAULT_SITE_DOCS)
 

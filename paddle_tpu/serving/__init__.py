@@ -1,0 +1,16 @@
+"""paddle_tpu.serving — the inference serving plane.
+
+Continuous-batching engine over a slotted fixed-shape KV cache:
+requests share one preallocated decode batch (one slot each), prefill
+is shape-bucketed so compiles are bounded by the bucket count, and the
+decode step compiles exactly once per engine geometry. See engine.py
+for the scheduler, kv_cache.py for the memory manager, http.py for the
+JSON front end.
+"""
+
+from .engine import QueueFullError, Request, ServingEngine
+from .http import ServingHTTPServer
+from .kv_cache import SlotKVCache
+
+__all__ = ["ServingEngine", "Request", "QueueFullError",
+           "SlotKVCache", "ServingHTTPServer"]

@@ -1,0 +1,437 @@
+"""ServingEngine — continuous-batching inference on the slotted cache.
+
+Iteration-level scheduling (the Orca design point): the unit of work is
+one *step*, not one request. Each step first admits queued requests
+into free cache slots (a shape-bucketed prefill per admission), then
+runs ONE batched decode over all occupied slots. A request that
+finishes mid-batch releases its slot immediately and the next queued
+request takes it on the following step — the decode batch never drains
+to let stragglers finish.
+
+Two compile surfaces, both fixed-shape:
+
+- decode: ``models.generation.decode_step(model)`` at batch =
+  ``max_slots`` — every step of every request, one XLA executable;
+- prefill: one jitted function per prompt-length *bucket*
+  (``FLAGS_serving_prefill_buckets``); prompts are right-padded to the
+  smallest bucket that fits, so a fleet of arbitrary-length prompts
+  compiles ``len(buckets)`` times, total. Padding is sound because the
+  position mask hides rows past the true length and decode overwrites
+  them in place — same reuse idea as CompiledProgram's keyed ``_cache``
+  (compiler.py), keyed here by shape bucket instead of program.
+
+Resilience: ``serving.submit`` faults reject a submission at admission
+(backpressure path); ``serving.step`` faults fire once per prefill
+attempt and per decode attempt — drop/error retry through RetryPolicy
+(exhaustion sheds the affected requests, never the whole engine),
+``skip`` sheds the request being prefilled / skips one decode
+iteration. Counters land in monitor.stats() as ``STAT_serving_*``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+from .. import profiler as _profiler
+from ..dygraph.tape import no_grad
+from ..dygraph.tensor import Tensor
+from ..models.generation import decode_step
+from ..resilience.injector import fault_point
+from ..resilience.retry import RetryError, RetryPolicy
+from .kv_cache import SlotKVCache
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the wait queue is at FLAGS_serving_max_queue.
+    Callers shed load (HTTP maps this to 429) instead of queueing
+    unboundedly."""
+
+
+class _Shed(Exception):
+    """Internal: this request is dropped by fault policy (injected
+    `skip`, or retry exhaustion). Not an OSError on purpose — it must
+    NOT be retried."""
+
+
+class _SkipStep(Exception):
+    """Internal: skip one decode iteration (injected `skip` at
+    serving.step during decode); requests stay live."""
+
+
+class Request:
+    """One generation request's lifecycle record.
+
+    States: queued -> running -> done, with shed as the fault exit
+    (queued/running -> shed). ``output_ids`` is prompt + generated
+    tokens (EOS included when hit), matching ``greedy_search`` row
+    semantics token for token.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 eos_token_id: Optional[int]):
+        self.id = next(Request._ids)
+        self.prompt: List[int] = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.tokens: List[int] = []
+        self.state = "queued"
+        self.slot: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self.submitted_at = time.perf_counter()
+        self.finished_at: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def output_ids(self) -> List[int]:
+        return self.prompt + self.tokens
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def latency(self) -> Optional[float]:
+        """Submit-to-finish wall seconds (None while in flight)."""
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state!r}, "
+                f"prompt={len(self.prompt)} toks, "
+                f"generated={len(self.tokens)})")
+
+
+def _parse_buckets(text: str, max_len: int) -> List[int]:
+    """Flag string -> sorted bucket lengths, clipped to the cache
+    capacity, with max_len itself as the terminal bucket so every
+    admissible prompt has a home."""
+    buckets = sorted({int(tok) for tok in str(text).split(",") if
+                      tok.strip()})
+    buckets = [b for b in buckets if 0 < b <= max_len]
+    if not buckets or buckets[-1] != max_len:
+        buckets.append(max_len)
+    return buckets
+
+
+class ServingEngine:
+    """Front door: ``submit()`` returns a :class:`Request` handle,
+    ``results()`` collects them; call ``start()`` for a background
+    scheduler thread or drive ``step()`` / ``run_until_idle()``
+    yourself (tests do the latter for determinism).
+
+    Geometry/admission knobs come from the ``FLAGS_serving_*`` plane;
+    constructor arguments override per instance.
+    """
+
+    def __init__(self, model, max_slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: Optional[int] = None,
+                 eos_token_id: Optional[int] = None):
+        g = _flags.get_flags(["serving_max_slots", "serving_max_len",
+                              "serving_max_queue",
+                              "serving_prefill_buckets",
+                              "serving_max_new_tokens",
+                              "serving_idle_wait"])
+        self.model = model
+        cfg = model.gpt.cfg
+        self.max_slots = int(max_slots if max_slots is not None
+                             else g["serving_max_slots"])
+        self.max_len = int(max_len if max_len is not None
+                           else g["serving_max_len"])
+        if self.max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"serving max_len {self.max_len} exceeds the model's "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
+        self.max_queue = int(max_queue if max_queue is not None
+                             else g["serving_max_queue"])
+        self.default_max_new_tokens = int(g["serving_max_new_tokens"])
+        self.default_eos_token_id = eos_token_id
+        self.idle_wait = float(g["serving_idle_wait"])
+        self.buckets = (_parse_buckets(g["serving_prefill_buckets"],
+                                       self.max_len)
+                        if buckets is None else
+                        _parse_buckets(",".join(map(str, buckets)),
+                                       self.max_len))
+        self.cache = SlotKVCache(cfg.num_layers, cfg.num_heads,
+                                 cfg.head_dim, self.max_slots,
+                                 self.max_len)
+        self._queue: deque = deque()
+        self._active: Dict[int, Request] = {}
+        self._all: List[Request] = []
+        self._lock = threading.Lock()        # queue + _all
+        self._step_lock = threading.Lock()   # one scheduler at a time
+        self._wake = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prefill_fns: Dict[int, dict] = {}   # bucket len -> entry
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None) -> Request:
+        """Queue a generation request; returns its handle immediately.
+        Raises ValueError for geometry the cache cannot hold and
+        QueueFullError when admission control sheds the submission."""
+        mnt = int(max_new_tokens if max_new_tokens is not None
+                  else self.default_max_new_tokens)
+        eos = (eos_token_id if eos_token_id is not None
+               else self.default_eos_token_id)
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if mnt < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        if len(prompt) + mnt > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) "
+                f"exceeds slot capacity max_len={self.max_len}")
+        # raising kinds reject this submission pre-queue; `skip` sheds
+        # it through the same backpressure exit as a full queue
+        kind = fault_point("serving.submit")
+        if kind == "skip":
+            _monitor.stat_add("STAT_serving_rejected")
+            raise QueueFullError("submission shed by injected fault at "
+                                 "serving.submit")
+        req = Request(prompt, mnt, eos)
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                _monitor.stat_add("STAT_serving_rejected")
+                raise QueueFullError(
+                    f"serving queue full ({self.max_queue} waiting); "
+                    "retry later or raise FLAGS_serving_max_queue")
+            self._queue.append(req)
+            self._all.append(req)
+        _monitor.stat_add("STAT_serving_submitted")
+        self._wake.set()
+        return req
+
+    # ----------------------------------------------------------- prefill
+    def _bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.max_len  # unreachable: submit() validated length
+
+    def _prefill_entry(self, bucket: int) -> dict:
+        """The jitted prompt pass for one length bucket (compiled on
+        first use, reused for every prompt that pads to it). Maps
+        ``(ids [1, bucket] i32, last i32)`` to the logits row at the
+        true last prompt position plus full-capacity cache rows."""
+        ent = self._prefill_fns.get(bucket)
+        if ent is not None and ent["flags_version"] == _flags.version():
+            return ent
+        traces = {"count": 0}
+        model, max_len = self.model, self.max_len
+
+        def _prefill(ids, last):
+            traces["count"] += 1
+            with no_grad():
+                cache = model.gpt.gen_fixed_cache(1, max_len)
+                logits, newc = model(
+                    Tensor(ids, stop_gradient=True), cache=cache,
+                    cache_pos=0)
+            lg = jax.lax.dynamic_slice_in_dim(logits.value, last, 1,
+                                              axis=1)[:, 0]
+            return lg, [(c[0].value, c[1].value) for c in newc]
+
+        ent = {"fn": jax.jit(_prefill), "traces": traces,
+               "flags_version": _flags.version()}
+        self._prefill_fns[bucket] = ent
+        return ent
+
+    def _prefill_attempt(self, req: Request):
+        kind = fault_point("serving.step")
+        if kind == "skip":
+            raise _Shed(f"injected skip during prefill of request "
+                        f"{req.id}")
+        n = len(req.prompt)
+        bucket = self._bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt
+        fn = self._prefill_entry(bucket)["fn"]
+        return fn(jnp.asarray(padded), jnp.asarray(n - 1, jnp.int32))
+
+    def _admit(self) -> int:
+        """Fill free slots from the queue; one bucketed prefill per
+        admission. Returns how many requests were admitted."""
+        admitted = 0
+        while self.cache.num_free:
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            slot = self.cache.alloc()
+            try:
+                with _monitor.stat_time("STAT_serving_prefill"), \
+                        _profiler.RecordEvent("serving.prefill"):
+                    lg, rows = RetryPolicy.from_flags(
+                        "serving.step").call(self._prefill_attempt, req)
+            except (_Shed, RetryError) as e:
+                self.cache.release(slot)
+                self._shed(req, e)
+                continue
+            self.cache.write_prefill(slot, rows, len(req.prompt))
+            req.slot = slot
+            req.state = "running"
+            self._active[slot] = req
+            admitted += 1
+            _monitor.stat_add("STAT_serving_prefills")
+            # the first generated token comes from the prefill logits
+            # (same argmax greedy_search takes after ITS prefill)
+            self._append_token(req, int(np.asarray(
+                jnp.argmax(lg, axis=-1))[0]))
+        return admitted
+
+    # ------------------------------------------------------------ decode
+    def _decode_attempt(self, tokens: np.ndarray):
+        kind = fault_point("serving.step")
+        if kind == "skip":
+            raise _SkipStep("injected skip of one decode iteration")
+        fn = decode_step(self.model)["fn"]
+        return fn(jnp.asarray(tokens),
+                  jnp.asarray(self.cache.lengths),
+                  self.cache.arrays())
+
+    def _decode(self) -> int:
+        """One batched decode over every occupied slot. Returns how
+        many tokens were produced (0 when idle/skipped)."""
+        if not self._active:
+            return 0
+        tokens = np.zeros(self.max_slots, np.int32)
+        for slot, req in self._active.items():
+            tokens[slot] = req.tokens[-1]
+        try:
+            with _monitor.stat_time("STAT_serving_decode"), \
+                    _profiler.RecordEvent("serving.decode"):
+                nxt, _, arrays = RetryPolicy.from_flags(
+                    "serving.step").call(self._decode_attempt, tokens)
+        except _SkipStep:
+            return 0
+        except RetryError as e:
+            # the step itself is unrecoverable: shed the affected
+            # requests, keep the engine alive for new submissions
+            for slot, req in list(self._active.items()):
+                del self._active[slot]
+                self.cache.release(slot)
+                self._shed(req, e)
+            return 0
+        self.cache.set_arrays(arrays)
+        nxt = np.asarray(nxt)
+        produced = 0
+        for slot, req in list(self._active.items()):
+            self.cache.lengths[slot] += 1
+            self._append_token(req, int(nxt[slot]))
+            produced += 1
+        return produced
+
+    # -------------------------------------------------------- lifecycle
+    def _append_token(self, req: Request, token: int):
+        req.tokens.append(token)
+        _monitor.stat_add("STAT_serving_tokens")
+        if (req.eos_token_id is not None and
+                token == req.eos_token_id) or \
+                len(req.tokens) >= req.max_new_tokens:
+            self._finish(req)
+
+    def _finish(self, req: Request):
+        if req.slot is not None:
+            self._active.pop(req.slot, None)
+            self.cache.release(req.slot)
+            req.slot = None
+        req.state = "done"
+        req.finished_at = time.perf_counter()
+        _monitor.stat_add("STAT_serving_completed")
+        req._done.set()
+
+    def _shed(self, req: Request, err: BaseException):
+        req.slot = None
+        req.state = "shed"
+        req.error = err
+        req.finished_at = time.perf_counter()
+        _monitor.stat_add("STAT_serving_shed")
+        req._done.set()
+
+    # --------------------------------------------------------- stepping
+    def step(self) -> bool:
+        """One scheduler iteration: admit into free slots, then one
+        batched decode. Returns whether any work happened."""
+        with self._step_lock:
+            _monitor.stat_add("STAT_serving_steps")
+            admitted = self._admit()
+            produced = self._decode()
+            return bool(admitted or produced)
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            queued = bool(self._queue)
+        return not queued and not self._active
+
+    def run_until_idle(self, max_steps: int = 10_000):
+        """Drive the scheduler inline until queue and slots drain
+        (the deterministic test/benchmark path — no thread)."""
+        steps = 0
+        while not self.idle:
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"serving engine not idle after {max_steps} steps "
+                    f"({len(self._active)} active, "
+                    f"{len(self._queue)} queued)")
+        return steps
+
+    def results(self, reqs: Optional[Sequence[Request]] = None,
+                timeout: Optional[float] = None) -> List[Request]:
+        """Wait for the given requests (default: every request ever
+        submitted) and return them in submission order."""
+        with self._lock:
+            reqs = list(self._all) if reqs is None else list(reqs)
+        for r in reqs:
+            if not r.wait(timeout):
+                raise TimeoutError(
+                    f"request {r.id} not finished within {timeout}s")
+        return reqs
+
+    # ------------------------------------------------- background thread
+    def start(self):
+        """Run the scheduler on a daemon thread (the HTTP deployment
+        mode); idle waits are bounded by FLAGS_serving_idle_wait."""
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+
+        def _loop():
+            while not self._stop_evt.is_set():
+                if not self.step():
+                    self._wake.wait(self.idle_wait)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,122 @@
+"""Threaded HTTP front end for :class:`ServingEngine`.
+
+Same server shape as distributed/fleet/utils/http_server.py (a
+ThreadingHTTPServer on a daemon thread with start/stop), speaking a
+minimal JSON generation protocol:
+
+  POST /v1/generate   {"ids": [...], "max_new_tokens"?, "eos_token_id"?}
+                      -> 200 {"id", "output_ids", "generated", "state"}
+                      -> 400 bad request geometry / malformed JSON
+                      -> 429 admission control (queue full / shed at
+                             submit — the backpressure signal)
+                      -> 503 request shed by fault policy mid-flight
+  GET  /v1/stats      -> 200 monitor.stats() (the STAT_serving_* plane)
+  GET  /health        -> 200 {"ok": true, "slots_free": n, "queued": n}
+
+Like the KV rendezvous server, this is unauthenticated cluster-private
+HTTP; bind 127.0.0.1 (the default here) unless the network is trusted.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .. import monitor as _monitor
+from .engine import QueueFullError, ServingEngine
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    server_version = "PaddleTPUServing/1.0"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        engine: ServingEngine = self.server.engine
+        if self.path == "/health":
+            self._json(200, {"ok": True,
+                             "slots_free": engine.cache.num_free,
+                             "queued": len(engine._queue)})
+        elif self.path == "/v1/stats":
+            self._json(200, _monitor.stats_with_prefix("STAT_serving"))
+        else:
+            self._json(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):
+        engine: ServingEngine = self.server.engine
+        if self.path != "/v1/generate":
+            self._json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            ids = body["ids"]
+        except (ValueError, KeyError, TypeError) as e:
+            self._json(400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            req = engine.submit(ids,
+                                max_new_tokens=body.get("max_new_tokens"),
+                                eos_token_id=body.get("eos_token_id"))
+        except QueueFullError as e:
+            self._json(429, {"error": str(e)})
+            return
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+            return
+        if not req.wait(self.server.request_timeout):
+            self._json(504, {"error": f"request {req.id} timed out"})
+            return
+        if req.state != "done":
+            self._json(503, {"error": f"request {req.id} {req.state}: "
+                                      f"{req.error}"})
+            return
+        self._json(200, {"id": req.id, "output_ids": req.output_ids,
+                         "generated": len(req.tokens),
+                         "state": req.state})
+
+
+class ServingHTTPServer:
+    """``srv = ServingHTTPServer(engine); srv.start()`` — starts the
+    engine's scheduler thread too, so a constructed server is the whole
+    deployment. ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, engine: ServingEngine, port: int = 0,
+                 bind_address: str = "127.0.0.1",
+                 request_timeout: float = 120.0):
+        self.engine = engine
+        self._httpd = ThreadingHTTPServer((bind_address, port),
+                                          _ServingHandler)
+        self._httpd.engine = engine
+        self._httpd.request_timeout = request_timeout
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self.engine.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serving-http")
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.engine.stop()

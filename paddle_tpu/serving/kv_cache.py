@@ -1,0 +1,88 @@
+"""Slotted KV cache — the serving plane's memory manager.
+
+One preallocated ``[max_slots, heads, max_len, head_dim]`` key/value
+pair per layer; each in-flight request owns one *slot* (a row on the
+batch axis) for its lifetime. Because the buffers never change shape,
+the batched decode step has a single signature and compiles exactly
+once; admitting or retiring a request is a row write / a bookkeeping
+update, never a recompile. This is the Orca/vLLM-style design point,
+simplified to slot granularity: a TPU wants one big dense batch axis,
+not paged blocks, and max_len-bounded rows make the position mask
+(ops.attention_ops.decode_attention_mask) the only "page table".
+
+Slot lifecycle: ``alloc()`` (admission) -> ``write_prefill`` (the
+bucketed prompt pass populates the row and sets its valid length) ->
+per-step in-place row writes inside the compiled decode (lengths
+advance by one per generated token) -> ``release()`` (EOS/max-tokens)
+returns the slot for the next admission; stale row contents need no
+scrubbing — the position mask already excludes them.
+"""
+
+from __future__ import annotations
+
+from bisect import insort
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+
+class SlotKVCache:
+    """Fixed-geometry KV storage + slot free list.
+
+    The jnp arrays are functionally updated (the compiled decode step
+    returns replacement buffers via :meth:`set_arrays`); the host-side
+    ``lengths`` vector tracks each slot's valid prefix and doubles as
+    the decode step's position input.
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 max_slots: int, max_len: int, dtype=None):
+        import jax.numpy as jnp
+        dtype = dtype or jnp.float32
+        shape = (max_slots, num_heads, max_len, head_dim)
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.layers: List[Tuple[jax.Array, jax.Array]] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)]
+        self.lengths = np.zeros(max_slots, np.int32)
+        # kept sorted so admission order -> slot order is deterministic
+        # (the equivalence tests replay exact schedules)
+        self._free = list(range(max_slots))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.max_slots - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """Claim the lowest free slot, or None when full."""
+        return self._free.pop(0) if self._free else None
+
+    def release(self, slot: int):
+        self.lengths[slot] = 0
+        insort(self._free, slot)
+
+    def write_prefill(self, slot: int, rows, length: int):
+        """Install a prefilled row: ``rows`` is one (k, v) pair per
+        layer shaped [1, heads, max_len, d] (full capacity, as produced
+        by the bucketed prefill function); ``length`` is the true
+        prompt length — entries past it are padding the position mask
+        hides until decode overwrites them."""
+        self.layers = [
+            (k.at[slot].set(rk[0]), v.at[slot].set(rv[0]))
+            for (k, v), (rk, rv) in zip(self.layers, rows)]
+        self.lengths[slot] = int(length)
+
+    def arrays(self):
+        """The per-layer (k, v) buffers, as fed to the decode step."""
+        return list(self.layers)
+
+    def set_arrays(self, layers):
+        """Adopt the decode step's returned buffers."""
+        self.layers = [(k, v) for k, v in layers]

@@ -329,3 +329,109 @@ def test_elastic_restart_after_injector_kill(tmp_path):
     assert epochs == [0, 1, 2, 3, 4]
     assert log[-1].endswith("acc10.0"), \
         "state must carry across the restart (0+1+2+3+4)"
+
+
+# -- serving plane under injected faults --------------------------------
+
+@pytest.fixture(scope="module")
+def _serving_model():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    pt.seed(11)
+    cfg = GPTConfig(vocab_size=61, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _serving_engine(model, **kw):
+    from paddle_tpu.serving import ServingEngine
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("buckets", [8])
+    return ServingEngine(model, **kw)
+
+
+def test_serving_step_drop_is_retried(_serving_model):
+    """A transient drop inside a prefill/decode attempt retries through
+    RetryPolicy; every request still completes with the exact fault-free
+    tokens, and both the injection and the recovery are counted."""
+    from paddle_tpu.models.generation import greedy_search
+    prompts = [[1, 2, 3], [4, 5, 6, 7]]
+    with fault_scope("serving.step:drop@1"):
+        eng = _serving_engine(_serving_model)
+        reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_idle()
+        assert [r.state for r in reqs] == ["done", "done"]
+        assert monitor.stat_get("STAT_fault_serving.step") == 1
+        assert monitor.stat_get("STAT_retry_serving.step") >= 1
+        for p, r in zip(prompts, reqs):
+            ref = greedy_search(_serving_model, np.asarray([p]),
+                                max_new_tokens=4,
+                                cache_len=eng.max_len)[0].tolist()
+            assert r.output_ids == ref
+
+
+def test_serving_step_skip_sheds_one_prefill(_serving_model):
+    """`skip` during a prefill sheds exactly the request being admitted;
+    everything behind it completes untouched."""
+    with fault_scope("serving.step:skip@0"):
+        eng = _serving_engine(_serving_model)
+        reqs = [eng.submit([1, 2, 3], max_new_tokens=3),
+                eng.submit([4, 5], max_new_tokens=3)]
+        eng.run_until_idle()
+        assert reqs[0].state == "shed" and reqs[0].error is not None
+        assert reqs[1].state == "done" and len(reqs[1].tokens) == 3
+        assert monitor.stat_get("STAT_fault_serving.step") == 1
+        assert monitor.stat_get("STAT_serving_shed") == 1
+        assert monitor.stat_get("STAT_serving_completed") == 1
+        assert eng.cache.num_free == eng.max_slots  # no leaked slot
+
+
+def test_serving_step_skip_decode_iteration(_serving_model):
+    """`skip` during decode drops one iteration, not the requests: the
+    next step redoes the decode and the output is still exact."""
+    from paddle_tpu.models.generation import greedy_search
+    with fault_scope("serving.step:skip@1"):  # call 0 = prefill
+        eng = _serving_engine(_serving_model)
+        req = eng.submit([7, 8, 9], max_new_tokens=4)
+        eng.run_until_idle()
+        assert req.state == "done"
+        assert monitor.stat_get("STAT_fault_serving.step") == 1
+        ref = greedy_search(_serving_model, np.asarray([[7, 8, 9]]),
+                            max_new_tokens=4,
+                            cache_len=eng.max_len)[0].tolist()
+        assert req.output_ids == ref
+
+
+def test_serving_retry_exhaustion_sheds_not_kills(_serving_model):
+    """A persistent step fault sheds the affected requests but leaves
+    the engine serving: the next fault-free submission completes."""
+    pt.set_flags({"retry_max_attempts": 2})
+    eng = _serving_engine(_serving_model)
+    with fault_scope("serving.step:drop"):
+        reqs = [eng.submit([1, 2], max_new_tokens=3),
+                eng.submit([3, 4], max_new_tokens=3)]
+        eng.run_until_idle()
+        assert [r.state for r in reqs] == ["shed", "shed"]
+        assert monitor.stat_get("STAT_serving_shed") == 2
+        assert eng.cache.num_free == eng.max_slots
+    req = eng.submit([5, 6], max_new_tokens=3)
+    eng.run_until_idle()
+    assert req.state == "done" and len(req.tokens) == 3
+
+
+def test_serving_submit_fault_rejects_before_queue(_serving_model):
+    """serving.submit faults reject at admission (backpressure), leaving
+    queued and in-flight work untouched."""
+    from paddle_tpu.resilience.injector import InjectedIOError
+    eng = _serving_engine(_serving_model)
+    ok = eng.submit([1, 2, 3], max_new_tokens=2)
+    with fault_scope("serving.submit:error@0"):
+        with pytest.raises(InjectedIOError):
+            eng.submit([4, 5], max_new_tokens=2)
+        assert monitor.stat_get("STAT_fault_serving.submit") == 1
+        later = eng.submit([6, 7], max_new_tokens=2)  # call 1: clean
+        eng.run_until_idle()
+    assert ok.state == "done" and later.state == "done"

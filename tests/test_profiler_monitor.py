@@ -5,8 +5,10 @@ tools/timeline.py, platform/monitor.h:76.
 """
 
 import json
+import time
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import monitor, profiler
@@ -63,3 +65,19 @@ def test_stat_registry():
     assert monitor.stats() == {"feasigns": 15, "epoch": 3}
     monitor.reset()
     assert monitor.stats() == {}
+
+
+def test_stat_time_records_count_and_total_ms():
+    monitor.reset()
+    for _ in range(3):
+        with monitor.stat_time("phase"):
+            time.sleep(0.002)
+    s = monitor.stats()
+    assert s["phase_calls"] == 3
+    assert s["phase_ms"] >= 3 * 2.0 * 0.5  # wall clock, generous slack
+    # exceptions still record the timing (the finally path)
+    with pytest.raises(RuntimeError):
+        with monitor.stat_time("phase"):
+            raise RuntimeError("boom")
+    assert monitor.stats()["phase_calls"] == 4
+    monitor.reset()

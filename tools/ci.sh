@@ -9,10 +9,12 @@
 #   3. full test suite on the virtual 8-device CPU mesh
 #   4. chaos suite (deterministic fault injection: retry/skip/rollback
 #      recovery paths under FLAGS_fault_spec-driven failures)
-#   5. op coverage gate (>= 80% of the reference forward-op surface)
-#   6. API-freeze check (public signature snapshot diff)
-#   7. multi-chip dry-run (GSPMD train step on N virtual devices)
-#   8. README headline vs latest bench artifact (no drift)
+#   5. serving plane (continuous-batching engine == sequential decode,
+#      compile-count budget, queue backpressure; reduced in quick mode)
+#   6. op coverage gate (>= 80% of the reference forward-op surface)
+#   7. API-freeze check (public signature snapshot diff)
+#   8. multi-chip dry-run (GSPMD train step on N virtual devices)
+#   9. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -20,7 +22,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/8 import smoke"
+echo "== 1/9 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -29,37 +31,46 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/8 lint (program verifier + op-desc compat)"
+echo "== 2/9 lint (program verifier + op-desc compat)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --books
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 3/8 test suite (virtual 8-device CPU mesh)"
+  echo "== 3/9 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 3/8 test suite: SKIPPED (quick mode)"
+  echo "== 3/9 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/8 chaos suite (deterministic fault injection)"
+  echo "== 4/9 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 4/8 chaos suite: reduced subset (quick mode)"
+  echo "== 4/9 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
-echo "== 5/8 op coverage gate"
+if [[ "${1:-}" != "quick" ]]; then
+  echo "== 5/9 serving plane"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+else
+  echo "== 5/9 serving plane: reduced subset (quick mode)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
+    -k "matches_sequential or queue_full or slot_kv"
+fi
+
+echo "== 6/9 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 6/8 API freeze"
+echo "== 7/9 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -78,14 +89,14 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 7/8 multi-chip dry run"
+echo "== 8/9 multi-chip dry run"
 python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('   8-device GSPMD train step ok')
 "
 
-echo "== 8/8 README headline sync"
+echo "== 9/9 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

@@ -15,6 +15,9 @@ Three fragments are generated, everything else stays hand-written:
   - the "Fault tolerance" section between the
     `<!-- BEGIN GENERATED: fault-tolerance -->` markers (from
     resilience/injector.py:FAULT_SITES + the registered flags)
+  - the "Serving" section between the
+    `<!-- BEGIN GENERATED: serving -->` markers (from the registered
+    `FLAGS_serving_*` flags + the serving fault sites)
 """
 
 import argparse
@@ -223,6 +226,77 @@ def sync_fault_block(text, check):
     return text[:b] + "\n" + want + "\n" + text[e:], None
 
 
+_SERVING_BEGIN = "<!-- BEGIN GENERATED: serving -->"
+_SERVING_END = "<!-- END GENERATED: serving -->"
+
+
+def render_serving_block():
+    """Serving-engine config + fault surface, from the live registries
+    (paddle_tpu/flags.py `serving_*` + resilience/injector.py serving
+    sites) — the deployment-config doc can't drift from the code."""
+    import textwrap
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu import flags
+    from paddle_tpu.resilience import FAULT_SITE_DOCS
+
+    def bullet(head, body):
+        return "\n".join(textwrap.wrap(
+            f"- {head} — {body}", width=76, subsequent_indent="  "))
+
+    lines = [
+        "`paddle_tpu.serving.ServingEngine` batches requests at",
+        "iteration granularity: each step admits queued prompts into",
+        "free KV-cache slots (prefill padded to a length bucket, one",
+        "compile per bucket) and runs one batched decode over every",
+        "occupied slot (one compile, total). `submit()` returns a",
+        "request handle; `results()` collects them;",
+        "`serving.ServingHTTPServer` is the JSON front end",
+        "(`POST /v1/generate`, `GET /v1/stats`, `GET /health`; 429 on",
+        "queue-full backpressure). Per-phase latency lands in",
+        "`monitor.stats()` as `STAT_serving_prefill_ms` /",
+        "`STAT_serving_decode_ms`; throughput/shedding as the other",
+        "`STAT_serving_*` counters.",
+        "",
+        "Flags:",
+        "",
+    ]
+    defs = flags.list_flags()
+    for name in sorted(defs):
+        if name.startswith("serving_"):
+            d = defs[name]
+            lines.append(bullet(
+                f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
+    lines += [
+        "",
+        "Fault sites (see Fault tolerance for the spec grammar):",
+        "",
+    ]
+    lines += [bullet(f"`{site}`", doc)
+              for site, doc in FAULT_SITE_DOCS.items()
+              if site.startswith("serving.")]
+    return "\n".join(lines)
+
+
+def sync_serving_block(text, check):
+    """Returns (new_text, drift_message_or_None)."""
+    try:
+        b = text.index(_SERVING_BEGIN) + len(_SERVING_BEGIN)
+        e = text.index(_SERVING_END)
+    except ValueError:
+        raise SystemExit("README serving markers not found")
+    current = text[b:e].strip("\n")
+    want = render_serving_block()
+    if current == want:
+        print("README serving block in sync")
+        return text, None
+    if check:
+        return text, ("README serving block DRIFTS from the serving "
+                      "flag/site registries — rerun tools/sync_readme.py")
+    print("README serving block regenerated")
+    return text[:b] + "\n" + want + "\n" + text[e:], None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--check", action="store_true",
@@ -234,7 +308,8 @@ def main():
         text = f.read()
     orig = text
     drifts = []
-    for sync in (sync_headline, sync_checks_block, sync_fault_block):
+    for sync in (sync_headline, sync_checks_block, sync_fault_block,
+                 sync_serving_block):
         text, drift = sync(text, args.check)
         if drift:
             drifts.append(drift)
