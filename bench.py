@@ -340,10 +340,14 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
 
     ``batch`` = engine slots, ``seq`` = per-slot KV capacity, ``steps``
     = requests per slot (steps*batch mixed-length requests total).
-    Reports generated tokens/s plus p50/p99 submit-to-finish latency;
-    ``vs_baseline`` is the speedup over serving the same requests one
-    at a time through ``greedy_search`` (the pre-engine path), unless
-    BENCH_SERVING_COMPARE=0 skips that run.
+    Reports generated tokens/s plus p50/p99 submit-to-finish latency
+    and TTFT/TPOT percentiles; ``vs_baseline`` is the speedup over
+    serving the same requests one at a time through ``greedy_search``
+    (the pre-engine path), unless BENCH_SERVING_COMPARE=0 skips that
+    run. With BENCH_SERVING_SPEC=K (default 4; 0 disables) it also
+    serves a repetitive-suffix workload — where the n-gram self-drafter
+    earns its keep — once without and once with speculative decoding
+    and reports the spec_* block (tokens/s, acceptance rate, speedup).
     """
     import jax
 
@@ -355,6 +359,7 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     dev = jax.devices()[0]
     gpt = os.environ.get("BENCH_SERVING_GPT", "gpt2-medium")
     new_tokens = int(os.environ.get("BENCH_SERVING_NEW_TOKENS", "32"))
+    spec_k = int(os.environ.get("BENCH_SERVING_SPEC", "4"))
     nreq = steps * batch
     try:
         pt.seed(0)
@@ -362,30 +367,44 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
         model = GPTForCausalLM(cfg)
         model.eval()
         rng = np.random.RandomState(0)
-        max_prompt = max(4, min(64, seq - new_tokens))
+        max_prompt = max(4, min(64, seq - new_tokens - spec_k))
 
         def prompts(n, r):
             return [r.randint(1, cfg.vocab_size,
                               size=r.randint(4, max_prompt + 1)).tolist()
                     for _ in range(n)]
 
-        def serve(ps):
+        def rep_prompts(n, r):
+            # repetitive-suffix workload: periodic token patterns the
+            # n-gram drafter predicts near-perfectly (code/templated
+            # text analog)
+            out = []
+            for _ in range(n):
+                period = r.randint(2, 5)
+                pat = r.randint(1, cfg.vocab_size, size=period).tolist()
+                ln = r.randint(8, max_prompt + 1)
+                out.append((pat * (ln // period + 1))[:ln])
+            return out
+
+        def serve(ps, k=0):
             eng = ServingEngine(model, max_slots=batch, max_len=seq,
-                                max_queue=len(ps) + batch)
+                                max_queue=len(ps) + batch,
+                                spec_tokens=k)
             reqs = [eng.submit(p, max_new_tokens=new_tokens) for p in ps]
             eng.run_until_idle()
-            return reqs
+            return reqs, eng
 
         # warmup fleet: every prefill bucket + the decode step compile
         # outside the timed window
         serve(prompts(2 * batch, np.random.RandomState(1)))
         ps = prompts(nreq, rng)
         t0 = time.perf_counter()
-        reqs = serve(ps)
+        reqs, eng = serve(ps)
         dt = time.perf_counter() - t0
         assert all(r.state == "done" for r in reqs)
         toks = sum(len(r.tokens) for r in reqs)
         lat = sorted(r.latency for r in reqs)
+        eng_stats = eng.stats()
         seq_dt = None
         if os.environ.get("BENCH_SERVING_COMPARE", "1") != "0":
             sub = ps[:batch]   # sequential sample; compiled b=1 warmup
@@ -396,6 +415,31 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
                 greedy_search(model, np.asarray([p]),
                               max_new_tokens=new_tokens, cache_len=seq)
             seq_dt = (time.perf_counter() - t0) / len(sub)
+        spec = None
+        if spec_k > 0:
+            rep = rep_prompts(nreq, np.random.RandomState(2))
+            # warm the verify compile outside the timed window
+            serve(rep_prompts(batch, np.random.RandomState(3)), k=spec_k)
+            t0 = time.perf_counter()
+            base_reqs, _ = serve(rep)
+            base_dt = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            spec_reqs, spec_eng = serve(rep, k=spec_k)
+            spec_dt = time.perf_counter() - t0
+            for a, b in zip(base_reqs, spec_reqs):
+                assert a.output_ids == b.output_ids, \
+                    "speculative decode diverged from plain greedy"
+            base_toks = sum(len(r.tokens) for r in base_reqs)
+            spec_toks = sum(len(r.tokens) for r in spec_reqs)
+            st = spec_eng.stats()
+            spec = {
+                "spec_tokens": spec_k,
+                "tokens_per_sec": round(spec_toks / spec_dt, 1),
+                "nonspec_tokens_per_sec": round(base_toks / base_dt, 1),
+                "speedup": round((spec_toks / spec_dt) /
+                                 (base_toks / base_dt), 2),
+                "acceptance_rate": st.get("spec_acceptance_rate"),
+            }
     except Exception as e:
         msg = str(e)
         if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
@@ -406,7 +450,7 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     tokens_per_sec = toks / dt
     req_dt = dt / nreq   # engine wall time amortized per request
     speedup = round(seq_dt / req_dt, 2) if seq_dt else 1.0
-    print(json.dumps({
+    out = {
         "metric": "serving_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
@@ -414,10 +458,17 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
         "p50_latency_ms": round(lat[len(lat) // 2] * 1000, 1),
         "p99_latency_ms": round(
             lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1000, 1),
+        "ttft_p50_ms": eng_stats["ttft_p50_ms"],
+        "ttft_p99_ms": eng_stats["ttft_p99_ms"],
+        "tpot_p50_ms": eng_stats["tpot_p50_ms"],
+        "tpot_p99_ms": eng_stats["tpot_p99_ms"],
         "requests": nreq, "slots": batch, "max_len": seq,
         "new_tokens": new_tokens, "model": gpt,
         "device": getattr(dev, "device_kind", str(dev)),
-    }))
+    }
+    if spec is not None:
+        out["spec"] = spec
+    print(json.dumps(out))
     return 0
 
 

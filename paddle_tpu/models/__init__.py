@@ -6,7 +6,8 @@ python/paddle/vision/models/)."""
 from .gpt import (GPT_CONFIGS, GPTForCausalLM, GPTModel, gpt2_medium,
                   gpt2_small, gpt2_tiny)
 from . import generation
-from .generation import beam_search, decode_step, greedy_search, sample
+from .generation import (beam_search, decode_step, draft_ngram,
+                         greedy_search, sample, verify_step)
 from .ernie import (ERNIE_CONFIGS, ErnieForPretraining,
                     ErnieForSequenceClassification, ErnieModel,
                     ernie_tiny)

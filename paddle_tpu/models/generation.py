@@ -15,6 +15,11 @@ axis each step, forcing an XLA recompile per generated token.
 ``decode_step(model)`` exposes the per-model compiled step (and its
 trace counter, asserted ==1 in tests); ``paddle_tpu.serving`` drives
 the same step function with slots on the batch axis.
+
+``verify_step(model, k)`` is the speculative-decoding sibling: one
+fixed-shape forward scores K+1 positions (the last committed token
+plus K drafts from ``draft_ngram``), so a serving step can commit up
+to K+1 tokens while staying on a single compiled executable.
 """
 
 from __future__ import annotations
@@ -71,6 +76,83 @@ def decode_step(model):
            "flags_version": _flags.version()}
     model._decode_step_cache = ent
     return ent
+
+
+def verify_step(model, spec_tokens: int):
+    """The compiled draft–verify step for speculative decoding.
+
+    Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn``
+    maps ``(tokens [b, K+1] i32, pos [b] i32, caches)`` to
+    ``(next_tokens [b, K+1] i32, logits [b, K+1, V], new_caches)``.
+    Row layout: ``tokens[:, 0]`` is each row's last *committed* token
+    (the one a plain decode step would feed), ``tokens[:, 1:]`` the K
+    draft tokens proposed for the positions after it. One forward
+    scatter-writes all K+1 rows at ``pos..pos+K`` and scores them
+    under the causal position mask, so ``next_tokens[:, i]`` is the
+    model's true greedy continuation after consuming ``tokens[:, :i+1]``
+    — valid exactly while the drafts match, which is the acceptance
+    test the caller runs on the host. The rejected tail's cache rows
+    are garbage past the accepted prefix; the caller rolls the slot's
+    write offset back and the position mask hides them.
+
+    Compiled once per (model, K) — the fixed K+1 query width is what
+    keeps speculative serving on a single XLA executable. Cached on
+    the model keyed by the flag-plane version, like ``decode_step``.
+    """
+    from .. import flags as _flags
+    k = int(spec_tokens)
+    if k < 1:
+        raise ValueError(f"verify_step needs spec_tokens >= 1, got {k}")
+    cache = getattr(model, "_verify_step_cache", None)
+    if cache is None:
+        cache = model._verify_step_cache = {}
+    ent = cache.get(k)
+    if ent is not None and ent["flags_version"] == _flags.version():
+        return ent
+    traces = {"count": 0}
+
+    def _step(tokens, pos, caches):
+        traces["count"] += 1
+        with no_grad():
+            tcaches = [(Tensor(kk, stop_gradient=True),
+                        Tensor(vv, stop_gradient=True))
+                       for kk, vv in caches]
+            logits, newc = model(_t(tokens), cache=tcaches,
+                                 cache_pos=pos)
+        lg = logits.value                                # [b, K+1, V]
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return nxt, lg, [(c[0].value, c[1].value) for c in newc]
+
+    ent = {"fn": jax.jit(_step), "traces": traces,
+           "flags_version": _flags.version()}
+    cache[k] = ent
+    return ent
+
+
+def draft_ngram(context, k: int, max_ngram: int = 3):
+    """N-gram self-drafting (prompt-lookup decoding): propose ``k``
+    draft tokens by matching the longest suffix n-gram of ``context``
+    (prompt + generated so far) against its own earlier occurrences
+    and copying what followed — no second model, and very accurate on
+    repetitive/structured tails, which is where speculation pays.
+
+    Tries n-grams from ``max_ngram`` down to 1, preferring the most
+    recent match; a short continuation is cycled up to ``k`` (periodic
+    text keeps its period); with no match at all the last token is
+    repeated. Pure host-side list work, O(len * max_ngram) per call.
+    """
+    ctx = [int(t) for t in context]
+    n_ctx = len(ctx)
+    for n in range(min(int(max_ngram), n_ctx - 1), 0, -1):
+        pat = ctx[n_ctx - n:]
+        for j in range(n_ctx - n - 1, -1, -1):
+            if ctx[j:j + n] == pat:
+                cont = ctx[j + n:j + n + k]
+                if cont:
+                    while len(cont) < k:
+                        cont = cont + cont
+                    return cont[:k]
+    return [ctx[-1]] * k
 
 
 def _prefill(model, ids: np.ndarray, capacity: int):

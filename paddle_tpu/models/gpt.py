@@ -113,24 +113,19 @@ class GPTAttention(Layer):
             # preallocated [b, h, max_len, d] pair and the new keys are
             # written in place at each row's own offset, so every
             # decode step has ONE shape and XLA compiles it once. The
-            # per-row position mask stands in for the causal structure.
+            # same path serves s > 1 blocks — bucketed prefill and the
+            # speculative verify step (last token + K drafts) both
+            # scatter-write s rows at once; the per-row position mask
+            # keeps each query row causal within the written block.
             # Inference-only by construction (writes bypass the tape).
-            import jax
-
-            from ..ops.attention_ops import decode_attention_mask
+            from ..ops.attention_ops import (cache_scatter_write,
+                                             decode_attention_mask)
             kc, vc = cache[0].value, cache[1].value
             pos = jnp.asarray(cache_pos, jnp.int32)
             if pos.ndim == 0:
                 pos = jnp.broadcast_to(pos, (b,))
-
-            def _write(buf, new, p):
-                # all start indices must share a dtype (x64 mode makes
-                # bare 0 an int64)
-                z = jnp.zeros((), jnp.int32)
-                return jax.lax.dynamic_update_slice(buf, new, (z, p, z))
-
-            kc = jax.vmap(_write)(kc, k.value, pos)
-            vc = jax.vmap(_write)(vc, v.value, pos)
+            kc = cache_scatter_write(kc, k.value, pos)
+            vc = cache_scatter_write(vc, v.value, pos)
             mask = decode_attention_mask(pos, s, kc.shape[2], kc.dtype)
             cache = (Tensor(kc, stop_gradient=True),
                      Tensor(vc, stop_gradient=True))

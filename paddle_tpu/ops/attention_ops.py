@@ -32,10 +32,19 @@ def decode_attention_mask(pos, q_len: int, capacity: int,
     """Additive attention mask for the fixed-capacity KV-cache decode
     path: query i (absolute position ``pos[b] + i``) may attend cache
     entry j iff ``j <= pos[b] + i``. Entries past the valid length —
-    prefill padding, stale rows from a retired slot — get
-    ``finfo.min``, which the softmax turns into an exact 0 probability,
-    so a [max_slots, heads, max_len, d] cache behaves like each slot's
-    true-length cache. Returns [b, 1, q_len, capacity].
+    prefill padding, stale rows from a retired slot, a speculative
+    verify's rejected tail — get ``finfo.min``, which the softmax turns
+    into an exact 0 probability, so a [max_slots, heads, max_len, d]
+    cache behaves like each slot's true-length cache. Returns
+    [b, 1, q_len, capacity].
+
+    With ``q_len > 1`` this is also the verify-step mask for
+    speculative decoding: the K+1 query rows (last committed token +
+    K draft tokens, freshly scatter-written at ``pos..pos+K`` by
+    :func:`cache_scatter_write`) each see exactly the causal prefix
+    ``j <= pos + i``, so row i's logits equal what a sequential decode
+    at that position would produce — the acceptance test compares
+    argmaxes directly against the draft.
     """
     pos = jnp.asarray(pos, jnp.int32)
     qpos = pos[:, None] + jnp.arange(q_len, dtype=jnp.int32)  # [b, q]
@@ -43,6 +52,32 @@ def decode_attention_mask(pos, q_len: int, capacity: int,
         <= qpos[:, :, None]                                   # [b, q, C]
     neg = jnp.asarray(jnp.finfo(dtype).min, dtype)
     return jnp.where(valid, jnp.zeros((), dtype), neg)[:, None]
+
+
+def cache_scatter_write(buf, new, pos):
+    """Write ``new`` [b, h, s, d] rows into the fixed-capacity cache
+    ``buf`` [b, h, capacity, d] at each batch row's own offset
+    ``pos[b]`` (one in-place dynamic_update_slice per row, vmapped so
+    the batched decode/verify step stays a single fused XLA op).
+
+    Contract: ``pos[b] + s <= capacity`` for every live row. XLA
+    *clamps* out-of-range start indices instead of failing, which
+    would silently shift the write window back onto valid rows and
+    corrupt the slot's committed prefix — callers reserve headroom up
+    front (ServingEngine.submit keeps ``prompt + max_new_tokens +
+    spec_tokens`` within the slot capacity for exactly this reason).
+    """
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos, (buf.shape[0],))
+
+    def _write(b, n, p):
+        # all start indices must share a dtype (x64 mode makes a bare
+        # python 0 an int64)
+        z = jnp.zeros((), jnp.int32)
+        return jax.lax.dynamic_update_slice(b, n, (z, p, z))
+
+    return jax.vmap(_write)(buf, new, pos)
 
 
 def _composed_attention(q, k, v, mask, causal, scale):

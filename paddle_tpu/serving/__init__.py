@@ -2,10 +2,14 @@
 
 Continuous-batching engine over a slotted fixed-shape KV cache:
 requests share one preallocated decode batch (one slot each), prefill
-is shape-bucketed so compiles are bounded by the bucket count, and the
-decode step compiles exactly once per engine geometry. See engine.py
-for the scheduler, kv_cache.py for the memory manager, http.py for the
-JSON front end.
+is shape-bucketed AND batched (every same-bucket admission rides one
+dispatch), and the decode step compiles exactly once per engine
+geometry. With ``FLAGS_serving_spec_tokens`` = K > 0 the engine runs
+draft–verify speculative decoding: an n-gram self-drafter proposes K
+tokens per slot and one fixed-shape verify forward commits up to K+1
+tokens per step, token-identical to the plain greedy path. See
+engine.py for the scheduler, kv_cache.py for the memory manager,
+http.py for the JSON front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine

@@ -8,17 +8,31 @@ finishes mid-batch releases its slot immediately and the next queued
 request takes it on the following step — the decode batch never drains
 to let stragglers finish.
 
-Two compile surfaces, both fixed-shape:
+Compile surfaces, all fixed-shape:
 
 - decode: ``models.generation.decode_step(model)`` at batch =
   ``max_slots`` — every step of every request, one XLA executable;
+- verify (``FLAGS_serving_spec_tokens`` = K > 0): speculative
+  decoding replaces the one-token decode with
+  ``models.generation.verify_step(model, K)`` — an on-host n-gram
+  self-drafter proposes K tokens per slot from the request's own
+  generated suffix, one fixed-shape forward scores all K+1 positions,
+  and the accepted prefix commits to the cache while the rejected
+  tail's write offset rolls back. Greedy output is token-identical to
+  K=0 (the correctness oracle); throughput gains scale with the
+  drafter's acceptance rate (``STAT_serving_spec_*``). One XLA
+  executable, compiled once per engine geometry like decode;
 - prefill: one jitted function per prompt-length *bucket*
-  (``FLAGS_serving_prefill_buckets``); prompts are right-padded to the
-  smallest bucket that fits, so a fleet of arbitrary-length prompts
-  compiles ``len(buckets)`` times, total. Padding is sound because the
-  position mask hides rows past the true length and decode overwrites
-  them in place — same reuse idea as CompiledProgram's keyed ``_cache``
-  (compiler.py), keyed here by shape bucket instead of program.
+  (``FLAGS_serving_prefill_buckets``) at a fixed ``max_slots`` batch;
+  prompts are right-padded to the smallest bucket that fits and every
+  queued same-bucket admission rides ONE dispatch of that function
+  per step (batch rows past the admitted count are padding), so a
+  fleet of arbitrary-length prompts compiles ``len(buckets)`` times
+  and dispatches once per (bucket, step), total. Padding is sound
+  because the position mask hides rows past the true length and
+  decode overwrites them in place — same reuse idea as
+  CompiledProgram's keyed ``_cache`` (compiler.py), keyed here by
+  shape bucket instead of program.
 
 Resilience: ``serving.submit`` faults reject a submission at admission
 (backpressure path); ``serving.step`` faults fire once per prefill
@@ -46,7 +60,7 @@ from .. import monitor as _monitor
 from .. import profiler as _profiler
 from ..dygraph.tape import no_grad
 from ..dygraph.tensor import Tensor
-from ..models.generation import decode_step
+from ..models.generation import decode_step, draft_ngram, verify_step
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
 from .kv_cache import SlotKVCache
@@ -91,6 +105,7 @@ class Request:
         self.slot: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
 
@@ -108,6 +123,24 @@ class Request:
         if self.finished_at is None:
             return None
         return self.finished_at - self.submitted_at
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time-to-first-token: submit to first generated token,
+        seconds (None before the prefill lands)."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Time-per-output-token: mean seconds per generated token
+        after the first (None until finished with >= 2 tokens)."""
+        if self.finished_at is None or self.first_token_at is None or \
+                len(self.tokens) < 2:
+            return None
+        return (self.finished_at - self.first_token_at) / \
+            (len(self.tokens) - 1)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
@@ -144,12 +177,15 @@ class ServingEngine:
                  max_len: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
                  max_queue: Optional[int] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 spec_tokens: Optional[int] = None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
                               "serving_max_new_tokens",
-                              "serving_idle_wait"])
+                              "serving_idle_wait",
+                              "serving_spec_tokens",
+                              "serving_spec_ngram"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -165,6 +201,16 @@ class ServingEngine:
         self.default_max_new_tokens = int(g["serving_max_new_tokens"])
         self.default_eos_token_id = eos_token_id
         self.idle_wait = float(g["serving_idle_wait"])
+        self.spec_tokens = int(spec_tokens if spec_tokens is not None
+                               else g["serving_spec_tokens"])
+        self.spec_ngram = int(g["serving_spec_ngram"])
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {self.spec_tokens}")
+        if self.spec_tokens >= self.max_len:
+            raise ValueError(
+                f"spec_tokens {self.spec_tokens} leaves no room in "
+                f"max_len={self.max_len} slots")
         self.buckets = (_parse_buckets(g["serving_prefill_buckets"],
                                        self.max_len)
                         if buckets is None else
@@ -182,6 +228,10 @@ class ServingEngine:
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._prefill_fns: Dict[int, dict] = {}   # bucket len -> entry
+        # latency samples of completed requests: (ttft s, tpot s|None)
+        self._lat: deque = deque(maxlen=4096)
+        self._spec_proposed = 0   # draft tokens offered to the verify
+        self._spec_accepted = 0   # draft tokens the model agreed with
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int],
@@ -199,10 +249,16 @@ class ServingEngine:
             raise ValueError("empty prompt")
         if mnt < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
-        if len(prompt) + mnt > self.max_len:
+        if len(prompt) + mnt + self.spec_tokens > self.max_len:
+            # speculative decoding reserves spec_tokens rows of slot
+            # headroom: the verify step scatter-writes K+1 rows at the
+            # current offset, and XLA would *clamp* an out-of-range
+            # write back onto committed rows instead of failing
+            spec = (f" + spec_tokens ({self.spec_tokens})"
+                    if self.spec_tokens else "")
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) "
-                f"exceeds slot capacity max_len={self.max_len}")
+                f"prompt ({len(prompt)}) + max_new_tokens ({mnt})"
+                f"{spec} exceeds slot capacity max_len={self.max_len}")
         # raising kinds reject this submission pre-queue; `skip` sheds
         # it through the same backpressure exit as a full queue
         kind = fault_point("serving.submit")
@@ -232,73 +288,128 @@ class ServingEngine:
 
     def _prefill_entry(self, bucket: int) -> dict:
         """The jitted prompt pass for one length bucket (compiled on
-        first use, reused for every prompt that pads to it). Maps
-        ``(ids [1, bucket] i32, last i32)`` to the logits row at the
-        true last prompt position plus full-capacity cache rows."""
-        ent = self._prefill_fns.get(bucket)
+        first use, reused for every admission that pads to it). Fixed
+        batch = ``max_slots`` so every same-bucket admission in a step
+        shares ONE dispatch: maps ``(ids [max_slots, bucket] i32,
+        last [max_slots] i32)`` to each row's logits at its true last
+        prompt position plus full-capacity cache rows; rows past the
+        admitted count are padding the caller discards.
+
+        Cached on the MODEL keyed by (bucket, max_slots, max_len) —
+        like ``decode_step``/``verify_step`` — so engine restarts with
+        the same geometry (benchmark reruns, rolling deploys) reuse the
+        executable instead of paying the prefill compile again."""
+        key = (bucket, self.max_slots, self.max_len)
+        cache = getattr(self.model, "_prefill_step_cache", None)
+        if cache is None:
+            cache = self.model._prefill_step_cache = {}
+        ent = cache.get(key)
         if ent is not None and ent["flags_version"] == _flags.version():
+            self._prefill_fns[bucket] = ent
             return ent
         traces = {"count": 0}
-        model, max_len = self.model, self.max_len
+        model, max_len, slots = self.model, self.max_len, self.max_slots
 
         def _prefill(ids, last):
             traces["count"] += 1
             with no_grad():
-                cache = model.gpt.gen_fixed_cache(1, max_len)
+                cache = model.gpt.gen_fixed_cache(slots, max_len)
                 logits, newc = model(
                     Tensor(ids, stop_gradient=True), cache=cache,
                     cache_pos=0)
-            lg = jax.lax.dynamic_slice_in_dim(logits.value, last, 1,
-                                              axis=1)[:, 0]
+            lg = jnp.take_along_axis(logits.value,
+                                     last[:, None, None], axis=1)[:, 0]
             return lg, [(c[0].value, c[1].value) for c in newc]
 
         ent = {"fn": jax.jit(_prefill), "traces": traces,
                "flags_version": _flags.version()}
+        cache[key] = ent
         self._prefill_fns[bucket] = ent
         return ent
 
-    def _prefill_attempt(self, req: Request):
-        kind = fault_point("serving.step")
-        if kind == "skip":
-            raise _Shed(f"injected skip during prefill of request "
-                        f"{req.id}")
-        n = len(req.prompt)
-        bucket = self._bucket_for(n)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = req.prompt
+    def _prefill_group_attempt(self, bucket: int, group: List[Request]):
+        """One batched prefill attempt for every same-bucket admission.
+        The fault site fires once per request per attempt (preserving
+        the per-request `skip`-sheds-one semantics); surviving requests
+        share one dispatch of the bucket's compiled function. Returns
+        ``(live, shed, (logits, rows) | None)``."""
+        live, shed = [], []
+        for req in group:
+            kind = fault_point("serving.step")
+            if kind == "skip":
+                shed.append((req, _Shed("injected skip during prefill "
+                                        f"of request {req.id}")))
+            else:
+                live.append(req)
+        if not live:
+            return live, shed, None
+        ids = np.zeros((self.max_slots, bucket), np.int32)
+        last = np.zeros(self.max_slots, np.int32)
+        for i, req in enumerate(live):
+            ids[i, :len(req.prompt)] = req.prompt
+            last[i] = len(req.prompt) - 1
         fn = self._prefill_entry(bucket)["fn"]
-        return fn(jnp.asarray(padded), jnp.asarray(n - 1, jnp.int32))
+        return live, shed, fn(jnp.asarray(ids), jnp.asarray(last))
 
-    def _admit(self) -> int:
-        """Fill free slots from the queue; one bucketed prefill per
-        admission. Returns how many requests were admitted."""
+    def _admit_round(self):
+        """One admission pass: pop up to num_free queued requests,
+        group them by prefill bucket, and run ONE batched prefill per
+        group. Returns (popped, admitted)."""
+        candidates: List[Request] = []
+        with self._lock:
+            while len(candidates) < self.cache.num_free and self._queue:
+                candidates.append(self._queue.popleft())
+        if not candidates:
+            return 0, 0
+        groups: Dict[int, List[Request]] = {}
+        for req in candidates:
+            groups.setdefault(self._bucket_for(len(req.prompt)),
+                              []).append(req)
         admitted = 0
-        while self.cache.num_free:
-            with self._lock:
-                if not self._queue:
-                    break
-                req = self._queue.popleft()
-            slot = self.cache.alloc()
+        for bucket in sorted(groups):
+            group = groups[bucket]
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
                         _profiler.RecordEvent("serving.prefill"):
-                    lg, rows = RetryPolicy.from_flags(
-                        "serving.step").call(self._prefill_attempt, req)
-            except (_Shed, RetryError) as e:
-                self.cache.release(slot)
-                self._shed(req, e)
+                    live, shed, out = RetryPolicy.from_flags(
+                        "serving.step").call(self._prefill_group_attempt,
+                                             bucket, group)
+            except RetryError as e:
+                for req in group:
+                    self._shed(req, e)
                 continue
-            self.cache.write_prefill(slot, rows, len(req.prompt))
-            req.slot = slot
-            req.state = "running"
-            self._active[slot] = req
-            admitted += 1
-            _monitor.stat_add("STAT_serving_prefills")
-            # the first generated token comes from the prefill logits
-            # (same argmax greedy_search takes after ITS prefill)
-            self._append_token(req, int(np.asarray(
-                jnp.argmax(lg, axis=-1))[0]))
-        return admitted
+            for req, err in shed:
+                self._shed(req, err)
+            if not live:
+                continue
+            lg, rows = out
+            slots = [self.cache.alloc() for _ in live]
+            self.cache.write_prefill_batch(
+                slots, rows, [len(r.prompt) for r in live])
+            first = np.asarray(jnp.argmax(lg, axis=-1))
+            for i, (req, slot) in enumerate(zip(live, slots)):
+                req.slot = slot
+                req.state = "running"
+                self._active[slot] = req
+                admitted += 1
+                _monitor.stat_add("STAT_serving_prefills")
+                # the first generated token comes from the prefill
+                # logits (same argmax greedy_search takes after ITS
+                # prefill)
+                self._append_token(req, int(first[i]))
+        return len(candidates), admitted
+
+    def _admit(self) -> int:
+        """Fill free slots from the queue (batched, one prefill
+        dispatch per bucket per round). Returns how many requests were
+        admitted; keeps going while progress frees more slots (e.g. a
+        request that finishes on its prefill token)."""
+        admitted = 0
+        while True:
+            popped, n = self._admit_round()
+            admitted += n
+            if not popped:
+                return admitted
 
     # ------------------------------------------------------------ decode
     def _decode_attempt(self, tokens: np.ndarray):
@@ -337,14 +448,84 @@ class ServingEngine:
         nxt = np.asarray(nxt)
         produced = 0
         for slot, req in list(self._active.items()):
-            self.cache.lengths[slot] += 1
+            self.cache.advance(slot, 1)
             self._append_token(req, int(nxt[slot]))
             produced += 1
+        return produced
+
+    # ------------------------------------------------- speculative decode
+    def _verify_attempt(self, tokens: np.ndarray):
+        kind = fault_point("serving.step")
+        if kind == "skip":
+            raise _SkipStep("injected skip of one verify iteration")
+        fn = verify_step(self.model, self.spec_tokens)["fn"]
+        return fn(jnp.asarray(tokens),
+                  jnp.asarray(self.cache.lengths),
+                  self.cache.arrays())
+
+    def _spec_decode(self) -> int:
+        """One speculative draft–verify step over every occupied slot:
+        draft K tokens per slot from its own generated suffix, score
+        all K+1 positions in one compiled forward, commit the accepted
+        prefix (plus the model's one guaranteed next token) and roll
+        the rejected tail's write offset back. Returns tokens produced
+        (anywhere from len(active) to (K+1)*len(active))."""
+        if not self._active:
+            return 0
+        K = self.spec_tokens
+        tokens = np.zeros((self.max_slots, K + 1), np.int32)
+        drafts = np.zeros((self.max_slots, K), np.int32)
+        for slot, req in self._active.items():
+            d = draft_ngram(req.prompt + req.tokens, K, self.spec_ngram)
+            tokens[slot, 0] = req.tokens[-1]
+            tokens[slot, 1:] = d
+            drafts[slot] = d
+        try:
+            with _monitor.stat_time("STAT_serving_verify"), \
+                    _profiler.RecordEvent("serving.verify"):
+                nxt, _, arrays = RetryPolicy.from_flags(
+                    "serving.step").call(self._verify_attempt, tokens)
+        except _SkipStep:
+            return 0
+        except RetryError as e:
+            for slot, req in list(self._active.items()):
+                del self._active[slot]
+                self.cache.release(slot)
+                self._shed(req, e)
+            return 0
+        self.cache.set_arrays(arrays)
+        nxt = np.asarray(nxt)
+        produced = 0
+        for slot, req in list(self._active.items()):
+            # the verify wrote K+1 rows at this slot's offset; commit
+            # them optimistically, then trim to what was accepted
+            self.cache.advance(slot, K + 1)
+            committed = accepted = 0
+            for i in range(K + 1):
+                tok = int(nxt[slot, i])
+                self._append_token(req, tok)
+                committed += 1
+                produced += 1
+                if req.state != "running":
+                    break        # finished (EOS / budget) mid-verify
+                if i == K or int(drafts[slot, i]) != tok:
+                    break        # out of drafts / first mismatch
+                accepted += 1
+            self._spec_proposed += K
+            self._spec_accepted += accepted
+            _monitor.stat_add("STAT_serving_spec_proposed", K)
+            _monitor.stat_add("STAT_serving_spec_accepted", accepted)
+            if req.state == "running":
+                # reject the unaccepted tail: roll the write offset
+                # back so the next step overwrites those rows
+                self.cache.rollback(slot, K + 1 - committed)
         return produced
 
     # -------------------------------------------------------- lifecycle
     def _append_token(self, req: Request, token: int):
         req.tokens.append(token)
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
         _monitor.stat_add("STAT_serving_tokens")
         if (req.eos_token_id is not None and
                 token == req.eos_token_id) or \
@@ -358,6 +539,8 @@ class ServingEngine:
             req.slot = None
         req.state = "done"
         req.finished_at = time.perf_counter()
+        with self._lock:
+            self._lat.append((req.ttft, req.tpot))
         _monitor.stat_add("STAT_serving_completed")
         req._done.set()
 
@@ -371,13 +554,46 @@ class ServingEngine:
 
     # --------------------------------------------------------- stepping
     def step(self) -> bool:
-        """One scheduler iteration: admit into free slots, then one
-        batched decode. Returns whether any work happened."""
+        """One scheduler iteration: admit into free slots (batched
+        per-bucket prefill), then one batched decode — or, with
+        speculation on, one draft–verify multi-token step. Returns
+        whether any work happened."""
         with self._step_lock:
             _monitor.stat_add("STAT_serving_steps")
             admitted = self._admit()
-            produced = self._decode()
+            produced = (self._spec_decode() if self.spec_tokens
+                        else self._decode())
             return bool(admitted or produced)
+
+    def stats(self) -> dict:
+        """Per-engine serving metrics: time-to-first-token and
+        time-per-output-token percentiles over the last completed
+        requests (up to the sample window), plus the speculative
+        acceptance counters. Percentiles are None until samples exist;
+        the HTTP front end merges this into ``GET /v1/stats``."""
+        with self._lock:
+            samples = list(self._lat)
+        ttft = sorted(s[0] for s in samples if s[0] is not None)
+        tpot = sorted(s[1] for s in samples if s[1] is not None)
+
+        def pct(xs, q):
+            if not xs:
+                return None
+            return round(xs[min(int(len(xs) * q), len(xs) - 1)] * 1e3, 3)
+
+        out = {
+            "ttft_p50_ms": pct(ttft, 0.50), "ttft_p99_ms": pct(ttft, 0.99),
+            "tpot_p50_ms": pct(tpot, 0.50), "tpot_p99_ms": pct(tpot, 0.99),
+            "latency_samples": len(samples),
+            "spec_tokens": self.spec_tokens,
+        }
+        if self.spec_tokens:
+            out["spec_proposed"] = self._spec_proposed
+            out["spec_accepted"] = self._spec_accepted
+            out["spec_acceptance_rate"] = (
+                round(self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else None)
+        return out
 
     @property
     def idle(self) -> bool:

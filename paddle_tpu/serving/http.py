@@ -8,9 +8,13 @@ minimal JSON generation protocol:
                       -> 200 {"id", "output_ids", "generated", "state"}
                       -> 400 bad request geometry / malformed JSON
                       -> 429 admission control (queue full / shed at
-                             submit — the backpressure signal)
+                             submit — the backpressure signal; carries
+                             a Retry-After header so well-behaved
+                             clients back off instead of hammering)
                       -> 503 request shed by fault policy mid-flight
-  GET  /v1/stats      -> 200 monitor.stats() (the STAT_serving_* plane)
+  GET  /v1/stats      -> 200 the STAT_serving_* counters merged with
+                             engine.stats() (TTFT / TPOT percentiles,
+                             speculative acceptance rate)
   GET  /health        -> 200 {"ok": true, "slots_free": n, "queued": n}
 
 Like the KV rendezvous server, this is unauthenticated cluster-private
@@ -20,6 +24,7 @@ HTTP; bind 127.0.0.1 (the default here) unless the network is trusted.
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -34,11 +39,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
-    def _json(self, code: int, payload: dict):
+    def _json(self, code: int, payload: dict,
+              headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -49,7 +57,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
                              "slots_free": engine.cache.num_free,
                              "queued": len(engine._queue)})
         elif self.path == "/v1/stats":
-            self._json(200, _monitor.stats_with_prefix("STAT_serving"))
+            payload = _monitor.stats_with_prefix("STAT_serving")
+            payload.update(engine.stats())
+            self._json(200, payload)
         else:
             self._json(404, {"error": f"unknown path {self.path!r}"})
 
@@ -70,7 +80,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
                                 max_new_tokens=body.get("max_new_tokens"),
                                 eos_token_id=body.get("eos_token_id"))
         except QueueFullError as e:
-            self._json(429, {"error": str(e)})
+            # Retry-After: one idle-wait is when the scheduler next
+            # looks at the queue — the earliest a retry could land
+            retry_s = max(1, int(math.ceil(engine.idle_wait)))
+            self._json(429, {"error": str(e)},
+                       headers={"Retry-After": str(retry_s)})
             return
         except ValueError as e:
             self._json(400, {"error": str(e)})

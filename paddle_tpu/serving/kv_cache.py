@@ -10,12 +10,16 @@ simplified to slot granularity: a TPU wants one big dense batch axis,
 not paged blocks, and max_len-bounded rows make the position mask
 (ops.attention_ops.decode_attention_mask) the only "page table".
 
-Slot lifecycle: ``alloc()`` (admission) -> ``write_prefill`` (the
-bucketed prompt pass populates the row and sets its valid length) ->
-per-step in-place row writes inside the compiled decode (lengths
-advance by one per generated token) -> ``release()`` (EOS/max-tokens)
-returns the slot for the next admission; stale row contents need no
-scrubbing — the position mask already excludes them.
+Slot lifecycle: ``alloc()`` (admission) -> ``write_prefill`` /
+``write_prefill_batch`` (the bucketed prompt pass populates the row
+and sets its valid length) -> per-step in-place row writes inside the
+compiled decode (``advance``: +1 per plain decode token, +K+1 per
+speculative verify) -> ``rollback`` of the rejected draft tail (the
+verify step writes K+1 rows optimistically; only the accepted prefix
+stays committed) -> ``release()`` (EOS/max-tokens) returns the slot
+for the next admission; stale row contents need no scrubbing — the
+position mask already excludes them, and the next write at the
+rolled-back offset overwrites them.
 """
 
 from __future__ import annotations
@@ -78,6 +82,47 @@ class SlotKVCache:
             (k.at[slot].set(rk[0]), v.at[slot].set(rv[0]))
             for (k, v), (rk, rv) in zip(self.layers, rows)]
         self.lengths[slot] = int(length)
+
+    def write_prefill_batch(self, slots, rows, lengths):
+        """Install several prefilled rows in one functional update per
+        layer: ``rows`` is one (k, v) pair per layer shaped
+        [batch, heads, max_len, d] (a batched prefill's output; only
+        the first ``len(slots)`` batch rows are meaningful — the rest
+        are padding), row i landing in ``slots[i]`` with true prompt
+        length ``lengths[i]``."""
+        import jax.numpy as jnp
+        n = len(slots)
+        if n != len(lengths):
+            raise ValueError(f"{n} slots but {len(lengths)} lengths")
+        sl = jnp.asarray(np.asarray(slots, np.int32))
+        self.layers = [
+            (k.at[sl].set(rk[:n]), v.at[sl].set(rv[:n]))
+            for (k, v), (rk, rv) in zip(self.layers, rows)]
+        for s, ln in zip(slots, lengths):
+            self.lengths[s] = int(ln)
+
+    def advance(self, slot: int, n: int = 1):
+        """Advance a slot's valid length by ``n`` freshly written rows
+        (1 for a plain decode token, K+1 after a speculative verify —
+        committed optimistically, then trimmed via :meth:`rollback`)."""
+        ln = int(self.lengths[slot]) + int(n)
+        if ln > self.max_len:
+            raise ValueError(
+                f"slot {slot}: advancing by {n} overflows capacity "
+                f"max_len={self.max_len} (at {self.lengths[slot]})")
+        self.lengths[slot] = ln
+
+    def rollback(self, slot: int, n: int):
+        """Roll a slot's write offset back over ``n`` rejected rows
+        (the speculative verify's unaccepted draft tail). The rows'
+        contents stay in the buffer but sit past the valid length, so
+        the position mask hides them and the next write at this offset
+        overwrites them."""
+        if n < 0 or n > int(self.lengths[slot]):
+            raise ValueError(
+                f"slot {slot}: cannot roll back {n} rows from length "
+                f"{self.lengths[slot]}")
+        self.lengths[slot] = int(self.lengths[slot]) - int(n)
 
     def arrays(self):
         """The per-layer (k, v) buffers, as fed to the decode step."""

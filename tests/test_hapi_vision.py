@@ -138,6 +138,10 @@ def test_mobilenet_v1_v2_forward_and_train():
     lab = np.array([1, 3], np.int64)
 
     for ctor in (mobilenet_v1, mobilenet_v2):
+        # pin the init: without this, the draw depends on how much of
+        # the global stream earlier tests consumed, and an unlucky init
+        # diverges under lr=0.1 instead of decreasing
+        pt.seed(0)
         m = ctor(scale=0.25, num_classes=10)
         out = m(pt.dygraph.to_tensor(x))
         assert tuple(out.shape) == (2, 10)

@@ -3,6 +3,8 @@ programs through the wrappers and execute them — numbers checked
 against numpy where cheap. Coverage count asserted against the
 reference's layers/nn.py __all__ (the round-4 'layers breadth' gap)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -137,10 +139,16 @@ def test_py_func_host_op():
     np.testing.assert_allclose(np.asarray(got), np.full((2, 4), 4.0))
 
 
+_REFERENCE_NN = "/root/reference/python/paddle/fluid/layers/nn.py"
+
+
+@pytest.mark.skipif(not os.path.exists(_REFERENCE_NN),
+                    reason="reference Paddle checkout not present in this "
+                           "environment")
 def test_wrapper_breadth_vs_reference():
     """The measurable closure of round-4 VERDICT partial #54."""
     import re
-    src = open("/root/reference/python/paddle/fluid/layers/nn.py").read()
+    src = open(_REFERENCE_NN).read()
     ref = set(re.findall(r"'(\w+)'", re.search(
         r"__all__ = \[(.*?)\]", src, re.S).group(1)))
     have = {n for n in ref if hasattr(pt.layers, n)}

@@ -15,6 +15,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.distributed.ring_attention import ring_attention
 
+# every test here lowers through the top-level jax.shard_map alias,
+# which this environment's jax (0.4.x) does not expose yet
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="installed jax has no jax.shard_map (0.4.x exposes only "
+           "jax.experimental.shard_map)")
+
 
 def _mesh(n=4):
     return Mesh(np.asarray(jax.devices()[:n]), ("sp",))

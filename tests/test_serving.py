@@ -17,7 +17,8 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import monitor
-from paddle_tpu.models.generation import decode_step, greedy_search
+from paddle_tpu.models.generation import (decode_step, draft_ngram,
+                                          greedy_search, verify_step)
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.serving import (QueueFullError, ServingEngine,
                                 ServingHTTPServer, SlotKVCache)
@@ -185,3 +186,243 @@ def test_slot_kv_cache_bookkeeping():
     c.release(a)
     assert c.lengths[a] == 0 and c.num_free == 1
     assert c.alloc() == 0  # lowest slot is reused first, deterministic
+
+
+# -- speculative decoding ------------------------------------------------
+
+def test_spec_engine_matches_nonspec_and_greedy(model):
+    """The correctness oracle: with spec_tokens > 0, mixed-length
+    concurrent requests through 2 slots (slot reuse + mid-batch
+    retirement + rollback every verify) produce token-for-token the
+    non-speculative engine's output, which itself equals sequential
+    greedy."""
+    # mix repetitive prompts (high acceptance) with random ones (low):
+    # both acceptance regimes must stay exact
+    prompts = _prompts((3, 7, 5, 11, 4), seed=6)
+    prompts[1] = [5, 9, 5, 9, 5, 9, 5]
+    prompts[3] = [2, 3, 4] * 3 + [2, 3]
+    kw = dict(max_slots=2, max_len=32, buckets=[4, 8, 16], max_queue=16)
+    spec = ServingEngine(model, spec_tokens=3, **kw)
+    plain = ServingEngine(model, spec_tokens=0, **kw)
+    sreqs = [spec.submit(p, max_new_tokens=6) for p in prompts]
+    preqs = [plain.submit(p, max_new_tokens=6) for p in prompts]
+    spec.run_until_idle()
+    plain.run_until_idle()
+    assert all(r.state == "done" for r in sreqs + preqs)
+    assert len(prompts) > spec.max_slots   # every slot was reused
+    for p, s, q in zip(prompts, sreqs, preqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=6,
+                            cache_len=spec.max_len)[0].tolist()
+        assert s.output_ids == q.output_ids == ref, \
+            f"request {s.id} diverged under speculation"
+
+
+def test_spec_verify_compiles_once(model):
+    """Compile budget under speculation: verify traces exactly once
+    for the engine's K, decode is never traced (the verify step IS the
+    decode), and prefill still compiles once per bucket."""
+    k = 4
+    before_v = verify_step(model, k)["traces"]["count"]
+    before_d = decode_step(model)["traces"]["count"]
+    eng = ServingEngine(model, max_slots=3, max_len=32,
+                        buckets=[4, 8, 16], max_queue=32, spec_tokens=k)
+    for p in _prompts((2, 3, 4, 6, 7, 9, 13, 15), seed=7):
+        eng.submit(p, max_new_tokens=4)
+    eng.run_until_idle()
+    assert verify_step(model, k)["traces"]["count"] - before_v == 1
+    assert decode_step(model)["traces"]["count"] - before_d == 0
+    used = {b: e["traces"]["count"] for b, e in eng._prefill_fns.items()}
+    assert used == {4: 1, 8: 1, 16: 1}
+
+
+def test_spec_eos_mid_verify_matches_greedy(model):
+    """EOS discovered inside a verify window finishes the request
+    mid-commit, exactly where sequential greedy stops."""
+    prompts = _prompts((4, 6), seed=8)
+    ref0 = greedy_search(model, np.asarray([prompts[0]]),
+                         max_new_tokens=8, cache_len=32)[0].tolist()
+    eos = ref0[len(prompts[0]) + 1]
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8],
+                        eos_token_id=eos, spec_tokens=3)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=8,
+                            eos_token_id=eos,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref
+    assert reqs[0].tokens[-1] == eos and len(reqs[0].tokens) < 8
+
+
+def test_spec_acceptance_stats(model):
+    """Acceptance accounting: a strongly periodic prompt drives the
+    n-gram drafter's acceptance rate up, and both the engine stats and
+    the monitor counters see proposed/accepted."""
+    monitor.reset()
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        spec_tokens=3)
+    eng.submit([5, 9, 5, 9, 5, 9], max_new_tokens=8)
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["spec_tokens"] == 3
+    assert st["spec_proposed"] > 0
+    assert 0 <= st["spec_accepted"] <= st["spec_proposed"]
+    assert st["spec_acceptance_rate"] == pytest.approx(
+        st["spec_accepted"] / st["spec_proposed"], abs=1e-3)
+    assert monitor.stat_get("STAT_serving_spec_proposed") == \
+        st["spec_proposed"]
+    assert monitor.stat_get("STAT_serving_spec_accepted") == \
+        st["spec_accepted"]
+    # fewer verify steps than tokens generated = speculation paid off
+    assert monitor.stat_get("STAT_serving_verify_calls") < \
+        monitor.stat_get("STAT_serving_tokens")
+
+
+def test_spec_headroom_validation(model):
+    """Speculation reserves K rows of slot headroom at admission: a
+    geometry that fits without speculation is rejected with it (the
+    verify scatter-write must never clamp onto committed rows)."""
+    plain = ServingEngine(model, max_slots=1, max_len=16, buckets=[8])
+    plain.submit(list(range(1, 11)), max_new_tokens=6)   # 10+6 == 16 ok
+    spec = ServingEngine(model, max_slots=1, max_len=16, buckets=[8],
+                         spec_tokens=4)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        spec.submit(list(range(1, 11)), max_new_tokens=6)  # 10+6+4 > 16
+    spec.submit(list(range(1, 7)), max_new_tokens=6)       # 6+6+4 ok
+
+
+def test_draft_ngram():
+    """The self-drafter: longest-suffix match, most recent occurrence
+    wins, short continuations cycle, no match repeats the last token."""
+    assert draft_ngram([1, 2, 3, 1, 2], 2) == [3, 1]      # bigram match
+    assert draft_ngram([4, 4, 4, 4], 3) == [4, 4, 4]      # periodic
+    assert draft_ngram([1, 2, 3, 4], 2) == [4, 4]         # no match
+    assert draft_ngram([7], 2) == [7, 7]                  # single token
+    # most recent match preferred: ...2,9 (old) vs ...2,5 (recent)
+    assert draft_ngram([2, 9, 8, 2, 5, 2], 1) == [5]
+
+
+# -- SlotKVCache rollback / batched writes -------------------------------
+
+def test_slot_kv_advance_rollback_guards():
+    c = SlotKVCache(num_layers=1, num_heads=2, head_dim=4, max_slots=2,
+                    max_len=8)
+    s = c.alloc()
+    c.lengths[s] = 3
+    c.advance(s, 4)                    # optimistic verify commit
+    assert c.lengths[s] == 7
+    c.rollback(s, 2)                   # rejected draft tail
+    assert c.lengths[s] == 5
+    with pytest.raises(ValueError):
+        c.advance(s, 4)                # 5 + 4 > max_len
+    with pytest.raises(ValueError):
+        c.rollback(s, 6)               # below zero
+    assert c.lengths[s] == 5           # failed calls left state alone
+
+
+def test_slot_reuse_after_rollback_interleaved_retirement(model):
+    """The bug class speculative rollback introduces: release -> alloc
+    -> write must land at the NEW request's offsets, never a stale
+    rolled-back offset. Interleave a long request with a short one so
+    the slot retires mid-batch and is re-prefilled while its neighbor
+    keeps verifying; outputs must still be exact."""
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[4, 8],
+                        spec_tokens=3)
+    long1 = eng.submit([3, 1, 4, 1, 5, 9, 2], max_new_tokens=9)
+    short = eng.submit([2, 7], max_new_tokens=2)      # retires early
+    eng.step()
+    while short.state != "done":
+        eng.step()
+    reused = eng.submit([8, 2, 8, 2, 8], max_new_tokens=6)
+    eng.run_until_idle()
+    for r, p in ((long1, [3, 1, 4, 1, 5, 9, 2]), (short, [2, 7]),
+                 (reused, [8, 2, 8, 2, 8])):
+        ref = greedy_search(model, np.asarray([p]),
+                            max_new_tokens=r.max_new_tokens,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref
+
+
+def test_write_prefill_batch_matches_single_writes(model):
+    """One batched functional update per layer == N single-slot
+    writes, bit for bit."""
+    import jax.numpy as jnp
+    kw = dict(num_layers=2, num_heads=2, head_dim=4, max_slots=3,
+              max_len=8)
+    a, b = SlotKVCache(**kw), SlotKVCache(**kw)
+    rng = np.random.RandomState(0)
+    # a batched prefill output: rows for 2 admissions + 1 padding row
+    rows = [(jnp.asarray(rng.randn(3, 2, 8, 4).astype(np.float32)),
+             jnp.asarray(rng.randn(3, 2, 8, 4).astype(np.float32)))
+            for _ in range(2)]
+    a.write_prefill_batch([2, 0], rows, [5, 3])
+    for i, slot in enumerate([2, 0]):
+        b.write_prefill(slot, [(rk[i:i + 1], rv[i:i + 1])
+                               for rk, rv in rows], [5, 3][i])
+    assert a.lengths.tolist() == b.lengths.tolist()
+    for (ak, av), (bk, bv) in zip(a.arrays(), b.arrays()):
+        assert jnp.array_equal(ak, bk) and jnp.array_equal(av, bv)
+
+
+# -- batched prefill admission -------------------------------------------
+
+def test_prefill_batched_one_dispatch_per_bucket(model):
+    """All queued same-bucket admissions in a step share ONE prefill
+    dispatch (the compile-count contract already pins one trace per
+    bucket; this pins the dispatch count too)."""
+    monitor.reset()
+    eng = ServingEngine(model, max_slots=3, max_len=32, buckets=[4, 8])
+    prompts = _prompts((2, 3, 4), seed=9)      # all fit bucket 4
+    reqs = [eng.submit(p, max_new_tokens=3) for p in prompts]
+    eng.step()
+    assert monitor.stat_get("STAT_serving_prefill_calls") == 1
+    assert monitor.stat_get("STAT_serving_prefills") == 3
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=3,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref
+
+
+# -- latency stats + HTTP surface ----------------------------------------
+
+def test_ttft_tpot_stats(model):
+    """TTFT / TPOT percentiles appear in engine.stats() once requests
+    complete, and TTFT <= total latency."""
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8])
+    reqs = [eng.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 5, 4), seed=10)]
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["latency_samples"] == 3
+    for key in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                "tpot_p99_ms"):
+        assert st[key] is not None and st[key] >= 0
+    for r in reqs:
+        assert r.ttft is not None and r.tpot is not None
+        assert r.ttft <= r.latency
+
+
+def test_http_429_retry_after_and_stats_surface(model):
+    """Queue-full over HTTP carries Retry-After; /v1/stats exposes the
+    TTFT/TPOT percentile keys."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=0)   # every submission is shed
+    srv = ServingHTTPServer(eng, port=0)
+    srv.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+        c.request("POST", "/v1/generate",
+                  body=json.dumps({"ids": [1, 2], "max_new_tokens": 2}))
+        r = c.getresponse()
+        assert r.status == 429
+        assert int(r.getheader("Retry-After")) >= 1
+        r.read()
+        c.request("GET", "/v1/stats")
+        stats = json.loads(c.getresponse().read())
+        for key in ("ttft_p50_ms", "tpot_p99_ms", "latency_samples",
+                    "spec_tokens"):
+            assert key in stats
+        c.close()
+    finally:
+        srv.stop()

@@ -11,10 +11,14 @@
 #      recovery paths under FLAGS_fault_spec-driven failures)
 #   5. serving plane (continuous-batching engine == sequential decode,
 #      compile-count budget, queue backpressure; reduced in quick mode)
-#   6. op coverage gate (>= 80% of the reference forward-op surface)
-#   7. API-freeze check (public signature snapshot diff)
-#   8. multi-chip dry-run (GSPMD train step on N virtual devices)
-#   9. README generated fragments vs their registries (no drift)
+#   6. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
+#      token-identical to sequential greedy, compile counts pinned;
+#      full mode also runs the BENCH_MODEL=serving spec variant on a
+#      tiny model: tokens/s + acceptance rate vs the plain engine)
+#   7. op coverage gate (>= 80% of the reference forward-op surface)
+#   8. API-freeze check (public signature snapshot diff)
+#   9. multi-chip dry-run (GSPMD train step on N virtual devices)
+#  10. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -22,7 +26,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/9 import smoke"
+echo "== 1/10 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -31,46 +35,55 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/9 lint (program verifier + op-desc compat)"
+echo "== 2/10 lint (program verifier + op-desc compat)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --books
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 3/9 test suite (virtual 8-device CPU mesh)"
+  echo "== 3/10 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 3/9 test suite: SKIPPED (quick mode)"
+  echo "== 3/10 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/9 chaos suite (deterministic fault injection)"
+  echo "== 4/10 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 4/9 chaos suite: reduced subset (quick mode)"
+  echo "== 4/10 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/9 serving plane"
+  echo "== 5/10 serving plane"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 else
-  echo "== 5/9 serving plane: reduced subset (quick mode)"
+  echo "== 5/10 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv"
 fi
 
-echo "== 6/9 op coverage gate"
+echo "== 6/10 speculative decoding gate"
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -k "spec"
+if [[ "${1:-}" != "quick" ]]; then
+  echo "   bench: spec vs non-spec on the repetitive-suffix workload"
+  BENCH_MODEL=serving BENCH_SERVING_GPT=gpt2-tiny BENCH_BATCH=4 \
+    BENCH_SEQ=64 BENCH_STEPS=1 BENCH_SERVING_NEW_TOKENS=16 \
+    BENCH_SERVING_COMPARE=0 JAX_PLATFORMS=cpu python bench.py
+fi
+
+echo "== 7/10 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 7/9 API freeze"
+echo "== 8/10 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -89,14 +102,23 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 8/9 multi-chip dry run"
-python -c "
+echo "== 9/10 multi-chip dry run"
+# needs the jax_num_cpu_devices config option to carve out virtual CPU
+# devices; older jax builds (0.4.x) don't have it
+if JAX_PLATFORMS=cpu python -c "
+import jax
+raise SystemExit(0 if hasattr(jax.config, 'jax_num_cpu_devices') else 1)
+" 2>/dev/null; then
+  python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('   8-device GSPMD train step ok')
 "
+else
+  echo "   installed jax has no jax_num_cpu_devices — skipped"
+fi
 
-echo "== 9/9 README generated-fragment sync"
+echo "== 10/10 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

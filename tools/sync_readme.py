@@ -248,15 +248,28 @@ def render_serving_block():
         "`paddle_tpu.serving.ServingEngine` batches requests at",
         "iteration granularity: each step admits queued prompts into",
         "free KV-cache slots (prefill padded to a length bucket, one",
-        "compile per bucket) and runs one batched decode over every",
-        "occupied slot (one compile, total). `submit()` returns a",
-        "request handle; `results()` collects them;",
+        "compile per bucket — and all same-bucket admissions in a step",
+        "share ONE dispatch of that compile) and runs one batched",
+        "decode over every occupied slot (one compile, total). With",
+        "`FLAGS_serving_spec_tokens` = K > 0 the decode becomes",
+        "draft–verify speculative decoding: an n-gram self-drafter",
+        "proposes K tokens per slot from the request's own generated",
+        "suffix (no second model), one fixed-shape verify forward",
+        "scores all K+1 positions, the accepted prefix commits to the",
+        "slot's KV cache and the rejected tail's write offset rolls",
+        "back — greedy output stays token-identical to K=0. `submit()`",
+        "returns a request handle; `results()` collects them;",
         "`serving.ServingHTTPServer` is the JSON front end",
         "(`POST /v1/generate`, `GET /v1/stats`, `GET /health`; 429 on",
-        "queue-full backpressure). Per-phase latency lands in",
-        "`monitor.stats()` as `STAT_serving_prefill_ms` /",
-        "`STAT_serving_decode_ms`; throughput/shedding as the other",
-        "`STAT_serving_*` counters.",
+        "queue-full backpressure carries a `Retry-After` header).",
+        "Per-phase latency lands in `monitor.stats()` as",
+        "`STAT_serving_prefill_ms` / `STAT_serving_decode_ms` /",
+        "`STAT_serving_verify_ms`; acceptance as",
+        "`STAT_serving_spec_proposed` / `STAT_serving_spec_accepted`;",
+        "`engine.stats()` (merged into `GET /v1/stats`) adds",
+        "time-to-first-token and time-per-output-token percentiles",
+        "(`ttft_p50_ms` / `ttft_p99_ms` / `tpot_p50_ms` /",
+        "`tpot_p99_ms`) and the speculative `spec_acceptance_rate`.",
         "",
         "Flags:",
         "",
@@ -268,6 +281,19 @@ def render_serving_block():
             lines.append(bullet(
                 f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
     lines += [
+        "",
+        "Tuning `FLAGS_serving_spec_tokens`: each verify step scores",
+        "K+1 positions whether or not the drafts are accepted, so the",
+        "win is `(1 + K * acceptance_rate)` tokens per step against a",
+        "step that costs slightly more than plain decode. Watch",
+        "`spec_acceptance_rate` in `GET /v1/stats`: repetitive or",
+        "templated traffic (code, markup, retrieval-augmented answers)",
+        "sustains 0.5+ and profits from K of 4-8; low-entropy-free chat",
+        "traffic near 0.2 wants K of 2-3 or 0. Each request reserves K",
+        "rows of slot headroom, so `prompt + max_new_tokens + K` must",
+        "fit in `FLAGS_serving_max_len`. `BENCH_MODEL=serving` reports",
+        "spec vs non-spec tokens/s and the measured acceptance rate on",
+        "a repetitive-suffix workload.",
         "",
         "Fault sites (see Fault tolerance for the spec grammar):",
         "",
