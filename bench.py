@@ -468,6 +468,10 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     }
     if spec is not None:
         out["spec"] = spec
+    # full observability snapshot (counters + histogram percentiles +
+    # compile records, never raw samples) rides along in BENCH_*.json
+    from paddle_tpu import observability
+    out["observability"] = observability.snapshot()
     print(json.dumps(out))
     return 0
 
@@ -517,6 +521,7 @@ def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
             f"implausible MFU {mfu * 100:.1f}% (step {dt * 1000:.3f} ms) — "
             "timing did not synchronize; refusing to report\n")
         return 3
+    from paddle_tpu import observability
     print(json.dumps({
         "metric": "gpt2_345m_mfu" if model_name == "gpt2-medium"
         else f"{model_name}_mfu",
@@ -531,6 +536,9 @@ def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
         "loss": round(loss, 4),
         "device": getattr(dev, "device_kind", str(dev)),
         "peak_flops": peak,
+        # compile accounting for the timed step (count should stay at
+        # the warmup's 1 — a recompile inside the window is a bug)
+        "observability": {"compiles": observability.snapshot()["compiles"]},
     }))
     return 0
 

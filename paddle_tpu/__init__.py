@@ -40,6 +40,7 @@ from . import metric
 from . import vision
 from . import hapi
 from .hapi import Model
+from . import observability
 from . import monitor
 from . import profiler
 from . import incubate

@@ -243,4 +243,6 @@ class _ParallelRunner:
                      {n: state_spec(n) for n in written})
         fn = jax.shard_map(shard_step, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
-        return jax.jit(fn), state_in, written
+        from .observability import compile_tracker as _ct
+        return _ct.tracked_jit("parallel_executor_step", fn), \
+            state_in, written

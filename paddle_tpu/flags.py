@@ -219,3 +219,20 @@ define_flag("serving_spec_ngram", 3,
             "prompt+generated context when proposing draft tokens "
             "(falls back to shorter n-grams, then to repeating the "
             "last token).")
+
+# Observability plane (paddle_tpu/observability): metrics registry,
+# XLA compile tracker, structured run log, Prometheus export.
+define_flag("warn_recompiles", 0,
+            "XLA compile tracker: when > 0, emit a structured "
+            "RecompileWarning (with the offending abstract shape/dtype "
+            "signature) whenever a tracked_jit function compiles more "
+            "than this many times — catches the recompile-per-token "
+            "class of bug at the first occurrence. 0 disables.")
+define_flag("runlog_dir", "",
+            "Directory for the structured JSONL run log "
+            "(observability.log_event); one runlog-<pid>.jsonl per "
+            "process. Empty (default) keeps events in memory only.")
+define_flag("runlog_max_mb", 64.0,
+            "Size cap in MB for the active run-log file; on overflow "
+            "it rotates to <name>.1 (replacing the previous one), so a "
+            "process writes at most two caps of disk.")

@@ -23,6 +23,7 @@ Semantics preserved:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -106,6 +107,31 @@ class _BlockRunner:
                         check_nan_inf_hook(op.type, n, v)
                     env[n] = v
         return env
+
+
+def _log_train_step(runlog_mod, step, feed, fetch_names, fetched,
+                    step_time_s: float):
+    """Emit one structured ``train_step`` run-log event: step index,
+    loss (the first scalar fetch, by convention the loss), wall step
+    time, and examples/sec from the feed's batch dimension."""
+    if not runlog_mod.enabled():
+        return
+    loss = None
+    if fetched:
+        v = np.asarray(fetched[0])
+        if v.size == 1:
+            loss = float(v.ravel()[0])
+    batch = None
+    for arr in (feed or {}).values():
+        shape = getattr(arr, "shape", None)
+        if shape:
+            batch = int(shape[0])
+            break
+    runlog_mod.log_event(
+        "train_step", step=int(step), loss=loss,
+        step_time_ms=round(step_time_s * 1e3, 3),
+        examples_per_sec=(round(batch / step_time_s, 3)
+                          if batch and step_time_s > 0 else None))
 
 
 def _collect_io(block, feed_names, scope: Scope):
@@ -294,10 +320,14 @@ class Executor:
                                        debug=debug,
                                        fetch_info=fetch_info,
                                        print_period=print_period)
+        from ..observability import runlog as _runlog
         last = None
         for step, feed in enumerate(dataset.batch_iterator()):
+            t0 = time.perf_counter()
             last = self.run(program, feed=feed, fetch_list=fetch_names,
                             scope=scope)
+            _log_train_step(_runlog, step, feed, fetch_names, last,
+                            time.perf_counter() - t0)
             if debug and fetch_names and step % print_period == 0:
                 infos = fetch_info or fetch_names
                 msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
@@ -459,5 +489,7 @@ class Executor:
             return fetches, new_state
 
         donate = (0,) if self.donate_state else ()
-        compiled = jax.jit(step, donate_argnums=donate)
+        from ..observability import compile_tracker as _ct
+        compiled = _ct.tracked_jit("executor_step", step,
+                                   donate_argnums=donate)
         return compiled, state_in, written, (program, scope)

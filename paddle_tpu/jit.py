@@ -158,8 +158,12 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
                         param_rules or ShardingRules([]))
                 return out_arrays, new_state
             donate = (0,) if donate_state else ()
+            from .observability import compile_tracker as _ct
+            _labels = {"py_fn": getattr(fn, "__name__", "?")}
             if mesh is None:
-                return jax.jit(traced, donate_argnums=donate)
+                return _ct.tracked_jit("to_static", traced,
+                                       labels=_labels,
+                                       donate_argnums=donate)
             from jax.sharding import NamedSharding
             from .distributed.sharding import ShardingRules, state_shardings
             rules = param_rules or ShardingRules([])
@@ -169,8 +173,9 @@ def to_static(function: Optional[Callable] = None, *, layers=None,
                                                      grads_present)]
             arg_sh = (tuple(NamedSharding(mesh, s) for s in arg_specs)
                       if arg_specs is not None else None)
-            return jax.jit(traced, donate_argnums=donate,
-                           in_shardings=(st_sh, arg_sh))
+            return _ct.tracked_jit("to_static", traced, labels=_labels,
+                                   donate_argnums=donate,
+                                   in_shardings=(st_sh, arg_sh))
 
         @functools.wraps(fn)
         def wrapper(*args):
@@ -246,8 +251,11 @@ def to_static_multi_step(fn, *, layers, optimizers=None,
             return outs, new_state
 
         donate = (0,) if donate_state else ()
+        from .observability import compile_tracker as _ct
+        _labels = {"py_fn": getattr(fn, "__name__", "?")}
         if mesh is None:
-            return jax.jit(traced, donate_argnums=donate)
+            return _ct.tracked_jit("to_static_multi_step", traced,
+                                   labels=_labels, donate_argnums=donate)
         from jax.sharding import NamedSharding
         from .distributed.sharding import ShardingRules, state_shardings
         rules = param_rules or ShardingRules([])
@@ -256,8 +264,9 @@ def to_static_multi_step(fn, *, layers, optimizers=None,
                           for sh, p in zip(st_sh["params"], spec.params)]
         arg_sh = (tuple(NamedSharding(mesh, s) for s in arg_specs)
                   if arg_specs is not None else None)
-        return jax.jit(traced, donate_argnums=donate,
-                       in_shardings=(st_sh, arg_sh))
+        return _ct.tracked_jit("to_static_multi_step", traced,
+                               labels=_labels, donate_argnums=donate,
+                               in_shardings=(st_sh, arg_sh))
 
     def wrapper(*args):
         state = spec.snapshot()

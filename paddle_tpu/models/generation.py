@@ -56,13 +56,12 @@ def decode_step(model):
     are closed over as constants: decoding assumes frozen weights.
     """
     from .. import flags as _flags
+    from ..observability import compile_tracker as _ct
     ent = getattr(model, "_decode_step_cache", None)
     if ent is not None and ent["flags_version"] == _flags.version():
         return ent
-    traces = {"count": 0}
 
     def _step(tokens, pos, caches):
-        traces["count"] += 1
         with no_grad():
             tcaches = [(Tensor(k, stop_gradient=True),
                         Tensor(v, stop_gradient=True)) for k, v in caches]
@@ -72,7 +71,8 @@ def decode_step(model):
         nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
         return nxt, lg, [(c[0].value, c[1].value) for c in newc]
 
-    ent = {"fn": jax.jit(_step), "traces": traces,
+    fn = _ct.tracked_jit("decode_step", _step)
+    ent = {"fn": fn, "traces": fn.traces,
            "flags_version": _flags.version()}
     model._decode_step_cache = ent
     return ent
@@ -109,10 +109,8 @@ def verify_step(model, spec_tokens: int):
     ent = cache.get(k)
     if ent is not None and ent["flags_version"] == _flags.version():
         return ent
-    traces = {"count": 0}
 
     def _step(tokens, pos, caches):
-        traces["count"] += 1
         with no_grad():
             tcaches = [(Tensor(kk, stop_gradient=True),
                         Tensor(vv, stop_gradient=True))
@@ -123,7 +121,9 @@ def verify_step(model, spec_tokens: int):
         nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
         return nxt, lg, [(c[0].value, c[1].value) for c in newc]
 
-    ent = {"fn": jax.jit(_step), "traces": traces,
+    from ..observability import compile_tracker as _ct
+    fn = _ct.tracked_jit("verify_step", _step, labels={"k": str(k)})
+    ent = {"fn": fn, "traces": fn.traces,
            "flags_version": _flags.version()}
     cache[k] = ent
     return ent

@@ -6,6 +6,13 @@ the dataset/PS tiers for observability; thread-safe, exported as a dict.
 registry: phase timings (serving prefill/decode, checkpoint IO) land in
 ``stats()`` as ``<name>_calls`` / ``<name>_ms`` without a separate
 metrics stack.
+
+Since the observability plane landed, this module is a *shim*: every
+``stat_add`` counter is a Counter and every ``stat_time`` site a
+Histogram in ``paddle_tpu.observability.metrics.DEFAULT``, so the same
+values surface in ``GET /metrics`` / ``observability.snapshot()``. The
+dict-shaped API (exact key names, int/float types, dotted fault-site
+names) is unchanged — the whole chaos suite pins it.
 """
 
 from __future__ import annotations
@@ -15,23 +22,47 @@ import threading
 import time
 from typing import Dict
 
+from .observability import metrics as _metrics
+
 _lock = threading.Lock()
-_stats: Dict[str, float] = {}
+# names this shim has created in the shared registry, by flavor — needed
+# so stats()/reset() cover exactly the STAT plane and leave native
+# instruments (serving histograms, compile counters) alone
+_counter_names: set = set()
+_timer_names: set = set()
+
+
+def _registry() -> _metrics.MetricsRegistry:
+    return _metrics.DEFAULT
 
 
 def stat_add(name: str, value: int = 1):
     with _lock:
-        _stats[name] = _stats.get(name, 0) + int(value)
+        _counter_names.add(name)
+    _registry().counter(name).add(int(value))
 
 
 def stat_set(name: str, value: int):
     with _lock:
-        _stats[name] = int(value)
+        _counter_names.add(name)
+    _registry().counter(name).set(int(value))
 
 
 def stat_get(name: str) -> int:
+    reg = _registry()
     with _lock:
-        return _stats.get(name, 0)
+        if name in _counter_names:
+            inst = reg.get(name)
+            return inst.value if inst is not None else 0
+        # derived stat_time keys kept readable through stat_get, as the
+        # flat-dict store allowed
+        for suffix in ("_calls", "_ms"):
+            if name.endswith(suffix) and name[:-len(suffix)] in _timer_names:
+                inst = reg.get(name[:-len(suffix)])
+                if inst is None:
+                    return 0
+                return inst.count if suffix == "_calls" else inst.sum
+    return 0
 
 
 @contextlib.contextmanager
@@ -41,32 +72,49 @@ def stat_time(name: str):
     ``<name>_ms`` (float total) alongside the ordinary counters, so
     ``stats()["STAT_serving_prefill_ms"] /
     stats()["STAT_serving_prefill_calls"]`` is the mean latency."""
+    with _lock:
+        _timer_names.add(name)
+    hist = _registry().histogram(name)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt_ms = (time.perf_counter() - t0) * 1e3
-        with _lock:
-            _stats[name + "_calls"] = int(_stats.get(name + "_calls", 0)) + 1
-            _stats[name + "_ms"] = _stats.get(name + "_ms", 0.0) + dt_ms
+        hist.observe((time.perf_counter() - t0) * 1e3)
 
 
 def stats() -> Dict[str, float]:
+    reg = _registry()
+    out: Dict[str, float] = {}
     with _lock:
-        return dict(_stats)
+        counters = list(_counter_names)
+        timers = list(_timer_names)
+    for name in counters:
+        inst = reg.get(name)
+        if inst is not None:
+            out[name] = inst.value
+    for name in timers:
+        inst = reg.get(name)
+        if inst is not None:
+            out[name + "_calls"] = inst.count
+            out[name + "_ms"] = inst.sum
+    return out
 
 
 def stats_with_prefix(prefix: str) -> Dict[str, int]:
     """Counters under one namespace, e.g. ``stats_with_prefix
     ("STAT_fault_")`` — how the chaos suite asserts every injection and
     every recovery was actually observed, not just survived."""
-    with _lock:
-        return {k: v for k, v in _stats.items() if k.startswith(prefix)}
+    return {k: v for k, v in stats().items() if k.startswith(prefix)}
 
 
 def reset():
+    reg = _registry()
     with _lock:
-        _stats.clear()
+        names = _counter_names | _timer_names
+        _counter_names.clear()
+        _timer_names.clear()
+    for name in names:
+        reg.unregister(name)
 
 
 # C++-style aliases

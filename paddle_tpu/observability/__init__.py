@@ -1,0 +1,92 @@
+"""paddle_tpu.observability — the unified observability plane.
+
+One metrics plane for the whole framework (SURVEY §2.7 profiler tier,
+grown into a production-style plane):
+
+- :mod:`.metrics`          typed Counter/Gauge/Histogram registry
+- :mod:`.compile_tracker`  ``tracked_jit`` XLA compile accounting
+- :mod:`.runlog`           structured JSONL run-log emitter
+- :mod:`.export`           Prometheus text + JSON snapshot exporters
+
+``paddle_tpu.monitor`` (the STAT_* counter API) is a thin shim over the
+registry here, so every existing ``stat_add``/``stat_time`` call site
+reports into the same plane that ``GET /metrics`` scrapes.
+"""
+
+from __future__ import annotations
+
+from . import compile_tracker, export, metrics, runlog
+from .compile_tracker import (RecompileWarning, compiles, reset_compiles,
+                              tracked_jit)
+from .export import prometheus_text, snapshot, validate_prometheus_text
+from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                      MetricsRegistry)
+from .runlog import log_event, recent
+
+#: well-known instruments, rendered into the README's generated
+#: "Observability" section by tools/sync_readme.py — keep descriptions
+#: here, next to the code that emits them
+INSTRUMENT_DOCS = {
+    "xla_compiles{fn=...}":
+        "counter — XLA compiles per tracked_jit site (executor_step, "
+        "parallel_executor_step, decode_step, verify_step, "
+        "serving_prefill{bucket=...}, to_static, to_static_multi_step)",
+    "xla_compile_ms":
+        "histogram — wall ms of calls that triggered an XLA compile",
+    "serving_ttft_seconds{engine=...}":
+        "histogram — time to first token of completed serving requests",
+    "serving_tpot_seconds{engine=...}":
+        "histogram — mean time per output token of completed requests",
+    "STAT_serving_*":
+        "counters — admission/token/shed/speculative accounting from "
+        "the serving engine (see the Serving section)",
+    "STAT_fault_<site>":
+        "counters — one per injected fault firing (see Fault tolerance)",
+    "STAT_guardian_*":
+        "counters — TrainGuardian NaN-skips and rollbacks",
+    "<name>  /  <name>_calls, <name>_ms":
+        "any monitor.stat_add counter / monitor.stat_time histogram "
+        "(calls + total ms derived from it)",
+}
+
+#: run-log event kinds emitted by the framework itself
+EVENT_DOCS = {
+    "train_step": "executor/guardian training step: step, loss, "
+                  "step_time_ms, examples_per_sec",
+    "guardian_skip": "TrainGuardian skipped a non-finite step",
+    "guardian_rollback": "TrainGuardian restored a checkpoint",
+    "serving_admit": "request admitted into a KV slot (bucket, "
+                     "prompt_tokens)",
+    "serving_finish": "request retired (tokens, ttft_ms, tpot_ms)",
+    "serving_shed": "request shed by backpressure/deadline",
+    "serving_spec": "speculative decoding round (proposed, accepted)",
+    "fault_injected": "deterministic fault fired (site, fault_kind)",
+    "recompile_warning": "tracked function exceeded "
+                         "FLAGS_warn_recompiles (fn, signature)",
+}
+
+
+def counter(name: str, help_str: str = "") -> Counter:
+    """Get-or-create a counter in the default registry."""
+    return metrics.DEFAULT.counter(name, help_str)
+
+
+def gauge(name: str, help_str: str = "") -> Gauge:
+    """Get-or-create a gauge in the default registry."""
+    return metrics.DEFAULT.gauge(name, help_str)
+
+
+def histogram(name: str, help_str: str = "", buckets=None) -> Histogram:
+    """Get-or-create a histogram in the default registry."""
+    return metrics.DEFAULT.histogram(name, help_str, buckets=buckets)
+
+
+__all__ = [
+    "metrics", "compile_tracker", "runlog", "export",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "tracked_jit", "compiles", "reset_compiles", "RecompileWarning",
+    "log_event", "recent",
+    "prometheus_text", "snapshot", "validate_prometheus_text",
+    "counter", "gauge", "histogram",
+    "INSTRUMENT_DOCS", "EVENT_DOCS",
+]

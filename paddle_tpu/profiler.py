@@ -14,6 +14,7 @@ start/stop_trace (TensorBoard-loadable), replacing CUPTI.
 from __future__ import annotations
 
 import contextlib
+import functools
 import json
 import os
 import threading
@@ -50,8 +51,10 @@ class RecordEvent:
         t1 = time.perf_counter_ns()
         if self._ann is not None:
             self._ann.__exit__(*exc)
-        if _enabled:
-            with _lock:
+        with _lock:
+            # _enabled is mutated by start/stop_profiler under _lock;
+            # read it there too so a concurrent stop can't interleave
+            if _enabled:
                 _events.append({
                     "name": self.name,
                     "ts": self._t0 / 1e3,     # chrome trace uses us
@@ -61,6 +64,7 @@ class RecordEvent:
         return False
 
     def __call__(self, fn):
+        @functools.wraps(fn)
         def wrapper(*a, **kw):
             with RecordEvent(self.name):
                 return fn(*a, **kw)
@@ -73,7 +77,7 @@ def start_profiler(state: str = "All", trace_dir: Optional[str] = None):
     global _enabled, _trace_dir
     with _lock:
         _events.clear()
-    _enabled = True
+        _enabled = True
     if trace_dir:
         import jax.profiler
         jax.profiler.start_trace(trace_dir)
@@ -86,14 +90,14 @@ def stop_profiler(sorted_key: Optional[str] = None,
     the summary table (fluid/profiler.py stop_profiler +
     tools/timeline.py collapsed into one step)."""
     global _enabled, _trace_dir
-    _enabled = False
+    with _lock:
+        _enabled = False
+        events = list(_events)
+        _events.clear()
     if _trace_dir is not None:
         import jax.profiler
         jax.profiler.stop_trace()
         _trace_dir = None
-    with _lock:
-        events = list(_events)
-        _events.clear()
     trace = {"traceEvents": [
         {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
          "pid": 0, "tid": e["tid"], "cat": "host"} for e in events]}
@@ -110,7 +114,40 @@ def stop_profiler(sorted_key: Optional[str] = None,
         for s in summary:
             print(f"{s['name']:{name_w}s}  {s['calls']:6d}  "
                   f"{s['total_ms']:10.3f}  {s['avg_ms']:10.3f}")
+    _print_metrics_summary()
     return summary
+
+
+def _print_metrics_summary():
+    """Counter/histogram totals from the observability plane, appended
+    to the host-event table so one report covers both."""
+    from . import observability
+    snap = observability.snapshot()
+    counters = {**snap["counters"], **snap["gauges"]}
+    hists = snap["histograms"]
+    if counters:
+        print("Counters:")
+        name_w = max(len(n) for n in counters)
+        for name in sorted(counters):
+            print(f"  {name:{name_w}s}  {counters[name]}")
+    if hists:
+        print(f"{'Histogram':28s}  {'Count':>7s}  {'Sum':>12s}  "
+              f"{'p50':>10s}  {'p95':>10s}  {'p99':>10s}")
+        for name in sorted(hists):
+            h = hists[name]
+            if not h["count"]:
+                continue
+            row = [f"{h[k]:10.4g}" if h[k] is not None else f"{'-':>10s}"
+                   for k in ("p50", "p95", "p99")]
+            print(f"{name:28s}  {h['count']:7d}  {h['sum']:12.4g}  "
+                  + "  ".join(row))
+    comp = snap.get("compiles") or {}
+    if comp:
+        print("XLA compiles:")
+        for qual in sorted(comp):
+            c = comp[qual]
+            print(f"  {qual}: {c['count']} "
+                  f"({c['total_ms']:.1f} ms traced)")
 
 
 def summarize(events: List[dict], sorted_key: Optional[str] = None):

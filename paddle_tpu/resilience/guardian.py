@@ -69,6 +69,9 @@ class TrainGuardian:
         """One guarded training step. Returns the fetches, or None when
         the batch was skipped (NaN) or spent on a rollback."""
         from ..framework.executor import NanInfError
+        from ..observability import runlog as _runlog
+        import time as _time
+        t0 = _time.perf_counter()
         try:
             out = self.executor.run(self.program, feed=feed,
                                     fetch_list=fetch_list,
@@ -77,11 +80,24 @@ class TrainGuardian:
             self.skipped += 1
             self.consecutive_bad += 1
             _monitor.stat_add("STAT_guardian_skipped")
+            _runlog.log_event("guardian_skip", step=self.steps_done,
+                              consecutive=self.consecutive_bad,
+                              skipped_total=self.skipped)
             if self.consecutive_bad > self.max_skip:
                 self.rollback()
             return None
         self.consecutive_bad = 0
         self.steps_done += 1
+        if _runlog.enabled():
+            loss = None
+            if out:
+                v = np.asarray(out[0])
+                if v.size == 1:
+                    loss = float(v.ravel()[0])
+            dt = _time.perf_counter() - t0
+            _runlog.log_event("train_step", step=self.steps_done,
+                              loss=loss,
+                              step_time_ms=round(dt * 1e3, 3))
         if (self.saver is not None and self.checkpoint_every > 0
                 and self.steps_done % self.checkpoint_every == 0):
             self._snapshot()
@@ -118,6 +134,10 @@ class TrainGuardian:
         self.consecutive_bad = 0
         self.rollbacks += 1
         _monitor.stat_add("STAT_guardian_rollbacks")
+        from ..observability import runlog as _runlog
+        _runlog.log_event("guardian_rollback",
+                          restored_step=self.steps_done,
+                          rollbacks=self.rollbacks)
         return meta
 
     # -- PS liveness -------------------------------------------------------

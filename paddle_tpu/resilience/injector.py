@@ -204,8 +204,10 @@ class FaultInjector:
             return None
         with self._lock:
             fired = [r.kind for r in site_rules if r.fires()]
-        for _ in fired:
+        from ..observability import runlog as _runlog
+        for k in fired:
             _monitor.stat_add(f"STAT_fault_{site}")
+            _runlog.log_event("fault_injected", site=site, fault_kind=k)
         if not fired:
             return None
         kind = fired[0]  # spec order breaks same-call ties

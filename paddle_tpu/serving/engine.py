@@ -57,7 +57,10 @@ import jax.numpy as jnp
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from .. import observability as _obs
 from .. import profiler as _profiler
+from ..observability import compile_tracker as _ct
+from ..observability import runlog as _runlog
 from ..dygraph.tape import no_grad
 from ..dygraph.tensor import Tensor
 from ..models.generation import decode_step, draft_ngram, verify_step
@@ -173,6 +176,8 @@ class ServingEngine:
     constructor arguments override per instance.
     """
 
+    _engine_ids = itertools.count()
+
     def __init__(self, model, max_slots: Optional[int] = None,
                  max_len: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
@@ -228,8 +233,20 @@ class ServingEngine:
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._prefill_fns: Dict[int, dict] = {}   # bucket len -> entry
-        # latency samples of completed requests: (ttft s, tpot s|None)
-        self._lat: deque = deque(maxlen=4096)
+        # completed-request latency distributions live in the shared
+        # metrics plane as fixed-bucket histograms (one label series
+        # per engine instance): constant memory however many requests
+        # retire, and the same numbers surface on GET /metrics
+        eid = str(next(ServingEngine._engine_ids))
+        self._ttft_hist = _obs.histogram(
+            "serving_ttft_seconds",
+            "time to first token of completed requests (s)"
+            ).labels(engine=eid)
+        self._tpot_hist = _obs.histogram(
+            "serving_tpot_seconds",
+            "mean time per output token of completed requests (s)"
+            ).labels(engine=eid)
+        self._completed = 0
         self._spec_proposed = 0   # draft tokens offered to the verify
         self._spec_accepted = 0   # draft tokens the model agreed with
 
@@ -307,11 +324,9 @@ class ServingEngine:
         if ent is not None and ent["flags_version"] == _flags.version():
             self._prefill_fns[bucket] = ent
             return ent
-        traces = {"count": 0}
         model, max_len, slots = self.model, self.max_len, self.max_slots
 
         def _prefill(ids, last):
-            traces["count"] += 1
             with no_grad():
                 cache = model.gpt.gen_fixed_cache(slots, max_len)
                 logits, newc = model(
@@ -321,7 +336,9 @@ class ServingEngine:
                                      last[:, None, None], axis=1)[:, 0]
             return lg, [(c[0].value, c[1].value) for c in newc]
 
-        ent = {"fn": jax.jit(_prefill), "traces": traces,
+        fn = _ct.tracked_jit("serving_prefill", _prefill,
+                             labels={"bucket": str(bucket)})
+        ent = {"fn": fn, "traces": fn.traces,
                "flags_version": _flags.version()}
         cache[key] = ent
         self._prefill_fns[bucket] = ent
@@ -393,6 +410,9 @@ class ServingEngine:
                 self._active[slot] = req
                 admitted += 1
                 _monitor.stat_add("STAT_serving_prefills")
+                _runlog.log_event("serving_admit", request=req.id,
+                                  bucket=bucket, slot=slot,
+                                  prompt_tokens=len(req.prompt))
                 # the first generated token comes from the prefill
                 # logits (same argmax greedy_search takes after ITS
                 # prefill)
@@ -515,6 +535,9 @@ class ServingEngine:
             self._spec_accepted += accepted
             _monitor.stat_add("STAT_serving_spec_proposed", K)
             _monitor.stat_add("STAT_serving_spec_accepted", accepted)
+            if _runlog.enabled():
+                _runlog.log_event("serving_spec", request=req.id,
+                                  proposed=K, accepted=accepted)
             if req.state == "running":
                 # reject the unaccepted tail: roll the write offset
                 # back so the next step overwrites those rows
@@ -539,9 +562,18 @@ class ServingEngine:
             req.slot = None
         req.state = "done"
         req.finished_at = time.perf_counter()
+        ttft, tpot = req.ttft, req.tpot
+        if ttft is not None:
+            self._ttft_hist.observe(ttft)
+        if tpot is not None:
+            self._tpot_hist.observe(tpot)
         with self._lock:
-            self._lat.append((req.ttft, req.tpot))
+            self._completed += 1
         _monitor.stat_add("STAT_serving_completed")
+        _runlog.log_event(
+            "serving_finish", request=req.id, tokens=len(req.tokens),
+            ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
+            tpot_ms=None if tpot is None else round(tpot * 1e3, 3))
         req._done.set()
 
     def _shed(self, req: Request, err: BaseException):
@@ -550,6 +582,8 @@ class ServingEngine:
         req.error = err
         req.finished_at = time.perf_counter()
         _monitor.stat_add("STAT_serving_shed")
+        _runlog.log_event("serving_shed", request=req.id,
+                          error=str(err))
         req._done.set()
 
     # --------------------------------------------------------- stepping
@@ -567,24 +601,24 @@ class ServingEngine:
 
     def stats(self) -> dict:
         """Per-engine serving metrics: time-to-first-token and
-        time-per-output-token percentiles over the last completed
-        requests (up to the sample window), plus the speculative
-        acceptance counters. Percentiles are None until samples exist;
-        the HTTP front end merges this into ``GET /v1/stats``."""
+        time-per-output-token percentiles of completed requests, plus
+        the speculative acceptance counters. Percentiles come from this
+        engine's fixed-bucket Histogram series in the observability
+        plane (constant memory — no raw-sample window); None until
+        observations exist. The HTTP front end merges this into
+        ``GET /v1/stats``."""
+        def pct(hist, q):
+            v = hist.quantile(q)
+            return None if v is None else round(v * 1e3, 3)
+
         with self._lock:
-            samples = list(self._lat)
-        ttft = sorted(s[0] for s in samples if s[0] is not None)
-        tpot = sorted(s[1] for s in samples if s[1] is not None)
-
-        def pct(xs, q):
-            if not xs:
-                return None
-            return round(xs[min(int(len(xs) * q), len(xs) - 1)] * 1e3, 3)
-
+            completed = self._completed
         out = {
-            "ttft_p50_ms": pct(ttft, 0.50), "ttft_p99_ms": pct(ttft, 0.99),
-            "tpot_p50_ms": pct(tpot, 0.50), "tpot_p99_ms": pct(tpot, 0.99),
-            "latency_samples": len(samples),
+            "ttft_p50_ms": pct(self._ttft_hist, 0.50),
+            "ttft_p99_ms": pct(self._ttft_hist, 0.99),
+            "tpot_p50_ms": pct(self._tpot_hist, 0.50),
+            "tpot_p99_ms": pct(self._tpot_hist, 0.99),
+            "latency_samples": completed,
             "spec_tokens": self.spec_tokens,
         }
         if self.spec_tokens:

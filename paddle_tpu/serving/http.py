@@ -15,6 +15,10 @@ minimal JSON generation protocol:
   GET  /v1/stats      -> 200 the STAT_serving_* counters merged with
                              engine.stats() (TTFT / TPOT percentiles,
                              speculative acceptance rate)
+  GET  /metrics       -> 200 the whole observability registry in
+                             Prometheus text exposition format
+                             (serving counters/latency histograms,
+                             fault counters, XLA compile tracking)
   GET  /health        -> 200 {"ok": true, "slots_free": n, "queued": n}
 
 Like the KV rendezvous server, this is unauthenticated cluster-private
@@ -30,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import monitor as _monitor
+from .. import observability as _obs
 from .engine import QueueFullError, ServingEngine
 
 
@@ -60,6 +65,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
             payload = _monitor.stats_with_prefix("STAT_serving")
             payload.update(engine.stats())
             self._json(200, payload)
+        elif self.path == "/metrics":
+            body = _obs.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._json(404, {"error": f"unknown path {self.path!r}"})
 

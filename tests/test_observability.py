@@ -1,0 +1,253 @@
+"""Observability plane: metrics registry, monitor shim, Prometheus
+export, XLA compile tracker (+ FLAGS_warn_recompiles), run log.
+
+The plane's design contracts under test:
+- histograms never store samples (fixed log-scale buckets), yet
+  p50/p95/p99 come back within a bucket's width of the truth;
+- dotted STAT names survive the registry verbatim and are sanitized
+  only at Prometheus render time;
+- every jax.jit entry point is compile-accounted: a new abstract
+  signature shows up as exactly one more compile, attributable by
+  signature, and FLAGS_warn_recompiles turns the excess into a
+  structured warning naming the offending signature.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor, observability
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  unique_name)
+from paddle_tpu.observability import (MetricsRegistry, RecompileWarning,
+                                      compile_tracker, export, runlog)
+
+
+# -- registry / instruments ---------------------------------------------
+
+
+def test_counter_gauge_and_kind_mismatch():
+    reg = MetricsRegistry()
+    c = reg.counter("requests", "total requests")
+    c.add()
+    c.add(4)
+    assert c.value == 5
+    assert reg.counter("requests") is c  # get-or-create
+    g = reg.gauge("depth")
+    g.set(3)
+    g.set(1)
+    assert g.value == 1
+    with pytest.raises(TypeError):
+        reg.histogram("requests")
+
+
+def test_histogram_quantiles_within_bucket_resolution():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    vals = [0.001 * (i + 1) for i in range(100)]  # 1ms .. 100ms
+    for v in vals:
+        h.observe(v)
+    assert h.count == 100
+    assert h.sum == pytest.approx(sum(vals))
+    # log-scale buckets are 10^0.25 wide: the estimate must land within
+    # one bucket (factor ~1.78) of the exact quantile
+    for q, exact in ((0.5, 0.0505), (0.95, 0.0955), (0.99, 0.0995)):
+        est = h.quantile(q)
+        assert exact / 1.8 <= est <= exact * 1.8, (q, est)
+    # clamped to the observed range, never extrapolates past max
+    assert h.quantile(1.0) <= 0.1
+    assert h.quantile(0.0) >= 0.001
+    assert reg.histogram("empty").quantile(0.5) is None
+
+
+def test_labels_bind_independent_series():
+    reg = MetricsRegistry()
+    c = reg.counter("hits")
+    c.labels(route="a").add(2)
+    c.labels(route="b").add(5)
+    c.add(1)  # unlabeled series is separate
+    assert c.labels(route="a").value == 2
+    assert c.labels(route="b").value == 5
+    assert c.value == 1
+    h = reg.histogram("ms")
+    h.labels(op="x").observe(1.0)
+    h.labels(op="x").observe(3.0)
+    assert h.labels(op="x").count == 2
+    assert h.labels(op="y").count == 0
+
+
+# -- monitor shim --------------------------------------------------------
+
+
+def test_monitor_shim_reports_into_default_registry():
+    monitor.reset()
+    monitor.stat_add("STAT_fault_ps.rpc.call", 2)  # dotted, kept verbatim
+    inst = observability.metrics.DEFAULT.get("STAT_fault_ps.rpc.call")
+    assert inst is not None and inst.value == 2
+    assert monitor.stat_get("STAT_fault_ps.rpc.call") == 2
+    with monitor.stat_time("shim_phase"):
+        pass
+    s = monitor.stats()
+    assert s["shim_phase_calls"] == 1
+    assert isinstance(s["shim_phase_ms"], float)
+    monitor.reset()
+    assert monitor.stats() == {}
+    # reset() removes only shim-created instruments
+    assert observability.metrics.DEFAULT.get("STAT_fault_ps.rpc.call") is None
+
+
+# -- Prometheus export ---------------------------------------------------
+
+
+def test_prometheus_text_sanitizes_and_reconciles():
+    reg = MetricsRegistry()
+    reg.counter("STAT_fault_exec.step", "dotted name").add(3)
+    h = reg.histogram("lat_seconds")
+    for v in (0.01, 0.02, 5.0):
+        h.observe(v)
+    h.labels(engine="0").observe(0.5)
+    text = export.prometheus_text(reg)
+    assert "STAT_fault_exec_step 3" in text          # dot sanitized
+    assert "STAT_fault_exec.step" not in text
+    assert 'lat_seconds_bucket{engine="0",le="+Inf"} 1' in text
+    n = export.validate_prometheus_text(text)
+    assert n > 40  # bucket series dominate
+    # the validator actually catches bucket/count mismatches
+    broken = text.replace("lat_seconds_count 3", "lat_seconds_count 7")
+    with pytest.raises(ValueError, match="count"):
+        export.validate_prometheus_text(broken)
+    with pytest.raises(ValueError):
+        export.validate_prometheus_text("bad metric line {\n")
+
+
+def test_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("c").add(2)
+    reg.gauge("g").set(7)
+    reg.histogram("h").observe(1.5)
+    snap = export.snapshot(reg)
+    assert snap["counters"] == {"c": 2}
+    assert snap["gauges"] == {"g": 7}
+    assert snap["histograms"]["h"]["count"] == 1
+    assert set(snap["histograms"]["h"]) == {
+        "count", "sum", "min", "max", "p50", "p95", "p99"}
+    json.dumps(snap)  # must be JSON-safe as bench.py embeds it
+
+
+# -- compile tracker -----------------------------------------------------
+
+
+def test_tracked_jit_counts_compiles_per_signature():
+    import jax.numpy as jnp
+
+    fn = compile_tracker.tracked_jit("obs_test_double", lambda x: x * 2)
+    before = observability.compiles().get("obs_test_double",
+                                          {"count": 0})["count"]
+    a = fn(jnp.ones((4,)))
+    b = fn(jnp.ones((4,)))          # cache hit, no new compile
+    c = fn(jnp.ones((4, 2)))        # new shape -> retrace
+    np.testing.assert_allclose(np.asarray(a), 2.0)
+    np.testing.assert_allclose(np.asarray(b), 2.0)
+    assert np.asarray(c).shape == (4, 2)
+    assert fn.traces["count"] == 2
+    rec = observability.compiles()["obs_test_double"]
+    assert rec["count"] - before == 2
+    assert "[4,2]" in rec["last_signature"]
+    assert len(rec["signatures"]) >= 2
+
+
+def test_warn_recompiles_names_offending_signature():
+    """The acceptance contract: force an extra recompile via a new input
+    shape and require BOTH the tracked count and a RecompileWarning
+    carrying the offending abstract signature."""
+    import jax.numpy as jnp
+
+    fn = compile_tracker.tracked_jit("obs_test_warn", lambda x: x + 1)
+    old = pt.get_flags("warn_recompiles")["warn_recompiles"]
+    pt.set_flags({"warn_recompiles": 1})
+    try:
+        fn(jnp.zeros((3,)))  # compile 1 of 1: under the limit, silent
+        with pytest.warns(RecompileWarning,
+                          match=r"obs_test_warn compiled 2 times.*\[5\]"):
+            fn(jnp.zeros((5,)))  # compile 2 > limit 1
+    finally:
+        pt.set_flags({"warn_recompiles": old})
+    rec = observability.compiles()["obs_test_warn"]
+    assert rec["count"] == 2
+    assert "[5]" in rec["last_signature"]
+    # mirrored into the run log (in-memory ring; no dir configured)
+    warns = [e for e in runlog.recent(50)
+             if e["kind"] == "recompile_warning"
+             and e["fn"] == "obs_test_warn"]
+    assert warns and warns[-1]["signature"] == rec["last_signature"]
+
+
+def test_executor_step_is_compile_tracked():
+    """Each new feed shape through Executor.run is one (and only one)
+    more tracked executor_step compile."""
+    main_p, startup = Program(), Program()
+    main_p.random_seed = startup.random_seed = 3
+    with program_guard(main_p, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        out = layers.fc(x, 2)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+
+    def count():
+        return observability.compiles().get("executor_step",
+                                            {"count": 0})["count"]
+
+    before = count()
+    for batch in (2, 2, 6):  # two distinct shapes, one repeat
+        exe.run(main_p, feed={"x": np.ones((batch, 4), np.float32)},
+                fetch_list=[out.name], scope=scope)
+    assert count() - before == 2
+
+
+# -- run log -------------------------------------------------------------
+
+
+def test_runlog_writes_jsonl_and_rotates(tmp_path):
+    old = pt.get_flags(["runlog_dir", "runlog_max_mb"])
+    pt.set_flags({"runlog_dir": str(tmp_path), "runlog_max_mb": 0.001})
+    try:
+        assert runlog.enabled()
+        for i in range(40):  # ~100 bytes/line, cap is 1000 bytes
+            runlog.log_event("obs_test_tick", i=i, pad="x" * 60)
+        path = runlog.current_path()
+        assert path and str(tmp_path) in path
+        runlog.close()
+    finally:
+        pt.set_flags(old)
+    # bounded disk by design: the active file plus ONE .1 predecessor,
+    # each at most one line over the cap, no matter how many rotations
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert len(files) == 2 and files[1].endswith(".1")
+    events = []
+    for p in tmp_path.iterdir():
+        assert p.stat().st_size <= 1000 + 200, p
+        with open(p) as f:
+            events += [json.loads(line) for line in f]
+    assert 0 < len(events) < 40  # older rotations were dropped
+    # what survives is the contiguous tail of the stream
+    events.sort(key=lambda e: e["seq"])
+    seqs = [e["seq"] for e in events]
+    assert seqs == list(range(seqs[0], seqs[0] + len(events)))
+    assert all(e["kind"] == "obs_test_tick" for e in events)
+    assert events[-1]["i"] == 39  # ... ending at the newest event
+    # ring keeps events regardless of persistence
+    assert any(e["kind"] == "obs_test_tick" for e in runlog.recent(50))
+
+
+def test_runlog_disabled_touches_no_files():
+    old = pt.get_flags("runlog_dir")
+    pt.set_flags({"runlog_dir": ""})
+    runlog.close()
+    try:
+        ev = runlog.log_event("obs_test_ghost", n=1)
+        assert ev["kind"] == "obs_test_ghost" and ev["seq"] > 0
+        assert runlog.current_path() is None
+    finally:
+        pt.set_flags(old)

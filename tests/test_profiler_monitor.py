@@ -56,6 +56,56 @@ def test_events_off_when_disabled(tmp_path):
     assert all(s["name"] != "ghost" for s in summary)
 
 
+def test_record_event_decorator_preserves_metadata():
+    @profiler.RecordEvent("meta")
+    def documented(a, b=1):
+        """the docstring survives"""
+        return a + b
+
+    assert documented.__name__ == "documented"
+    assert documented.__doc__ == "the docstring survives"
+    assert documented(2, b=3) == 5
+
+
+def test_chrome_trace_event_schema(tmp_path):
+    """Every emitted event carries the chrome://tracing complete-event
+    fields tools/timeline.py consumers expect (ph=X, us timestamps)."""
+    path = str(tmp_path / "schema.json")
+    profiler.start_profiler()
+    with profiler.RecordEvent("one"):
+        time.sleep(0.001)
+    profiler.stop_profiler(profile_path=path)
+    trace = json.load(open(path))
+    (e,) = trace["traceEvents"]
+    assert set(e) == {"name", "ph", "ts", "dur", "pid", "tid", "cat"}
+    assert e["ph"] == "X" and e["cat"] == "host" and e["pid"] == 0
+    assert e["dur"] >= 1000  # slept 1ms; dur is in microseconds
+
+
+def test_summarize_sort_keys():
+    events = [{"name": "big", "dur": 9000.0},
+              {"name": "hot", "dur": 1000.0},
+              {"name": "hot", "dur": 1000.0},
+              {"name": "hot", "dur": 1000.0}]
+    assert [s["name"] for s in profiler.summarize(events, "total")] == \
+        ["big", "hot"]
+    assert [s["name"] for s in profiler.summarize(events, "calls")] == \
+        ["hot", "big"]
+    assert [s["name"] for s in profiler.summarize(events, "ave")] == \
+        ["big", "hot"]
+
+
+def test_profiler_off_records_nothing(tmp_path):
+    with profiler.RecordEvent("off_event"):
+        pass
+    profiler.start_profiler()
+    summary = profiler.stop_profiler(
+        profile_path=str(tmp_path / "off.json"))
+    assert all(s["name"] != "off_event" for s in summary)
+    trace = json.load(open(tmp_path / "off.json"))
+    assert trace["traceEvents"] == []
+
+
 def test_stat_registry():
     monitor.reset()
     monitor.STAT_ADD("feasigns", 10)

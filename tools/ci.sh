@@ -15,10 +15,14 @@
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
 #      tiny model: tokens/s + acceptance rate vs the plain engine)
-#   7. op coverage gate (>= 80% of the reference forward-op surface)
-#   8. API-freeze check (public signature snapshot diff)
-#   9. multi-chip dry-run (GSPMD train step on N virtual devices)
-#  10. README generated fragments vs their registries (no drift)
+#   7. observability gate (train + serving smoke under the run log;
+#      /metrics parses as Prometheus text, compile tracker pins the
+#      decode/prefill compile budget, run-log events feed
+#      tools/trace_summary.py)
+#   8. op coverage gate (>= 80% of the reference forward-op surface)
+#   9. API-freeze check (public signature snapshot diff)
+#  10. multi-chip dry-run (GSPMD train step on N virtual devices)
+#  11. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -26,7 +30,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/10 import smoke"
+echo "== 1/11 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -35,39 +39,39 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/10 lint (program verifier + op-desc compat)"
+echo "== 2/11 lint (program verifier + op-desc compat)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --books
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 3/10 test suite (virtual 8-device CPU mesh)"
+  echo "== 3/11 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 3/10 test suite: SKIPPED (quick mode)"
+  echo "== 3/11 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/10 chaos suite (deterministic fault injection)"
+  echo "== 4/11 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 4/10 chaos suite: reduced subset (quick mode)"
+  echo "== 4/11 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/10 serving plane"
+  echo "== 5/11 serving plane"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 else
-  echo "== 5/10 serving plane: reduced subset (quick mode)"
+  echo "== 5/11 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv"
 fi
 
-echo "== 6/10 speculative decoding gate"
+echo "== 6/11 speculative decoding gate"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -k "spec"
 if [[ "${1:-}" != "quick" ]]; then
   echo "   bench: spec vs non-spec on the repetitive-suffix workload"
@@ -76,14 +80,20 @@ if [[ "${1:-}" != "quick" ]]; then
     BENCH_SERVING_COMPARE=0 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== 7/10 op coverage gate"
+echo "== 7/11 observability gate"
+# tiny train + serving smoke under the run log: /metrics parses as
+# Prometheus text, compile tracker pins decode_step==1 compile and
+# one batched prefill dispatch, JSONL events feed trace_summary
+JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
+echo "== 8/11 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 8/10 API freeze"
+echo "== 9/11 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -102,7 +112,7 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 9/10 multi-chip dry run"
+echo "== 10/11 multi-chip dry run"
 # needs the jax_num_cpu_devices config option to carve out virtual CPU
 # devices; older jax builds (0.4.x) don't have it
 if JAX_PLATFORMS=cpu python -c "
@@ -118,7 +128,7 @@ else
   echo "   installed jax has no jax_num_cpu_devices — skipped"
 fi
 
-echo "== 10/10 README generated-fragment sync"
+echo "== 11/11 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

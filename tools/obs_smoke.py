@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""CI observability gate: tiny train + serving smoke under the run log.
+
+Asserts, end to end through the observability plane:
+  - a guarded training run (with one injected-NaN batch) emits
+    train_step / guardian_skip / fault_injected run-log events;
+  - a serving run emits serving_admit / serving_finish events;
+  - the compile tracker reports decode_step compile-count == 1 and the
+    batched same-bucket prefill dispatched exactly once (the PR 3/4
+    invariants, regression-locked via the new plane);
+  - GET /metrics on ServingHTTPServer parses as Prometheus text and
+    carries serving, fault, and compile metrics;
+  - tools/trace_summary.py consumes the emitted JSONL run log.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="obs_smoke_")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, monitor, observability
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard, unique_name)
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import runlog
+    from paddle_tpu.optimizer import SGDOptimizer
+    from paddle_tpu.resilience import TrainGuardian, fault_scope
+    from paddle_tpu.serving import ServingEngine, ServingHTTPServer
+
+    pt.set_flags({"runlog_dir": tmp})
+
+    # -- tiny train under the guardian, with one injected NaN batch ----
+    main_p, startup = Program(), Program()
+    main_p.random_seed = startup.random_seed = 5
+    with program_guard(main_p, startup), unique_name.guard():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        SGDOptimizer(0.1).minimize(loss)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    pt.set_flags({"check_nan_inf": True})
+    try:
+        with fault_scope("exec.step:nan@3"):
+            guardian = TrainGuardian(exe, main_p, scope)
+            for _ in range(5):
+                xb = rng.rand(8, 4).astype(np.float32)
+                yb = (xb.sum(1, keepdims=True) +
+                      rng.rand(8, 1).astype(np.float32) * 0.1)
+                guardian.step(feed={"x": xb, "y": yb},
+                              fetch_list=[loss.name])
+    finally:
+        pt.set_flags({"check_nan_inf": False})
+    assert guardian.skipped == 1, guardian.skipped
+    print(f"   train: {guardian.steps_done} steps, "
+          f"{guardian.skipped} NaN skip")
+
+    # -- serving smoke: 3 same-bucket prompts through 3 slots ----------
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=97, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_slots=3, max_len=32,
+                        buckets=[8, 16], max_queue=16)
+    prompts = [rng.randint(1, 97, size=n).tolist() for n in (3, 5, 7)]
+    reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.step()
+    prefill_calls = monitor.stat_get("STAT_serving_prefill_calls")
+    assert prefill_calls == 1, (
+        f"expected ONE batched prefill dispatch, saw {prefill_calls}")
+    eng.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+
+    comp = observability.compiles()
+    assert comp["decode_step"]["count"] == 1, comp.get("decode_step")
+    assert comp["serving_prefill{bucket=8}"]["count"] == 1, comp
+    assert comp["decode_step"]["last_signature"], "no compile signature"
+    print(f"   compile tracker: decode_step=1, prefill{{bucket=8}}=1 "
+          f"({len(comp)} tracked sites)")
+
+    # -- /metrics scrape ----------------------------------------------
+    srv = ServingHTTPServer(eng, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+    finally:
+        srv.stop()
+    n = observability.validate_prometheus_text(text)
+    for needle in ("STAT_serving_tokens", "STAT_fault_exec_step",
+                   "STAT_guardian_skipped", "xla_compiles",
+                   "serving_ttft_seconds"):
+        assert needle in text, f"/metrics missing {needle}"
+    print(f"   /metrics: {n} samples, valid Prometheus text")
+
+    # -- run log consumed by trace_summary ----------------------------
+    runlog.close()
+    path = os.path.join(tmp, f"runlog-{os.getpid()}.jsonl")
+    kinds = set()
+    with open(path) as f:
+        for line in f:
+            kinds.add(json.loads(line)["kind"])
+    for k in ("train_step", "guardian_skip", "fault_injected",
+              "serving_admit", "serving_finish"):
+        assert k in kinds, f"run log missing {k!r} events (got {kinds})"
+    from tools import trace_summary
+    rc = trace_summary.main([path, "--top", "5"])
+    assert rc == 0
+    print(f"   run log: {sorted(kinds)} -> trace_summary ok")
+    print("observability gate PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,9 @@ Three fragments are generated, everything else stays hand-written:
   - the "Serving" section between the
     `<!-- BEGIN GENERATED: serving -->` markers (from the registered
     `FLAGS_serving_*` flags + the serving fault sites)
+  - the "Observability" section between the
+    `<!-- BEGIN GENERATED: observability -->` markers (from
+    observability.INSTRUMENT_DOCS / EVENT_DOCS + the registered flags)
 """
 
 import argparse
@@ -323,6 +326,98 @@ def sync_serving_block(text, check):
     return text[:b] + "\n" + want + "\n" + text[e:], None
 
 
+_OBS_BEGIN = "<!-- BEGIN GENERATED: observability -->"
+_OBS_END = "<!-- END GENERATED: observability -->"
+_OBS_FLAGS = ("warn_recompiles", "runlog_dir", "runlog_max_mb")
+
+
+def render_observability_block():
+    """Instrument inventory + run-log event kinds + flags, from the
+    live registries (observability.INSTRUMENT_DOCS / EVENT_DOCS and
+    paddle_tpu/flags.py)."""
+    import textwrap
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu import flags, observability
+
+    def bullet(head, body):
+        return "\n".join(textwrap.wrap(
+            f"- {head} — {body}", width=76, subsequent_indent="  "))
+
+    lines = [
+        "`paddle_tpu.observability` is the one metrics plane the whole",
+        "framework reports into: a thread-safe registry of typed",
+        "Counter / Gauge / Histogram instruments (fixed log-scale",
+        "buckets, so p50/p95/p99 are derivable without storing",
+        "samples), an XLA compile tracker wrapping every `jax.jit`",
+        "entry point (`observability.compiles()` gives per-site compile",
+        "counts, wall time, and the abstract shape/dtype signature that",
+        "triggered each compile), a structured JSONL run log",
+        "(`observability.log_event(kind, **fields)`), and exporters:",
+        "`observability.prometheus_text()` served at `GET /metrics` on",
+        "`ServingHTTPServer`, `observability.snapshot()` embedded in",
+        "`BENCH_*.json`, and counter/histogram summaries appended to",
+        "`profiler.stop_profiler()`'s table. The `monitor.stat_*` API",
+        "is a shim over the same registry.",
+        "",
+        "Instruments:",
+        "",
+    ]
+    lines += [bullet(f"`{name}`", doc)
+              for name, doc in observability.INSTRUMENT_DOCS.items()]
+    lines += [
+        "",
+        "Run-log event kinds (one JSON line each, stamped with a",
+        "monotonic `seq`/`ts`/`mono`; summarize with",
+        "`python tools/trace_summary.py <runlog.jsonl>`, which also",
+        "reads the profiler's chrome-trace JSON):",
+        "",
+    ]
+    lines += [bullet(f"`{kind}`", doc)
+              for kind, doc in observability.EVENT_DOCS.items()]
+    lines += [
+        "",
+        "Example scrape:",
+        "",
+        "```",
+        "$ curl -s localhost:$PORT/metrics | grep -m4 -E 'serving|compiles'",
+        "# TYPE STAT_serving_tokens counter",
+        "STAT_serving_tokens 128",
+        "# TYPE xla_compiles counter",
+        'xla_compiles{bucket="16",fn="serving_prefill"} 1',
+        "```",
+        "",
+        "Flags:",
+        "",
+    ]
+    defs = flags.list_flags()
+    for name in _OBS_FLAGS:
+        d = defs[name]
+        lines.append(bullet(
+            f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
+    return "\n".join(lines)
+
+
+def sync_observability_block(text, check):
+    """Returns (new_text, drift_message_or_None)."""
+    try:
+        b = text.index(_OBS_BEGIN) + len(_OBS_BEGIN)
+        e = text.index(_OBS_END)
+    except ValueError:
+        raise SystemExit("README observability markers not found")
+    current = text[b:e].strip("\n")
+    want = render_observability_block()
+    if current == want:
+        print("README observability block in sync")
+        return text, None
+    if check:
+        return text, ("README observability block DRIFTS from the "
+                      "observability/flag registries — rerun "
+                      "tools/sync_readme.py")
+    print("README observability block regenerated")
+    return text[:b] + "\n" + want + "\n" + text[e:], None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--check", action="store_true",
@@ -335,7 +430,7 @@ def main():
     orig = text
     drifts = []
     for sync in (sync_headline, sync_checks_block, sync_fault_block,
-                 sync_serving_block):
+                 sync_serving_block, sync_observability_block):
         text, drift = sync(text, args.check)
         if drift:
             drifts.append(drift)
