@@ -348,6 +348,13 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     serves a repetitive-suffix workload — where the n-gram self-drafter
     earns its keep — once without and once with speculative decoding
     and reports the spec_* block (tokens/s, acceptance rate, speedup).
+    Unless BENCH_SERVING_PAGED=0, it also serves a shared-system-prompt
+    workload through a dense engine and a paged engine holding the SAME
+    total KV pool bytes and reports the paged block: KV bytes/request,
+    prefix-cache hit rate, and max concurrent requests (the paged
+    engine packs more in-flight requests into the fixed pool because
+    shared prefix blocks are stored once and each request pays only
+    its actual need, not a full max_len row).
     """
     import jax
 
@@ -440,6 +447,65 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
                                  (base_toks / base_dt), 2),
                 "acceptance_rate": st.get("spec_acceptance_rate"),
             }
+        paged_cmp = None
+        if os.environ.get("BENCH_SERVING_PAGED", "1") != "0":
+            # shared-system-prompt workload: one long shared prefix +
+            # short unique user suffixes, served through a dense engine
+            # and a paged engine holding the SAME total KV pool bytes
+            # (batch full max_len rows == batch*blocks_per_row blocks)
+            bs = int(os.environ.get("BENCH_SERVING_BLOCK", "8"))
+            blocks_per_row = -(-seq // bs)
+            pool_blocks = batch * blocks_per_row + 1   # +1: trash block
+            sys_len = min(max_prompt - 2, 4 * bs)
+            user_len = 2
+            mnt = min(new_tokens, seq - sys_len - user_len)
+            r = np.random.RandomState(4)
+            sysp = r.randint(1, cfg.vocab_size, size=sys_len).tolist()
+            nshared = max(nreq, 4 * batch)
+            shared_ps = [sysp + r.randint(1, cfg.vocab_size,
+                                          size=user_len).tolist()
+                         for _ in range(nshared)]
+
+            def serve_peak(paged, **kw):
+                eng = ServingEngine(model, max_len=seq,
+                                    max_queue=nshared + batch,
+                                    paged=paged, **kw)
+                rs = [eng.submit(p, max_new_tokens=mnt)
+                      for p in shared_ps]
+                peak = 0
+                while eng._queue or eng._active:
+                    eng.step()
+                    peak = max(peak, len(eng._active))
+                assert all(rq.state == "done" for rq in rs)
+                return rs, eng, peak
+
+            d_reqs, d_eng, d_peak = serve_peak(False, max_slots=batch)
+            p_reqs, p_eng, p_peak = serve_peak(
+                True, max_slots=4 * batch, block_size=bs,
+                num_blocks=pool_blocks, prefix_cache=True)
+            for a, b in zip(d_reqs, p_reqs):
+                assert a.output_ids == b.output_ids, \
+                    "paged shared-prefix serve diverged from dense"
+            pos_bytes = (cfg.num_layers * 2 * cfg.num_heads *
+                         (cfg.hidden_size // cfg.num_heads) * 4)
+            dense_bpr = seq * pos_bytes        # one full row per request
+            paged_bpr = (p_eng.cache.blocks_allocated_total * bs *
+                         pos_bytes) / nshared
+            st = p_eng.stats()
+            paged_cmp = {
+                "workload": f"{sys_len}-token shared system prompt + "
+                            f"{user_len}-token user suffix x{nshared}",
+                "pool_kv_positions": (pool_blocks - 1) * bs,
+                "block_size": bs,
+                "dense_kv_bytes_per_request": dense_bpr,
+                "paged_kv_bytes_per_request": round(paged_bpr),
+                "kv_bytes_saved": round(1 - paged_bpr / dense_bpr, 3),
+                "dense_max_concurrent": d_peak,
+                "paged_max_concurrent": p_peak,
+                "concurrency_gain": round(p_peak / max(d_peak, 1), 2),
+                "prefix_hit_rate": st.get("prefix_hit_rate"),
+                "prefix_hit_requests": st.get("prefix_hit_requests"),
+            }
     except Exception as e:
         msg = str(e)
         if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
@@ -468,6 +534,8 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     }
     if spec is not None:
         out["spec"] = spec
+    if paged_cmp is not None:
+        out["paged"] = paged_cmp
     # full observability snapshot (counters + histogram percentiles +
     # compile records, never raw samples) rides along in BENCH_*.json
     from paddle_tpu import observability

@@ -219,6 +219,34 @@ define_flag("serving_spec_ngram", 3,
             "prompt+generated context when proposing draft tokens "
             "(falls back to shorter n-grams, then to repeating the "
             "last token).")
+define_flag("serving_paged", True,
+            "ServingEngine KV memory manager: True = block-paged "
+            "BlockKVCache (per-request block tables over a fixed pool "
+            "of serving_block_size-row KV blocks, ref-counted with "
+            "shared-prefix reuse — each request pays only the blocks "
+            "it needs); False = the dense SlotKVCache (every request "
+            "pays a full max_len row). Output is token-identical "
+            "either way.")
+define_flag("serving_block_size", 16,
+            "Paged serving: KV rows per block. Smaller blocks waste "
+            "less memory on partial blocks and share shorter "
+            "prefixes; larger blocks shrink the block table and the "
+            "gather fan-in.")
+define_flag("serving_num_blocks", 0,
+            "Paged serving: physical KV blocks in the pool per layer "
+            "(block 0 is reserved as the trash block for "
+            "padding/overflow writes). 0 = auto-size to "
+            "max_slots * ceil(max_len/block_size) + 1, enough for "
+            "every slot at worst-case length; set it lower to "
+            "oversubscribe memory and rely on short requests + "
+            "prefix sharing (admission blocks head-of-line when the "
+            "pool runs dry).")
+define_flag("serving_prefix_cache", True,
+            "Paged serving: cache full prompt blocks under a rolling "
+            "token-prefix hash so a repeated system prompt prefills "
+            "once and later requests reference its blocks "
+            "(copy-on-write at a partially shared boundary block). "
+            "Idle entries are evicted LRU under pool pressure.")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

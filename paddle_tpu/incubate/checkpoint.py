@@ -55,15 +55,31 @@ class CheckpointSaver:
         self._sweep_tmp()
 
     def _sweep_tmp(self):
-        """Remove ``<step>.tmp`` debris a preempted save left behind —
-        it was never published, so deleting it can't lose state."""
+        """Remove ``<step>.tmp[.<pid>]`` debris a preempted save left
+        behind — it was never published, so deleting it can't lose
+        state. Tmp dirs carry their writer's pid so a worker
+        initializing its saver while a PEER rank is mid-save (elastic
+        restart: ranks spawn staggered) sweeps only orphans, never an
+        in-flight publish."""
         if not os.path.isdir(self.dir):
             return
         for d in os.listdir(self.dir):
-            if d.endswith(".tmp"):
-                shutil.rmtree(os.path.join(self.dir, d),
-                              ignore_errors=True)
-                _monitor.stat_add("STAT_ckpt_tmp_swept")
+            if ".tmp" not in d:
+                continue
+            _, _, owner = d.partition(".tmp.")
+            if owner:
+                try:
+                    os.kill(int(owner), 0)   # raises if pid is gone
+                    continue                 # live writer: leave it
+                except (ProcessLookupError, ValueError):
+                    pass
+                except PermissionError:
+                    continue                 # alive, other user
+            elif not d.endswith(".tmp"):
+                continue
+            shutil.rmtree(os.path.join(self.dir, d),
+                          ignore_errors=True)
+            _monitor.stat_add("STAT_ckpt_tmp_swept")
 
     def _numbers(self) -> List[int]:
         if not os.path.isdir(self.dir):
@@ -88,7 +104,7 @@ class CheckpointSaver:
     def _save_once(self, state, number, meta):
         kind = fault_point("ckpt.save")  # may raise InjectedIOError
         path = os.path.join(self.dir, str(number))
-        tmp = path + ".tmp"
+        tmp = f"{path}.tmp.{os.getpid()}"   # pid: see _sweep_tmp
         os.makedirs(tmp, exist_ok=True)
         np.savez(os.path.join(tmp, "state"), **{
             k: np.asarray(v) for k, v in state.items()})

@@ -6,8 +6,9 @@ python/paddle/vision/models/)."""
 from .gpt import (GPT_CONFIGS, GPTForCausalLM, GPTModel, gpt2_medium,
                   gpt2_small, gpt2_tiny)
 from . import generation
-from .generation import (beam_search, decode_step, draft_ngram,
-                         greedy_search, sample, verify_step)
+from .generation import (beam_search, decode_step, decode_step_paged,
+                         draft_ngram, greedy_search, sample,
+                         verify_step, verify_step_paged)
 from .ernie import (ERNIE_CONFIGS, ErnieForPretraining,
                     ErnieForSequenceClassification, ErnieModel,
                     ernie_tiny)

@@ -129,6 +129,84 @@ def verify_step(model, spec_tokens: int):
     return ent
 
 
+def decode_step_paged(model):
+    """The block-paged sibling of :func:`decode_step`.
+
+    Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn``
+    maps ``(tokens [b] i32, pos [b] i32, tables [b, T] i32, pools
+    [(k, v) block arrays])`` to ``(next_tokens [b] i32, last_logits
+    [b, V], new_pools)``. Identical semantics to ``decode_step`` — each
+    row's token is written at its own offset, now routed through the
+    row's block table into the shared [num_blocks, h, block_size, d]
+    pools — with the same compile-once contract: pools AND tables are
+    fixed-shape jit inputs, so block remapping (admission, prefix
+    sharing, COW) never retraces.
+    """
+    from .. import flags as _flags
+    from ..observability import compile_tracker as _ct
+    ent = getattr(model, "_decode_step_paged_cache", None)
+    if ent is not None and ent["flags_version"] == _flags.version():
+        return ent
+
+    def _step(tokens, pos, tables, pools):
+        with no_grad():
+            tpools = [(Tensor(k, stop_gradient=True),
+                       Tensor(v, stop_gradient=True)) for k, v in pools]
+            logits, newp = model(_t(tokens[:, None]), cache=tpools,
+                                 cache_pos=pos, block_tables=tables)
+        lg = logits.value[:, -1]
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return nxt, lg, [(c[0].value, c[1].value) for c in newp]
+
+    fn = _ct.tracked_jit("decode_step_paged", _step)
+    ent = {"fn": fn, "traces": fn.traces,
+           "flags_version": _flags.version()}
+    model._decode_step_paged_cache = ent
+    return ent
+
+
+def verify_step_paged(model, spec_tokens: int):
+    """The block-paged sibling of :func:`verify_step`: one fixed-shape
+    forward scores the last committed token plus K drafts
+    (``tokens [b, K+1]``) through per-row block tables. Same row
+    layout, acceptance semantics, and rollback contract as the dense
+    verify step — rejected rows are stale pool contents past the
+    row's valid length, hidden by the position mask (blocks stay
+    reserved, so rollback across a block boundary is pure host-side
+    length arithmetic). Compiled once per (model, K).
+    """
+    from .. import flags as _flags
+    k = int(spec_tokens)
+    if k < 1:
+        raise ValueError(
+            f"verify_step_paged needs spec_tokens >= 1, got {k}")
+    cache = getattr(model, "_verify_step_paged_cache", None)
+    if cache is None:
+        cache = model._verify_step_paged_cache = {}
+    ent = cache.get(k)
+    if ent is not None and ent["flags_version"] == _flags.version():
+        return ent
+
+    def _step(tokens, pos, tables, pools):
+        with no_grad():
+            tpools = [(Tensor(kk, stop_gradient=True),
+                       Tensor(vv, stop_gradient=True))
+                      for kk, vv in pools]
+            logits, newp = model(_t(tokens), cache=tpools,
+                                 cache_pos=pos, block_tables=tables)
+        lg = logits.value                                # [b, K+1, V]
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return nxt, lg, [(c[0].value, c[1].value) for c in newp]
+
+    from ..observability import compile_tracker as _ct
+    fn = _ct.tracked_jit("verify_step_paged", _step,
+                         labels={"k": str(k)})
+    ent = {"fn": fn, "traces": fn.traces,
+           "flags_version": _flags.version()}
+    cache[k] = ent
+    return ent
+
+
 def draft_ngram(context, k: int, max_ngram: int = 3):
     """N-gram self-drafting (prompt-lookup decoding): propose ``k``
     draft tokens by matching the longest suffix n-gram of ``context``

@@ -101,13 +101,47 @@ class GPTAttention(Layer):
                                weight_attr=wo)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x, cache=None, cache_pos=None):
+    def forward(self, x, cache=None, cache_pos=None, block_tables=None):
         cfg = self.cfg
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
         qkv = qkv.transpose([2, 0, 3, 1, 4])  # [3, b, h, s, d]
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if block_tables is not None:
+            # block-paged KV cache: `cache` is a (k, v) pool pair of
+            # [num_blocks, h, block_size, d] blocks shared by every
+            # request; each batch row's logical positions route through
+            # its `block_tables` row to physical blocks. Pools and
+            # tables are both fixed-shape jit inputs, so remapping or
+            # sharing blocks (prefix cache, COW) never recompiles —
+            # same compile-once contract as the slotted path below,
+            # with per-request memory paid in blocks instead of a full
+            # max_len row. Inference-only by construction.
+            from ..ops.attention_ops import (block_gather,
+                                             block_scatter_write,
+                                             decode_attention_mask)
+            kp, vp = cache[0].value, cache[1].value
+            pos = jnp.asarray(cache_pos, jnp.int32)
+            if pos.ndim == 0:
+                pos = jnp.broadcast_to(pos, (b,))
+            tables = jnp.asarray(block_tables, jnp.int32)
+            kp = block_scatter_write(kp, k.value, pos, tables)
+            vp = block_scatter_write(vp, v.value, pos, tables)
+            kg = block_gather(kp, tables)        # [b, h, T*bs, d]
+            vg = block_gather(vp, tables)
+            mask = decode_attention_mask(pos, s, kg.shape[2], kg.dtype)
+            cache = (Tensor(kp, stop_gradient=True),
+                     Tensor(vp, stop_gradient=True))
+            out = run_op("fused_attention_qkv",
+                         {"Q": [q],
+                          "K": [Tensor(kg, stop_gradient=True)],
+                          "V": [Tensor(vg, stop_gradient=True)],
+                          "Mask": [Tensor(mask, stop_gradient=True)]},
+                         {"causal": False})["Out"][0]
+            out = out.transpose([0, 2, 1, 3]).reshape(
+                [b, s, cfg.hidden_size])
+            return self.dropout(self.out_proj(out)), cache
         if cache is not None and cache_pos is not None:
             # fixed-capacity (slotted) KV cache: `cache` is a
             # preallocated [b, h, max_len, d] pair and the new keys are
@@ -163,11 +197,12 @@ class GPTBlock(Layer):
                           weight_attr=wo)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x, cache=None, cache_pos=None):
+    def forward(self, x, cache=None, cache_pos=None, block_tables=None):
         if cache is None:
             x = x + self.attn(self.ln1(x))
         else:
-            a, cache = self.attn(self.ln1(x), cache, cache_pos=cache_pos)
+            a, cache = self.attn(self.ln1(x), cache, cache_pos=cache_pos,
+                                 block_tables=block_tables)
             x = x + a
         x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)),
                                              approximate=True)))
@@ -190,7 +225,7 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, cache=None, position_offset=0,
-                cache_pos=None):
+                cache_pos=None, block_tables=None):
         s = input_ids.shape[1]
         if cache_pos is not None:
             # fixed-capacity cache mode: positions come from each row's
@@ -207,8 +242,16 @@ class GPTModel(Layer):
                     f"{self.cfg.max_position_embeddings}")
             p = jnp.asarray(cache_pos, jnp.int32)
             p = p[None] if p.ndim == 0 else p
-            pos = Tensor(p[:, None] + jnp.arange(s, dtype=jnp.int32)[None],
-                         stop_gradient=True)
+            # clamp: bucketed-prefill padding rows carry positions past
+            # a short request's real length; an out-of-range position
+            # gather would produce NaN embeddings (jnp.take fill mode)
+            # that poison even *masked* attention lanes (finfo.min +
+            # NaN = NaN through the softmax). The clamp is an identity
+            # for every valid row.
+            pos = jnp.minimum(
+                p[:, None] + jnp.arange(s, dtype=jnp.int32)[None],
+                self.cfg.max_position_embeddings - 1)
+            pos = Tensor(pos, stop_gradient=True)
         else:
             if position_offset + s > self.cfg.max_position_embeddings:
                 # out-of-range position gathers would silently produce
@@ -235,7 +278,8 @@ class GPTModel(Layer):
                 else:
                     x = blk(x)
             else:
-                x, c = blk(x, cache[i], cache_pos=cache_pos)
+                x, c = blk(x, cache[i], cache_pos=cache_pos,
+                           block_tables=block_tables)
                 new_caches.append(c)
         x = self.ln_f(x)
         return x if cache is None else (x, new_caches)
@@ -256,6 +300,17 @@ class GPTModel(Layer):
                    stop_gradient=True)
         return [(z, z) for _ in range(self.cfg.num_layers)]
 
+    def gen_block_pool(self, num_blocks, block_size):
+        """Preallocated block-paged KV pool: one
+        [num_blocks, h, block_size, d] zero pair per layer, addressed
+        through per-request block tables (``block_tables`` forward
+        kwarg). Physical block 0 is reserved by the serving plane as
+        the trash block for padding/overflow writes."""
+        z = Tensor(jnp.zeros((num_blocks, self.cfg.num_heads, block_size,
+                              self.cfg.head_dim), jnp.float32),
+                   stop_gradient=True)
+        return [(z, z) for _ in range(self.cfg.num_layers)]
+
 
 class GPTForCausalLM(Layer):
     """LM head tied to the token embedding (weight sharing, like GPT-2)."""
@@ -266,14 +321,15 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(cfg)
 
     def forward(self, input_ids, labels=None, cache=None,
-                position_offset=0, cache_pos=None):
+                position_offset=0, cache_pos=None, block_tables=None):
         if cache is None:
             # forward the offset: chunked-prefill callers without a cache
             # must get real positions (and the out-of-range guard)
             h = self.gpt(input_ids, position_offset=position_offset)
         else:
             h, cache = self.gpt(input_ids, cache, position_offset,
-                                cache_pos=cache_pos)
+                                cache_pos=cache_pos,
+                                block_tables=block_tables)
         # tied LM head: h @ wte.T
         logits = run_op("matmul_v2",
                         {"X": [h], "Y": [self.gpt.wte.weight]},

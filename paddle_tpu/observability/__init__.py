@@ -29,14 +29,24 @@ from .runlog import log_event, recent
 INSTRUMENT_DOCS = {
     "xla_compiles{fn=...}":
         "counter — XLA compiles per tracked_jit site (executor_step, "
-        "parallel_executor_step, decode_step, verify_step, "
-        "serving_prefill{bucket=...}, to_static, to_static_multi_step)",
+        "parallel_executor_step, decode_step[_paged], "
+        "verify_step[_paged], serving_prefill[_paged]{bucket=...}, "
+        "to_static, to_static_multi_step)",
     "xla_compile_ms":
         "histogram — wall ms of calls that triggered an XLA compile",
     "serving_ttft_seconds{engine=...}":
         "histogram — time to first token of completed serving requests",
     "serving_tpot_seconds{engine=...}":
         "histogram — mean time per output token of completed requests",
+    "serving_kv_blocks_used{engine=...}":
+        "gauge — physical KV blocks referenced (paged serving; "
+        "includes the trash block and prefix-cache holds)",
+    "serving_kv_blocks_free{engine=...}":
+        "gauge — physical KV blocks on the free list (paged serving)",
+    "STAT_serving_prefix_hits / _misses":
+        "counters — paged admissions that reused >=1 prefix-cached KV "
+        "block vs prefilled from scratch (token-granular rates in "
+        "ServingEngine.stats())",
     "STAT_serving_*":
         "counters — admission/token/shed/speculative accounting from "
         "the serving engine (see the Serving section)",
@@ -56,7 +66,8 @@ EVENT_DOCS = {
     "guardian_skip": "TrainGuardian skipped a non-finite step",
     "guardian_rollback": "TrainGuardian restored a checkpoint",
     "serving_admit": "request admitted into a KV slot (bucket, "
-                     "prompt_tokens)",
+                     "prompt_tokens; + shared_tokens reused from the "
+                     "prefix cache when paged)",
     "serving_finish": "request retired (tokens, ttft_ms, tpot_ms)",
     "serving_shed": "request shed by backpressure/deadline",
     "serving_spec": "speculative decoding round (proposed, accepted)",

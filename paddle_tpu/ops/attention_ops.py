@@ -80,6 +80,55 @@ def cache_scatter_write(buf, new, pos):
     return jax.vmap(_write)(buf, new, pos)
 
 
+def block_scatter_write(pool, new, pos, tables, overflow_block=0):
+    """Write ``new`` [b, h, s, d] rows into the block-paged KV pool
+    ``pool`` [num_blocks, h, block_size, d], routing each batch row's
+    logical positions ``pos[b]..pos[b]+s-1`` through its block table
+    row ``tables[b]`` [b, T] to physical (block, offset) pairs — the
+    paged generalization of :func:`cache_scatter_write`, still a
+    single fused XLA scatter so the compiled decode/verify/prefill
+    steps keep one fixed signature.
+
+    Positions whose logical block falls outside the table (bucketed
+    prefill's suffix padding rows, beyond a short request's
+    reservation) are routed to ``overflow_block`` — physical block 0,
+    BlockKVCache's permanently-allocated *trash block* — instead of
+    letting XLA's index clamping silently redirect them onto a live
+    block's committed rows. Duplicate (trash, offset) targets are fine:
+    scatter picks one row's value, and nothing ever reads the trash
+    block through a position mask.
+    """
+    pos = jnp.asarray(pos, jnp.int32)
+    b, h, s, d = new.shape
+    bs = pool.shape[2]
+    T = tables.shape[1]
+    rowpos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # [b, s]
+    logical = rowpos // bs
+    phys = jnp.take_along_axis(
+        jnp.asarray(tables, jnp.int32),
+        jnp.minimum(logical, T - 1), axis=1)                      # [b, s]
+    phys = jnp.where(logical < T, phys, jnp.int32(overflow_block))
+    offset = rowpos % bs
+    # advanced indices (flat rows) are separated from the heads slice,
+    # so they broadcast to the FRONT: value rows are [b*s, h, d]
+    rows = jnp.swapaxes(new, 1, 2).reshape(b * s, h, d)
+    return pool.at[phys.reshape(-1), :, offset.reshape(-1)].set(rows)
+
+
+def block_gather(pool, tables):
+    """Materialize each request's logical KV row from the paged pool:
+    ``pool`` [num_blocks, h, block_size, d] gathered through ``tables``
+    [b, T] -> [b, h, T*block_size, d], the layout
+    :func:`decode_attention_mask` and fused attention already expect
+    (capacity = T*block_size; table entries past a request's
+    reservation point at the trash block, whose rows sit beyond the
+    valid length and are masked to exact zero probability).
+    """
+    g = pool[jnp.asarray(tables, jnp.int32)]        # [b, T, h, bs, d]
+    b, T, h, bs, d = g.shape
+    return jnp.swapaxes(g, 1, 2).reshape(b, h, T * bs, d)
+
+
 def _composed_attention(q, k, v, mask, causal, scale):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:

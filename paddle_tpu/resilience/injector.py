@@ -79,6 +79,12 @@ FAULT_SITE_DOCS: Dict[str, str] = {
                     "via RetryPolicy (exhaustion sheds the affected "
                     "requests), `skip` sheds the request being "
                     "prefilled or skips one decode iteration",
+    "serving.alloc": "BlockKVCache admission (paged serving), once per "
+                     "block-table acquisition attempt — drop/error are "
+                     "retried via RetryPolicy (exhaustion sheds that "
+                     "request; blocks already taken are unwound, never "
+                     "leaked), `skip` sheds the request as a simulated "
+                     "allocator failure",
 }
 FAULT_SITES: Tuple[str, ...] = tuple(FAULT_SITE_DOCS)
 

@@ -1,20 +1,27 @@
 """paddle_tpu.serving — the inference serving plane.
 
-Continuous-batching engine over a slotted fixed-shape KV cache:
-requests share one preallocated decode batch (one slot each), prefill
-is shape-bucketed AND batched (every same-bucket admission rides one
-dispatch), and the decode step compiles exactly once per engine
-geometry. With ``FLAGS_serving_spec_tokens`` = K > 0 the engine runs
-draft–verify speculative decoding: an n-gram self-drafter proposes K
-tokens per slot and one fixed-shape verify forward commits up to K+1
-tokens per step, token-identical to the plain greedy path. See
-engine.py for the scheduler, kv_cache.py for the memory manager,
-http.py for the JSON front end.
+Continuous-batching engine over a fixed-shape KV cache: requests share
+one preallocated decode batch, prefill is shape-bucketed AND batched
+(every same-bucket admission rides one dispatch), and the decode step
+compiles exactly once per engine geometry. KV memory is block-paged by
+default (``FLAGS_serving_paged``): a fixed pool of KV blocks with
+per-request block tables, a ref-counted allocator, and a rolling-hash
+prefix cache so a shared system prompt prefills once and is referenced
+by later requests (copy-on-write at the boundary block) — each request
+pays blocks for its actual need instead of a full ``max_len`` row. The
+dense ``SlotKVCache`` remains as the ``paged=False`` baseline. With
+``FLAGS_serving_spec_tokens`` = K > 0 the engine runs draft–verify
+speculative decoding: an n-gram self-drafter proposes K tokens per
+slot and one fixed-shape verify forward commits up to K+1 tokens per
+step, token-identical to the plain greedy path. See engine.py for the
+scheduler, kv_cache.py for the memory managers, http.py for the JSON
+front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine
 from .http import ServingHTTPServer
-from .kv_cache import SlotKVCache
+from .kv_cache import BlockAllocator, BlockKVCache, SlotKVCache
 
 __all__ = ["ServingEngine", "Request", "QueueFullError",
-           "SlotKVCache", "ServingHTTPServer"]
+           "SlotKVCache", "BlockKVCache", "BlockAllocator",
+           "ServingHTTPServer"]

@@ -1,4 +1,5 @@
-"""ServingEngine — continuous-batching inference on the slotted cache.
+"""ServingEngine — continuous-batching inference on a paged (or
+slotted) KV cache.
 
 Iteration-level scheduling (the Orca design point): the unit of work is
 one *step*, not one request. Each step first admits queued requests
@@ -34,12 +35,32 @@ Compile surfaces, all fixed-shape:
   CompiledProgram's keyed ``_cache`` (compiler.py), keyed here by
   shape bucket instead of program.
 
+KV memory comes from one of two managers (``FLAGS_serving_paged``):
+
+- **paged** (default): :class:`~paddle_tpu.serving.kv_cache.BlockKVCache`
+  — a fixed pool of block_size-row KV blocks per layer, per-request
+  host-side block tables shipped into the compiled steps as fixed-shape
+  inputs (``decode_step_paged`` / ``verify_step_paged`` /
+  ``serving_prefill_paged``, each still compiling exactly once), a
+  ref-counted allocator, and a rolling-hash prefix cache so a shared
+  system prompt prefills once and later admissions reference its
+  blocks (copy-on-write at a partially shared boundary block; only the
+  unshared prompt *suffix* runs through the bucketed prefill). A
+  request pays blocks for prompt + max_new_tokens + K, not a full
+  ``max_len`` row; when the pool runs dry admission blocks
+  head-of-line (FIFO preserved) and queue backpressure sheds via
+  QueueFullError/429 as before.
+- **dense**: the original :class:`SlotKVCache` (one max_len row per
+  request) — the bench baseline and fallback.
+
 Resilience: ``serving.submit`` faults reject a submission at admission
 (backpressure path); ``serving.step`` faults fire once per prefill
 attempt and per decode attempt — drop/error retry through RetryPolicy
 (exhaustion sheds the affected requests, never the whole engine),
 ``skip`` sheds the request being prefilled / skips one decode
-iteration. Counters land in monitor.stats() as ``STAT_serving_*``.
+iteration; ``serving.alloc`` faults fire per block-table acquisition
+attempt (paged), shedding that request with every taken block
+unwound. Counters land in monitor.stats() as ``STAT_serving_*``.
 """
 
 from __future__ import annotations
@@ -63,10 +84,12 @@ from ..observability import compile_tracker as _ct
 from ..observability import runlog as _runlog
 from ..dygraph.tape import no_grad
 from ..dygraph.tensor import Tensor
-from ..models.generation import decode_step, draft_ngram, verify_step
+from ..models.generation import (decode_step, decode_step_paged,
+                                 draft_ngram, verify_step,
+                                 verify_step_paged)
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
-from .kv_cache import SlotKVCache
+from .kv_cache import BlockKVCache, SlotKVCache
 
 
 class QueueFullError(RuntimeError):
@@ -183,14 +206,21 @@ class ServingEngine:
                  buckets: Optional[Sequence[int]] = None,
                  max_queue: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
-                 spec_tokens: Optional[int] = None):
+                 spec_tokens: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
                               "serving_max_new_tokens",
                               "serving_idle_wait",
                               "serving_spec_tokens",
-                              "serving_spec_ngram"])
+                              "serving_spec_ngram",
+                              "serving_paged", "serving_block_size",
+                              "serving_num_blocks",
+                              "serving_prefix_cache"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -221,9 +251,22 @@ class ServingEngine:
                         if buckets is None else
                         _parse_buckets(",".join(map(str, buckets)),
                                        self.max_len))
-        self.cache = SlotKVCache(cfg.num_layers, cfg.num_heads,
-                                 cfg.head_dim, self.max_slots,
-                                 self.max_len)
+        self.paged = bool(paged if paged is not None
+                          else g["serving_paged"])
+        if self.paged:
+            self.cache = BlockKVCache(
+                cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                self.max_slots, self.max_len,
+                block_size=int(block_size if block_size is not None
+                               else g["serving_block_size"]),
+                num_blocks=int(num_blocks if num_blocks is not None
+                               else g["serving_num_blocks"]),
+                prefix_cache=bool(prefix_cache if prefix_cache is not None
+                                  else g["serving_prefix_cache"]))
+        else:
+            self.cache = SlotKVCache(cfg.num_layers, cfg.num_heads,
+                                     cfg.head_dim, self.max_slots,
+                                     self.max_len)
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}
         self._all: List[Request] = []
@@ -249,6 +292,20 @@ class ServingEngine:
         self._completed = 0
         self._spec_proposed = 0   # draft tokens offered to the verify
         self._spec_accepted = 0   # draft tokens the model agreed with
+        self._prefix_hit_reqs = 0   # admissions that reused >=1 block
+        self._prefix_miss_reqs = 0  # admissions that reused none
+        if self.paged:
+            self._blocks_used_g = _obs.gauge(
+                "serving_kv_blocks_used",
+                "physical KV blocks currently referenced (paged "
+                "serving; includes the trash block and prefix-cache "
+                "holds)").labels(engine=eid)
+            self._blocks_free_g = _obs.gauge(
+                "serving_kv_blocks_free",
+                "physical KV blocks on the free list (paged serving)"
+                ).labels(engine=eid)
+            self._blocks_used_g.set(self.cache.blocks_used)
+            self._blocks_free_g.set(self.cache.blocks_free)
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int],
@@ -276,6 +333,14 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({mnt})"
                 f"{spec} exceeds slot capacity max_len={self.max_len}")
+        if self.paged:
+            need = self.cache.blocks_needed(
+                len(prompt) + mnt + self.spec_tokens)
+            if need > self.cache.num_blocks - 1:  # minus trash block
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only "
+                    f"has {self.cache.num_blocks - 1} usable; raise "
+                    "FLAGS_serving_num_blocks or shorten the request")
         # raising kinds reject this submission pre-queue; `skip` sheds
         # it through the same backpressure exit as a full queue
         kind = fault_point("serving.submit")
@@ -368,10 +433,186 @@ class ServingEngine:
         fn = self._prefill_entry(bucket)["fn"]
         return live, shed, fn(jnp.asarray(ids), jnp.asarray(last))
 
+    # ----------------------------------------------------- paged prefill
+    def _prefill_entry_paged(self, bucket: int) -> dict:
+        """The paged sibling of :meth:`_prefill_entry`: one jitted
+        prompt-suffix pass per length bucket at a fixed ``max_slots``
+        batch, writing KV through per-row block tables into the shared
+        pools. Maps ``(ids [max_slots, bucket] i32, last [max_slots]
+        i32, pos [max_slots] i32, tables [max_slots, T] i32, pools)``
+        to each row's logits at its true last token plus the updated
+        pools; ``pos`` is each row's write offset (its shared-prefix
+        length — 0 without a prefix hit), so a prefix-cached prompt
+        only computes its unshared suffix. Cached on the MODEL keyed
+        by the full pool geometry."""
+        key = ("paged", bucket, self.max_slots, self.max_len,
+               self.cache.block_size, self.cache.num_blocks)
+        cache = getattr(self.model, "_prefill_step_cache", None)
+        if cache is None:
+            cache = self.model._prefill_step_cache = {}
+        ent = cache.get(key)
+        if ent is not None and ent["flags_version"] == _flags.version():
+            self._prefill_fns[bucket] = ent
+            return ent
+        model = self.model
+
+        def _prefill(ids, last, pos, tables, pools):
+            with no_grad():
+                tpools = [(Tensor(k, stop_gradient=True),
+                           Tensor(v, stop_gradient=True))
+                          for k, v in pools]
+                logits, newp = model(
+                    Tensor(ids, stop_gradient=True), cache=tpools,
+                    cache_pos=pos, block_tables=tables)
+            lg = jnp.take_along_axis(logits.value,
+                                     last[:, None, None], axis=1)[:, 0]
+            return lg, [(c[0].value, c[1].value) for c in newp]
+
+        fn = _ct.tracked_jit("serving_prefill_paged", _prefill,
+                             labels={"bucket": str(bucket)})
+        ent = {"fn": fn, "traces": fn.traces,
+               "flags_version": _flags.version()}
+        cache[key] = ent
+        self._prefill_fns[bucket] = ent
+        return ent
+
+    def _alloc_attempt(self, req: Request, need: int):
+        """One block-table acquisition attempt (the serving.alloc fault
+        site): returns ``(row, shared) | None`` from the cache, raises
+        _Shed on an injected `skip` (simulated allocator failure)."""
+        kind = fault_point("serving.alloc")
+        if kind == "skip":
+            raise _Shed("injected allocator failure for request "
+                        f"{req.id}")
+        return self.cache.acquire(req.prompt, need)
+
+    def _prefill_group_attempt_paged(self, bucket: int, group):
+        """One batched paged-prefill attempt for every same-bucket
+        admission; ``group`` rows are ``(req, row, shared)``. Same
+        per-request fault semantics as the dense path. Returns
+        ``(live, shed, (logits, new_pools) | None)``."""
+        live, shed = [], []
+        for rec in group:
+            kind = fault_point("serving.step")
+            if kind == "skip":
+                shed.append((rec, _Shed("injected skip during prefill "
+                                        f"of request {rec[0].id}")))
+            else:
+                live.append(rec)
+        if not live:
+            return live, shed, None
+        T = self.cache.blocks_per_row
+        ids = np.zeros((self.max_slots, bucket), np.int32)
+        last = np.zeros(self.max_slots, np.int32)
+        pos = np.zeros(self.max_slots, np.int32)
+        tables = np.full((self.max_slots, T), BlockKVCache.TRASH,
+                         np.int32)
+        for i, (req, row, shared) in enumerate(live):
+            suffix = req.prompt[shared:]
+            ids[i, :len(suffix)] = suffix
+            last[i] = len(suffix) - 1
+            pos[i] = shared
+            tables[i] = self.cache.tables[row]
+        fn = self._prefill_entry_paged(bucket)["fn"]
+        return live, shed, fn(jnp.asarray(ids), jnp.asarray(last),
+                              jnp.asarray(pos), jnp.asarray(tables),
+                              self.cache.arrays())
+
+    def _admit_round_paged(self):
+        """One paged admission pass: pop queued requests FIFO, acquire
+        a block table for each (prefix-cache reuse first), group by
+        the unshared *suffix*'s bucket, one batched prefill per group.
+        Pool exhaustion requeues the head-of-line request (and all
+        behind it — FIFO order is part of the equivalence oracle) until
+        retirements free blocks. Returns (consumed, admitted)."""
+        candidates: List[Request] = []
+        with self._lock:
+            while len(candidates) < self.cache.num_free and self._queue:
+                candidates.append(self._queue.popleft())
+        if not candidates:
+            return 0, 0
+        acquired = []   # (req, row, shared)
+        back: List[Request] = []
+        for req in candidates:
+            if back:          # head-of-line blocked: keep FIFO order
+                back.append(req)
+                continue
+            need = (len(req.prompt) + req.max_new_tokens +
+                    self.spec_tokens)
+            try:
+                res = RetryPolicy.from_flags("serving.alloc").call(
+                    self._alloc_attempt, req, need)
+            except _Shed as e:
+                self._shed(req, e)
+                continue
+            except RetryError as e:
+                self._shed(req, e)
+                continue
+            if res is None:
+                back.append(req)   # pool dry: wait for retirements
+                continue
+            acquired.append((req, res[0], res[1]))
+        if back:
+            with self._lock:
+                self._queue.extendleft(reversed(back))
+        if not acquired:
+            return len(candidates) - len(back), 0
+        groups: Dict[int, List] = {}
+        for rec in acquired:
+            req, row, shared = rec
+            groups.setdefault(
+                self._bucket_for(len(req.prompt) - shared),
+                []).append(rec)
+        admitted = 0
+        for bucket in sorted(groups):
+            group = groups[bucket]
+            try:
+                with _monitor.stat_time("STAT_serving_prefill"), \
+                        _profiler.RecordEvent("serving.prefill"):
+                    live, shed, out = RetryPolicy.from_flags(
+                        "serving.step").call(
+                            self._prefill_group_attempt_paged,
+                            bucket, group)
+            except RetryError as e:
+                for req, row, _ in group:
+                    self.cache.release_row(row)
+                    self._shed(req, e)
+                continue
+            for (req, row, _), err in shed:
+                self.cache.release_row(row)
+                self._shed(req, err)
+            if not live:
+                continue
+            lg, pools = out
+            self.cache.set_arrays(pools)
+            first = np.asarray(jnp.argmax(lg, axis=-1))
+            for i, (req, row, shared) in enumerate(live):
+                self.cache.commit_prefill(row, len(req.prompt))
+                self.cache.insert_prefix(row, req.prompt)
+                req.slot = row
+                req.state = "running"
+                self._active[row] = req
+                admitted += 1
+                if shared:
+                    self._prefix_hit_reqs += 1
+                    _monitor.stat_add("STAT_serving_prefix_hits")
+                else:
+                    self._prefix_miss_reqs += 1
+                    _monitor.stat_add("STAT_serving_prefix_misses")
+                _monitor.stat_add("STAT_serving_prefills")
+                _runlog.log_event("serving_admit", request=req.id,
+                                  bucket=bucket, slot=row,
+                                  prompt_tokens=len(req.prompt),
+                                  shared_tokens=shared)
+                self._append_token(req, int(first[i]))
+        return len(candidates) - len(back), admitted
+
     def _admit_round(self):
         """One admission pass: pop up to num_free queued requests,
         group them by prefill bucket, and run ONE batched prefill per
         group. Returns (popped, admitted)."""
+        if self.paged:
+            return self._admit_round_paged()
         candidates: List[Request] = []
         with self._lock:
             while len(candidates) < self.cache.num_free and self._queue:
@@ -436,6 +677,12 @@ class ServingEngine:
         kind = fault_point("serving.step")
         if kind == "skip":
             raise _SkipStep("injected skip of one decode iteration")
+        if self.paged:
+            fn = decode_step_paged(self.model)["fn"]
+            return fn(jnp.asarray(tokens),
+                      jnp.asarray(self.cache.lengths),
+                      jnp.asarray(self.cache.tables),
+                      self.cache.arrays())
         fn = decode_step(self.model)["fn"]
         return fn(jnp.asarray(tokens),
                   jnp.asarray(self.cache.lengths),
@@ -478,6 +725,12 @@ class ServingEngine:
         kind = fault_point("serving.step")
         if kind == "skip":
             raise _SkipStep("injected skip of one verify iteration")
+        if self.paged:
+            fn = verify_step_paged(self.model, self.spec_tokens)["fn"]
+            return fn(jnp.asarray(tokens),
+                      jnp.asarray(self.cache.lengths),
+                      jnp.asarray(self.cache.tables),
+                      self.cache.arrays())
         fn = verify_step(self.model, self.spec_tokens)["fn"]
         return fn(jnp.asarray(tokens),
                   jnp.asarray(self.cache.lengths),
@@ -597,6 +850,9 @@ class ServingEngine:
             admitted = self._admit()
             produced = (self._spec_decode() if self.spec_tokens
                         else self._decode())
+            if self.paged:
+                self._blocks_used_g.set(self.cache.blocks_used)
+                self._blocks_free_g.set(self.cache.blocks_free)
             return bool(admitted or produced)
 
     def stats(self) -> dict:
@@ -627,6 +883,27 @@ class ServingEngine:
             out["spec_acceptance_rate"] = (
                 round(self._spec_accepted / self._spec_proposed, 4)
                 if self._spec_proposed else None)
+        out["paged"] = self.paged
+        if self.paged:
+            c = self.cache
+            hit_t, miss_t = c.prefix_hits, c.prefix_misses
+            out.update({
+                "block_size": c.block_size,
+                "num_blocks": c.num_blocks,
+                "kv_blocks_used": c.blocks_used,
+                "kv_blocks_free": c.blocks_free,
+                "prefix_cache": c.prefix_cache_enabled,
+                "prefix_entries": c.prefix_entries,
+                # request-granular (an admission that reused >=1 block
+                # is a hit) and token-granular (prompt tokens whose KV
+                # came from the cache vs were prefilled)
+                "prefix_hit_requests": self._prefix_hit_reqs,
+                "prefix_miss_requests": self._prefix_miss_reqs,
+                "prefix_hit_tokens": hit_t,
+                "prefix_miss_tokens": miss_t,
+                "prefix_hit_rate": (round(hit_t / (hit_t + miss_t), 4)
+                                    if hit_t + miss_t else None),
+            })
         return out
 
     @property

@@ -20,6 +20,7 @@ minimal JSON generation protocol:
                              (serving counters/latency histograms,
                              fault counters, XLA compile tracking)
   GET  /health        -> 200 {"ok": true, "slots_free": n, "queued": n}
+                             (+ kv_blocks_free/used with paged KV)
 
 Like the KV rendezvous server, this is unauthenticated cluster-private
 HTTP; bind 127.0.0.1 (the default here) unless the network is trusted.
@@ -58,9 +59,13 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         engine: ServingEngine = self.server.engine
         if self.path == "/health":
-            self._json(200, {"ok": True,
-                             "slots_free": engine.cache.num_free,
-                             "queued": len(engine._queue)})
+            payload = {"ok": True,
+                       "slots_free": engine.cache.num_free,
+                       "queued": len(engine._queue)}
+            if engine.paged:
+                payload["kv_blocks_free"] = engine.cache.blocks_free
+                payload["kv_blocks_used"] = engine.cache.blocks_used
+            self._json(200, payload)
         elif self.path == "/v1/stats":
             payload = _monitor.stats_with_prefix("STAT_serving")
             payload.update(engine.stats())

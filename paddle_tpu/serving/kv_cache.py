@@ -1,31 +1,43 @@
-"""Slotted KV cache — the serving plane's memory manager.
+"""KV cache memory managers for the serving plane.
 
-One preallocated ``[max_slots, heads, max_len, head_dim]`` key/value
-pair per layer; each in-flight request owns one *slot* (a row on the
-batch axis) for its lifetime. Because the buffers never change shape,
-the batched decode step has a single signature and compiles exactly
-once; admitting or retiring a request is a row write / a bookkeeping
-update, never a recompile. This is the Orca/vLLM-style design point,
-simplified to slot granularity: a TPU wants one big dense batch axis,
-not paged blocks, and max_len-bounded rows make the position mask
-(ops.attention_ops.decode_attention_mask) the only "page table".
+Two designs live here:
 
-Slot lifecycle: ``alloc()`` (admission) -> ``write_prefill`` /
-``write_prefill_batch`` (the bucketed prompt pass populates the row
-and sets its valid length) -> per-step in-place row writes inside the
-compiled decode (``advance``: +1 per plain decode token, +K+1 per
-speculative verify) -> ``rollback`` of the rejected draft tail (the
-verify step writes K+1 rows optimistically; only the accepted prefix
-stays committed) -> ``release()`` (EOS/max-tokens) returns the slot
-for the next admission; stale row contents need no scrubbing — the
-position mask already excludes them, and the next write at the
-rolled-back offset overwrites them.
+- :class:`BlockKVCache` — the production design: a fixed pool of
+  ``[num_blocks, heads, block_size, head_dim]`` KV *blocks* per layer,
+  a per-request host-side block table mapping logical positions to
+  physical blocks (vLLM/PagedAttention-style), a ref-counted
+  :class:`BlockAllocator`, and a prefix cache keyed on a rolling hash
+  of the token prefix so a shared system prompt prefills once and its
+  blocks are *referenced* (copy-on-write at the boundary block) by
+  every subsequent request. A request pays ``ceil(need/block_size)``
+  blocks instead of a full ``max_len`` row, minus whatever prefix it
+  shares — the memory unlock for high-concurrency serving.
+
+- :class:`SlotKVCache` — the original dense design (one
+  ``[max_slots, heads, max_len, head_dim]`` pair per layer, one *slot*
+  row per request), kept as the ``paged=False`` fallback and the
+  benchmark baseline the paged cache is measured against.
+
+Both keep every buffer at a fixed shape so the batched decode step has
+a single signature and compiles exactly once; admitting or retiring a
+request is bookkeeping, never a recompile.
+
+Slot/row lifecycle (shared by both): allocate at admission -> the
+bucketed prompt pass populates KV rows and sets the valid length ->
+per-step in-place writes inside the compiled decode (``advance``: +1
+per plain decode token, +K+1 per speculative verify) -> ``rollback``
+of the rejected draft tail (the verify step writes K+1 rows
+optimistically; only the accepted prefix stays committed) -> release
+(EOS/max-tokens). Stale row contents need no scrubbing — the position
+mask already excludes them, and the next write at the rolled-back
+offset overwrites them.
 """
 
 from __future__ import annotations
 
 from bisect import insort
-from typing import List, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -130,4 +142,381 @@ class SlotKVCache:
 
     def set_arrays(self, layers):
         """Adopt the decode step's returned buffers."""
+        self.layers = [(k, v) for k, v in layers]
+
+
+class BlockAllocator:
+    """Ref-counted free-list allocator over a fixed pool of KV blocks.
+
+    Physical block ids are plain ints; the free list is kept sorted so
+    allocation order is a pure function of the alloc/free history —
+    the engine equivalence tests replay exact schedules and rely on
+    identical block assignment across runs. A block's refcount goes
+    above 1 only via the prefix cache (:meth:`ref` on a shared prefix
+    block); :meth:`deref` returns it to the free list when the count
+    drops to zero.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.refcount = np.zeros(num_blocks, np.int32)
+        self._free = list(range(num_blocks))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """Claim the lowest free block at refcount 1, or None if empty."""
+        if not self._free:
+            return None
+        blk = self._free.pop(0)
+        self.refcount[blk] = 1
+        return blk
+
+    def ref(self, blk: int):
+        """Take an additional reference on an allocated block."""
+        if self.refcount[blk] < 1:
+            raise ValueError(f"block {blk} is free; cannot ref")
+        self.refcount[blk] += 1
+
+    def deref(self, blk: int):
+        """Drop one reference; the block is reclaimed at zero."""
+        if self.refcount[blk] < 1:
+            raise ValueError(f"block {blk} is free; cannot deref")
+        self.refcount[blk] -= 1
+        if self.refcount[blk] == 0:
+            insort(self._free, blk)
+
+    def leaked(self) -> int:
+        """Blocks still referenced — for the chaos suite's leak check
+        (after every request releases, only permanent refs remain)."""
+        return int((self.refcount > 0).sum())
+
+
+class _PrefixEntry:
+    """One cached full block of a prompt prefix.
+
+    Chained: ``key`` is the rolling hash up to and including this
+    block's tokens, ``parent_block`` the physical block this entry
+    pinned when published (None for a chain head). ``tokens`` is kept
+    to verify against hash collisions before any reuse.
+    """
+
+    __slots__ = ("key", "parent_block", "block", "tokens")
+
+    def __init__(self, key, parent_block: Optional[int], block: int,
+                 tokens: Tuple[int, ...]):
+        self.key = key
+        self.parent_block = parent_block
+        self.block = block
+        self.tokens = tokens
+
+
+class BlockKVCache:
+    """Block-paged KV storage + ref-counted allocator + prefix cache.
+
+    Geometry: one ``[num_blocks, heads, block_size, head_dim]`` (k, v)
+    pair per layer; a request's logical positions ``[0, max_len)`` map
+    through its row of the host-side ``tables`` array (shape
+    ``[max_slots, blocks_per_row]``, np.int32) to physical blocks. The
+    tables ship into the compiled steps as a fixed-shape jit *input* —
+    remapping blocks never recompiles.
+
+    Physical block 0 is the **trash block**: allocated permanently at
+    init, it backs every unassigned table entry and absorbs the
+    compiled steps' out-of-range writes (ops.attention_ops routes
+    overflow there rather than letting XLA's index clamping corrupt a
+    real block). Its contents are garbage by design and the position
+    mask guarantees no request ever attends to a row it didn't write
+    through its own table.
+
+    Prefix cache: full prompt blocks are published under a rolling
+    hash of the token prefix (``hash((parent_key, chunk))`` per
+    block). ``acquire`` walks the chain for the longest cached prefix,
+    refs the matched blocks instead of re-prefilling them, and
+    privatizes the boundary block (copy-on-write) when the shared
+    length isn't block-aligned — the suffix prefill would otherwise
+    write into a block other requests read. Entries idle at
+    refcount 1 (cache-only) are evicted LRU when the pool runs dry.
+
+    The row-level API mirrors :class:`SlotKVCache` (``lengths``,
+    ``advance``/``rollback``, ``arrays``/``set_arrays``,
+    ``num_free``/``num_used`` count *rows*) so the engine and the
+    chaos suite treat both interchangeably; block-level accounting is
+    exposed via ``blocks_free``/``blocks_used``.
+    """
+
+    TRASH = 0  # physical block 0: permanent ref, padding + overflow sink
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 max_slots: int, max_len: int, block_size: int = 16,
+                 num_blocks: int = 0, prefix_cache: bool = True,
+                 dtype=None):
+        import jax.numpy as jnp
+        dtype = dtype or jnp.float32
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.block_size = int(block_size)
+        self.blocks_per_row = -(-self.max_len // self.block_size)
+        if num_blocks <= 0:
+            # worst case every slot is full-length, +1 for the trash block
+            num_blocks = self.max_slots * self.blocks_per_row + 1
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={num_blocks} leaves no usable block after "
+                f"reserving the trash block")
+        self.num_blocks = int(num_blocks)
+        shape = (self.num_blocks, num_heads, self.block_size, head_dim)
+        self.layers: List[Tuple[jax.Array, jax.Array]] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)]
+        self.allocator = BlockAllocator(self.num_blocks)
+        trash = self.allocator.alloc()
+        assert trash == self.TRASH
+        self.tables = np.full((self.max_slots, self.blocks_per_row),
+                              self.TRASH, np.int32)
+        self.lengths = np.zeros(self.max_slots, np.int32)
+        self._nblocks = np.zeros(self.max_slots, np.int32)  # owned per row
+        self._free_rows = list(range(self.max_slots))
+        self.prefix_cache_enabled = bool(prefix_cache)
+        # key -> _PrefixEntry, move_to_end on touch => LRU eviction order
+        self._prefix: "OrderedDict[int, _PrefixEntry]" = OrderedDict()
+        self.prefix_hits = 0       # token-weighted: shared tokens reused
+        self.prefix_misses = 0     # prompt tokens prefilled from scratch
+        self.blocks_allocated_total = 0  # fresh allocs (bench: bytes/request)
+
+    # -- geometry ----------------------------------------------------
+
+    def blocks_needed(self, length: int) -> int:
+        return -(-int(length) // self.block_size)
+
+    @property
+    def blocks_free(self) -> int:
+        return self.allocator.num_free
+
+    @property
+    def blocks_used(self) -> int:
+        return self.allocator.num_used
+
+    # row-level view, API-compatible with SlotKVCache
+    @property
+    def num_free(self) -> int:
+        return len(self._free_rows)
+
+    @property
+    def num_used(self) -> int:
+        return self.max_slots - len(self._free_rows)
+
+    # -- allocation --------------------------------------------------
+
+    def _alloc_block(self) -> Optional[int]:
+        """Fresh block, evicting idle prefix-cache entries if needed."""
+        blk = self.allocator.alloc()
+        while blk is None and self._evict_one_prefix():
+            blk = self.allocator.alloc()
+        return blk
+
+    def _drop_entry(self, ent: _PrefixEntry):
+        del self._prefix[ent.key]
+        self.allocator.deref(ent.block)
+        if ent.parent_block is not None:
+            self.allocator.deref(ent.parent_block)
+
+    def _evict_one_prefix(self) -> bool:
+        """Drop the least-recently-used cache-only prefix entry.
+
+        Only entries whose block sits at refcount 1 (held solely by the
+        cache) are evictable; entries a live request still references
+        are skipped. A chain parent carries a pin from each cached
+        child, so eviction proceeds leaf-first regardless of LRU order.
+        """
+        for key in list(self._prefix):
+            ent = self._prefix[key]
+            if self.allocator.refcount[ent.block] == 1:
+                self._drop_entry(ent)
+                return True
+        return False
+
+    def _match_prefix(self, prompt: Sequence[int]) -> List[_PrefixEntry]:
+        """Longest chain of cached full blocks covering the prompt."""
+        if not self.prefix_cache_enabled:
+            return []
+        bs = self.block_size
+        matched: List[_PrefixEntry] = []
+        key = None
+        for i in range(len(prompt) // bs):
+            chunk = tuple(prompt[i * bs:(i + 1) * bs])
+            key = hash((key, chunk))
+            ent = self._prefix.get(key)
+            if ent is None or ent.tokens != chunk:
+                break
+            matched.append(ent)
+        return matched
+
+    def acquire(self, prompt: Sequence[int],
+                need: int) -> Optional[Tuple[int, int]]:
+        """Admit a request: reserve a row plus blocks for ``need``
+        logical positions (prompt + worst-case generation), reusing
+        cached prefix blocks where possible.
+
+        Returns ``(row, shared_tokens)`` — ``shared_tokens`` prompt
+        positions already hold valid KV and the prefill may skip them
+        (always < len(prompt): the last prompt token is recomputed for
+        its logits) — or None when rows or blocks run out. All-or-
+        nothing: on block exhaustion every ref/alloc taken is unwound
+        so a shed admission leaks nothing.
+        """
+        if need > self.max_len:
+            raise ValueError(
+                f"request needs {need} positions > max_len={self.max_len}")
+        if not self._free_rows:
+            return None
+        nblocks = self.blocks_needed(need)
+        matched = self._match_prefix(prompt)
+        # cap shared coverage: the final prompt token's logits seed
+        # generation, so at least one position must run through prefill
+        shared = min(len(matched) * self.block_size, len(prompt) - 1)
+        nshared = shared // self.block_size  # fully reusable blocks
+        taken: List[int] = []   # fresh allocs to unwind on failure
+        reffed: List[int] = []  # prefix refs to unwind on failure
+        blocks: List[int] = []
+        for ent in matched[:nshared]:
+            self.allocator.ref(ent.block)
+            self._prefix.move_to_end(ent.key)
+            reffed.append(ent.block)
+            blocks.append(ent.block)
+        cow = shared % self.block_size != 0
+        for _ in range(nblocks - nshared):
+            blk = self._alloc_block()
+            if blk is None:
+                for b in taken:
+                    self.allocator.deref(b)
+                for b in reffed:
+                    self.allocator.deref(b)
+                return None
+            taken.append(blk)
+            blocks.append(blk)
+        if cow:
+            # boundary block is partially shared: copy the cached
+            # block's rows into the freshly allocated private block so
+            # the suffix prefill can write the remainder in place
+            src = matched[nshared].block
+            dst = blocks[nshared]
+            self.layers = [
+                (k.at[dst].set(k[src]), v.at[dst].set(v[src]))
+                for k, v in self.layers]
+        row = self._free_rows.pop(0)
+        # counted here, not in _alloc_block: a failed acquire unwinds
+        # its allocs, and those must not inflate the bytes/request bench
+        self.blocks_allocated_total += len(taken)
+        self.tables[row] = self.TRASH
+        self.tables[row, :nblocks] = blocks
+        self._nblocks[row] = nblocks
+        self.lengths[row] = 0
+        if shared:
+            self.prefix_hits += shared
+            self.prefix_misses += len(prompt) - shared
+        else:
+            self.prefix_misses += len(prompt)
+        return row, shared
+
+    def release_row(self, row: int):
+        """Retire a request: deref every block its table row owns."""
+        n = int(self._nblocks[row])
+        for blk in self.tables[row, :n]:
+            self.allocator.deref(int(blk))
+        self.tables[row] = self.TRASH
+        self._nblocks[row] = 0
+        self.lengths[row] = 0
+        insort(self._free_rows, row)
+
+    # SlotKVCache-compatible aliases (engine + chaos suite call these)
+    def release(self, row: int):
+        self.release_row(row)
+
+    def insert_prefix(self, row: int, prompt: Sequence[int]):
+        """Publish a just-prefilled prompt's full blocks into the
+        prefix cache so later requests can reference them. Blocks
+        gain a cache ref; entries already present are just touched."""
+        if not self.prefix_cache_enabled:
+            return
+        bs = self.block_size
+        key = None
+        for i in range(len(prompt) // bs):
+            chunk = tuple(prompt[i * bs:(i + 1) * bs])
+            parent = key
+            key = hash((key, chunk))
+            ent = self._prefix.get(key)
+            if ent is not None:
+                if ent.tokens != chunk:
+                    break  # hash collision: leave the incumbent alone
+                self._prefix.move_to_end(key)
+                continue
+            blk = int(self.tables[row, i])
+            if blk == self.TRASH:
+                break
+            self.allocator.ref(blk)
+            pin = None
+            if parent is not None and parent in self._prefix:
+                # children pin their parent so chains evict leaf-first
+                pin = self._prefix[parent].block
+                self.allocator.ref(pin)
+            self._prefix[key] = _PrefixEntry(key, pin, blk, chunk)
+
+    def flush_prefix_cache(self):
+        """Drop every cached prefix ref (tests / memory pressure).
+        Live requests keep their own refs; only cache refs drop."""
+        for key in list(self._prefix):
+            self._drop_entry(self._prefix[key])
+
+    @property
+    def prefix_entries(self) -> int:
+        return len(self._prefix)
+
+    # -- per-step bookkeeping (same contract as SlotKVCache) ---------
+
+    def commit_prefill(self, row: int, length: int):
+        """The prompt pass populated this row's blocks up to
+        ``length`` (via the compiled step's table-routed writes)."""
+        if length > int(self._nblocks[row]) * self.block_size:
+            raise ValueError(
+                f"row {row}: prefill length {length} exceeds reserved "
+                f"blocks ({self._nblocks[row]} x {self.block_size})")
+        self.lengths[row] = int(length)
+
+    def advance(self, row: int, n: int = 1):
+        ln = int(self.lengths[row]) + int(n)
+        if ln > int(self._nblocks[row]) * self.block_size:
+            raise ValueError(
+                f"row {row}: advancing by {n} overflows reserved blocks "
+                f"({self._nblocks[row]} x {self.block_size} rows, at "
+                f"{self.lengths[row]})")
+        self.lengths[row] = ln
+
+    def rollback(self, row: int, n: int):
+        """Rewind over ``n`` rejected speculative rows. Blocks stay
+        reserved (worst-case reservation at admission), so a rollback
+        across a block boundary is pure length arithmetic — the stale
+        rows sit past the valid length behind the position mask."""
+        if n < 0 or n > int(self.lengths[row]):
+            raise ValueError(
+                f"row {row}: cannot roll back {n} rows from length "
+                f"{self.lengths[row]}")
+        self.lengths[row] = int(self.lengths[row]) - int(n)
+
+    def arrays(self):
+        """The per-layer (k, v) block pools, as fed to the steps."""
+        return list(self.layers)
+
+    def set_arrays(self, layers):
+        """Adopt a compiled step's returned pools."""
         self.layers = [(k, v) for k, v in layers]

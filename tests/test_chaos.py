@@ -435,3 +435,67 @@ def test_serving_submit_fault_rejects_before_queue(_serving_model):
         later = eng.submit([6, 7], max_new_tokens=2)  # call 1: clean
         eng.run_until_idle()
     assert ok.state == "done" and later.state == "done"
+
+
+# -- paged KV allocator under injected faults ---------------------------
+
+def test_serving_alloc_skip_sheds_request_not_engine(_serving_model):
+    """An injected allocator failure (`skip`) sheds exactly the request
+    whose acquisition failed; the one behind it completes, and no block
+    leaks — after drain + prefix flush only the trash block holds a
+    ref."""
+    from paddle_tpu.models.generation import greedy_search
+    with fault_scope("serving.alloc:skip@0"):
+        eng = _serving_engine(_serving_model)
+        assert eng.paged
+        reqs = [eng.submit([1, 2, 3], max_new_tokens=3),
+                eng.submit([4, 5], max_new_tokens=3)]
+        eng.run_until_idle()
+        assert reqs[0].state == "shed" and reqs[0].error is not None
+        assert reqs[1].state == "done" and len(reqs[1].tokens) == 3
+        assert monitor.stat_get("STAT_fault_serving.alloc") == 1
+        assert monitor.stat_get("STAT_serving_shed") == 1
+        ref = greedy_search(_serving_model, np.asarray([[4, 5]]),
+                            max_new_tokens=3,
+                            cache_len=eng.max_len)[0].tolist()
+        assert reqs[1].output_ids == ref
+    eng.cache.flush_prefix_cache()
+    assert eng.cache.allocator.leaked() == 1  # the trash block
+
+
+def test_serving_alloc_drop_is_retried(_serving_model):
+    """A transient allocator drop retries through RetryPolicy and the
+    request still completes with the exact fault-free tokens."""
+    from paddle_tpu.models.generation import greedy_search
+    with fault_scope("serving.alloc:drop@0"):
+        eng = _serving_engine(_serving_model)
+        req = eng.submit([1, 2, 3, 4], max_new_tokens=4)
+        eng.run_until_idle()
+        assert req.state == "done"
+        assert monitor.stat_get("STAT_fault_serving.alloc") == 1
+        assert monitor.stat_get("STAT_retry_serving.alloc") >= 1
+        ref = greedy_search(_serving_model, np.asarray([[1, 2, 3, 4]]),
+                            max_new_tokens=4,
+                            cache_len=eng.max_len)[0].tolist()
+        assert req.output_ids == ref
+    eng.cache.flush_prefix_cache()
+    assert eng.cache.allocator.leaked() == 1
+
+
+def test_serving_alloc_persistent_fault_no_block_leak(_serving_model):
+    """Retry exhaustion on the allocator sheds the requests but leaves
+    the pool intact: zero leaked blocks, and the next fault-free
+    submission completes."""
+    pt.set_flags({"retry_max_attempts": 2})
+    eng = _serving_engine(_serving_model)
+    with fault_scope("serving.alloc:drop"):
+        reqs = [eng.submit([1, 2], max_new_tokens=3),
+                eng.submit([3, 4], max_new_tokens=3)]
+        eng.run_until_idle()
+        assert [r.state for r in reqs] == ["shed", "shed"]
+        assert eng.cache.blocks_used == 1  # trash only: nothing leaked
+    req = eng.submit([5, 6], max_new_tokens=3)
+    eng.run_until_idle()
+    assert req.state == "done" and len(req.tokens) == 3
+    eng.cache.flush_prefix_cache()
+    assert eng.cache.allocator.leaked() == 1

@@ -272,6 +272,23 @@ def test_saver_sweeps_orphaned_tmp_dirs(tmp_path):
     assert s._numbers() == []
 
 
+def test_saver_sweep_spares_live_writers_tmp(tmp_path):
+    """Init-time sweep must not clobber a PEER rank's in-flight save
+    (elastic restarts spawn ranks staggered, so one rank can init its
+    saver while another is mid-publish): pid-tagged tmp dirs are swept
+    only when their writer is dead."""
+    d = tmp_path / "ck"
+    live = d / f"5.tmp.{os.getpid()}"   # live writer: this process
+    live.mkdir(parents=True)
+    (d / "4.tmp.999999999").mkdir(parents=True)   # writer long dead
+    (d / "3.tmp").mkdir(parents=True)             # legacy orphan
+    CheckpointSaver(str(tmp_path), "ck")
+    assert live.exists()
+    assert not (d / "4.tmp.999999999").exists()
+    assert not (d / "3.tmp").exists()
+    assert monitor.stat_get("STAT_ckpt_tmp_swept") == 2
+
+
 def test_load_falls_back_past_corrupt_checkpoint(tmp_path):
     s = CheckpointSaver(str(tmp_path), "ck", max_num=5)
     s.save({"w": np.full(2, 1.0)}, 1)

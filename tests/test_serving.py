@@ -17,10 +17,12 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import monitor
-from paddle_tpu.models.generation import (decode_step, draft_ngram,
-                                          greedy_search, verify_step)
+from paddle_tpu.models.generation import (decode_step, decode_step_paged,
+                                          draft_ngram, greedy_search,
+                                          verify_step, verify_step_paged)
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
-from paddle_tpu.serving import (QueueFullError, ServingEngine,
+from paddle_tpu.serving import (BlockAllocator, BlockKVCache,
+                                QueueFullError, ServingEngine,
                                 ServingHTTPServer, SlotKVCache)
 
 
@@ -59,14 +61,17 @@ def test_engine_matches_sequential_greedy(model):
 
 def test_decode_compiles_once_prefill_once_per_bucket(model):
     """The compile-reuse contract: across many requests of many lengths,
-    decode traces exactly once and each prefill bucket exactly once."""
-    before = decode_step(model)["traces"]["count"]
+    decode traces exactly once and each prefill bucket exactly once
+    (the engine runs the paged steps by default — block remapping,
+    prefix sharing and COW must never retrace)."""
+    before = decode_step_paged(model)["traces"]["count"]
     eng = ServingEngine(model, max_slots=3, max_len=32,
                         buckets=[4, 8, 16], max_queue=32)
+    assert eng.paged
     for p in _prompts((2, 3, 4, 6, 7, 9, 13, 15), seed=1):
         eng.submit(p, max_new_tokens=4)
     eng.run_until_idle()
-    assert decode_step(model)["traces"]["count"] - before == 1
+    assert decode_step_paged(model)["traces"]["count"] - before == 1
     used = {b: e["traces"]["count"] for b, e in eng._prefill_fns.items()}
     assert used == {4: 1, 8: 1, 16: 1}
 
@@ -222,15 +227,16 @@ def test_spec_verify_compiles_once(model):
     for the engine's K, decode is never traced (the verify step IS the
     decode), and prefill still compiles once per bucket."""
     k = 4
-    before_v = verify_step(model, k)["traces"]["count"]
-    before_d = decode_step(model)["traces"]["count"]
+    before_v = verify_step_paged(model, k)["traces"]["count"]
+    before_d = decode_step_paged(model)["traces"]["count"]
     eng = ServingEngine(model, max_slots=3, max_len=32,
                         buckets=[4, 8, 16], max_queue=32, spec_tokens=k)
+    assert eng.paged
     for p in _prompts((2, 3, 4, 6, 7, 9, 13, 15), seed=7):
         eng.submit(p, max_new_tokens=4)
     eng.run_until_idle()
-    assert verify_step(model, k)["traces"]["count"] - before_v == 1
-    assert decode_step(model)["traces"]["count"] - before_d == 0
+    assert verify_step_paged(model, k)["traces"]["count"] - before_v == 1
+    assert decode_step_paged(model)["traces"]["count"] - before_d == 0
     used = {b: e["traces"]["count"] for b, e in eng._prefill_fns.items()}
     assert used == {4: 1, 8: 1, 16: 1}
 
@@ -423,6 +429,262 @@ def test_http_429_retry_after_and_stats_surface(model):
         for key in ("ttft_p50_ms", "tpot_p99_ms", "latency_samples",
                     "spec_tokens"):
             assert key in stats
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- block-paged KV cache ------------------------------------------------
+
+def test_block_allocator_exhaustion_and_reclaim():
+    """Free-list exhaustion returns None; refcounts reclaim on the
+    drop to zero; assignment order is deterministic (lowest id first)."""
+    a = BlockAllocator(4)
+    got = [a.alloc() for _ in range(4)]
+    assert got == [0, 1, 2, 3]            # deterministic, sorted
+    assert a.alloc() is None              # exhausted
+    a.ref(2)                              # prefix-style second holder
+    a.deref(2)
+    assert a.num_free == 0                # still held once
+    a.deref(2)
+    assert a.num_free == 1 and a.alloc() == 2   # reclaimed, reused
+    with pytest.raises(ValueError):
+        a.deref(1) or a.deref(1) or a.deref(1)  # double-free guarded
+    a2 = BlockAllocator(4)
+    assert [a2.alloc() for _ in range(4)] == got   # replayed schedule
+
+
+def test_block_kv_cache_acquire_release_accounting():
+    """Row + block accounting round-trips: acquire reserves
+    ceil(need/bs) blocks, release returns every one, nothing leaks
+    but the trash block."""
+    c = BlockKVCache(num_layers=1, num_heads=2, head_dim=4, max_slots=2,
+                     max_len=16, block_size=4, prefix_cache=False)
+    assert c.blocks_used == 1             # the trash block
+    row, shared = c.acquire([1, 2, 3], need=9)   # 3 blocks
+    assert shared == 0 and c.blocks_used == 4
+    assert c.tables[row, :3].tolist() != [c.TRASH] * 3
+    assert c.tables[row, 3] == c.TRASH    # unreserved tail stays trash
+    c.release_row(row)
+    assert c.blocks_used == 1 and c.allocator.leaked() == 1
+    # all-or-nothing: a request too big for the remaining pool takes
+    # nothing (2 rows x 4 blocks needs 8, pool has 8 free after trash)
+    r1 = c.acquire(list(range(1, 14)), need=16)   # 4 blocks
+    r2 = c.acquire(list(range(1, 14)), need=16)   # 4 more
+    assert r1 and r2 and c.blocks_free == 0
+    assert c.acquire([1], need=1) is None         # no row AND no block
+    c.release_row(r1[0])
+    assert c.blocks_free == 4                     # exact unwind
+
+
+def test_block_kv_prefix_hit_and_cow():
+    """A republished prompt is matched block-for-block; a prompt whose
+    shared coverage ends mid-block privatizes the boundary block
+    (copy-on-write) so the original's rows stay intact."""
+    import jax.numpy as jnp
+    c = BlockKVCache(num_layers=1, num_heads=1, head_dim=2, max_slots=2,
+                     max_len=16, block_size=4)
+    prompt = list(range(10, 19))               # 9 tokens: 2 full blocks
+    row, shared = c.acquire(prompt, need=12)
+    assert shared == 0
+    # fake a prefill: mark valid rows, publish the full blocks
+    k, v = c.arrays()[0]
+    k = k.at[c.tables[row, 0]].set(1.0).at[c.tables[row, 1]].set(2.0)
+    c.set_arrays([(k, v)])
+    c.commit_prefill(row, len(prompt))
+    c.insert_prefix(row, prompt)
+    assert c.prefix_entries == 2
+    # same prompt again: both full blocks reused, last token recomputed
+    row2, shared2 = c.acquire(prompt, need=12)
+    assert shared2 == 8
+    assert c.tables[row2, :2].tolist() == c.tables[row, :2].tolist()
+    assert c.prefix_hits == 8 and c.prefix_misses >= 9
+    c.release_row(row2)
+    # prompt sharing exactly 2 blocks then diverging BUT only 8 tokens
+    # long: shared caps at len-1=7 -> boundary block 1 is partially
+    # shared -> COW: row3 gets a PRIVATE copy of block 1's rows
+    p3 = prompt[:8]
+    row3, shared3 = c.acquire(p3, need=12)
+    assert shared3 == 7
+    assert c.tables[row3, 0] == c.tables[row, 0]       # full block shared
+    assert c.tables[row3, 1] != c.tables[row, 1]       # boundary is COW
+    k3 = c.arrays()[0][0]
+    assert jnp.array_equal(k3[c.tables[row3, 1]], k3[c.tables[row, 1]])
+    c.release_row(row)
+    c.release_row(row3)
+
+
+def test_block_kv_prefix_eviction_under_pressure():
+    """Idle prefix entries are evicted LRU to satisfy new allocations;
+    entries still referenced by a live row survive."""
+    c = BlockKVCache(num_layers=1, num_heads=1, head_dim=2, max_slots=3,
+                     max_len=16, block_size=4, num_blocks=4)
+    pa = [1] * 4
+    ra, _ = c.acquire(pa, need=8)          # 2 blocks
+    c.commit_prefill(ra, 4)
+    c.insert_prefix(ra, pa)                # 1 cached block
+    c.release_row(ra)                      # now cache-only
+    assert c.prefix_entries == 1 and c.blocks_free == 2
+    rb, _ = c.acquire([2] * 6, need=12)    # needs 3 blocks: evicts a's
+    assert rb is not None
+    assert c.prefix_entries == 0 and c.blocks_free == 0
+    c.release_row(rb)
+    assert c.allocator.leaked() == 1       # only the trash block
+
+
+def test_block_kv_rollback_across_block_boundary():
+    """Speculative rollback that crosses a block boundary is pure
+    length arithmetic: blocks stay reserved, re-advance reuses them."""
+    c = BlockKVCache(num_layers=1, num_heads=2, head_dim=4, max_slots=1,
+                     max_len=16, block_size=4, prefix_cache=False)
+    row, _ = c.acquire([1, 2, 3], need=12)
+    c.commit_prefill(row, 3)
+    c.advance(row, 4)                      # verify commit: 3 -> 7
+    assert c.lengths[row] == 7             # spans blocks 0 and 1
+    used = c.blocks_used
+    c.rollback(row, 3)                     # back to 4: crosses boundary
+    assert c.lengths[row] == 4 and c.blocks_used == used
+    c.advance(row, 8)                      # 4 -> 12: fills reservation
+    with pytest.raises(ValueError):
+        c.advance(row, 1)                  # beyond reserved blocks
+    with pytest.raises(ValueError):
+        c.rollback(row, 13)
+
+
+def test_block_assignment_deterministic_replay():
+    """The same submit/retire schedule maps requests to identical
+    physical blocks on replay — the equivalence tests and the chaos
+    suite's seeded specs rely on this."""
+    def run():
+        c = BlockKVCache(num_layers=1, num_heads=1, head_dim=2,
+                         max_slots=2, max_len=16, block_size=4)
+        log = []
+        r1, _ = c.acquire([1, 2, 3, 4, 5], need=8)
+        r2, _ = c.acquire([9, 8, 7], need=12)
+        log.append(c.tables.copy())
+        c.release_row(r1)
+        r3, _ = c.acquire([5, 5], need=8)
+        log.append(c.tables.copy())
+        return log
+    a, b = run(), run()
+    for ta, tb in zip(a, b):
+        assert np.array_equal(ta, tb)
+
+
+def test_paged_engine_matches_greedy_without_prefix_cache(model):
+    """The paged oracle holds with prefix caching disabled (every
+    prompt prefills from scratch through the block tables)."""
+    prompts = _prompts((3, 7, 5, 11, 4), seed=11)
+    eng = ServingEngine(model, max_slots=2, max_len=32,
+                        buckets=[4, 8, 16], paged=True, block_size=4,
+                        prefix_cache=False)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    assert eng.cache.prefix_hits == 0
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=6,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref
+
+
+def test_dense_engine_still_matches_greedy(model):
+    """paged=False keeps the original SlotKVCache path working (the
+    bench baseline)."""
+    prompts = _prompts((3, 7, 5), seed=12)
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8, 16],
+                        paged=False)
+    assert isinstance(eng.cache, SlotKVCache)
+    reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=5,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref
+
+
+def test_paged_prefix_reuse_is_exact_and_counted(model):
+    """A shared system prompt prefills once; later requests reference
+    its blocks and still match sequential greedy token for token, and
+    the hit shows up in stats() + STAT_serving_prefix_hits."""
+    monitor.reset()
+    system = _prompts((12,), seed=13)[0]       # 3 full blocks at bs=4
+    tails = _prompts((3, 5, 2), seed=14)
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8, 16],
+                        paged=True, block_size=4)
+    r0 = eng.submit(system, max_new_tokens=4)
+    eng.run_until_idle()                       # publishes the prefix
+    reqs = [eng.submit(system + t, max_new_tokens=4) for t in tails]
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["prefix_hit_requests"] == 3
+    assert st["prefix_hit_tokens"] >= 3 * 8    # >=2 full blocks each
+    assert monitor.stat_get("STAT_serving_prefix_hits") == 3
+    for p, r in zip([system] + [system + t for t in tails],
+                    [r0] + reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=4,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref, "prefix reuse changed tokens"
+
+
+def test_paged_pool_exhaustion_blocks_head_of_line_then_completes(model):
+    """An undersized block pool stalls admission head-of-line (FIFO
+    preserved) until retirements free blocks; every request still
+    completes and matches greedy."""
+    prompts = _prompts((6, 6, 6, 6), seed=15)
+    # each request needs ceil((6+4)/4)=3 blocks; pool of 7 usable
+    # blocks fits two in flight, so admission must wait for releases
+    eng = ServingEngine(model, max_slots=4, max_len=32, buckets=[8],
+                        paged=True, block_size=4, num_blocks=8,
+                        prefix_cache=False)
+    reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=4,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref
+    # drained: only the trash block may stay referenced
+    assert eng.cache.allocator.leaked() == 1
+    # a request that can NEVER fit the pool is a geometry error
+    with pytest.raises(ValueError):
+        eng.submit(list(range(1, 26)), max_new_tokens=4)  # 8 blocks > 7
+
+
+def test_paged_spec_rollback_across_block_boundary_matches_greedy(model):
+    """Speculation with K+1 spanning block boundaries: rejected draft
+    rows land in a later block and must be invisible after rollback."""
+    # repetitive prompts -> high acceptance -> commits cross the bs=2
+    # boundary every verify; mixed with a random prompt for rejections
+    prompts = [[5, 9] * 4, _prompts((7,), seed=16)[0], [3, 3, 3, 3]]
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8, 16],
+                        paged=True, block_size=2, spec_tokens=3)
+    reqs = [eng.submit(p, max_new_tokens=9) for p in prompts]
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=9,
+                            cache_len=eng.max_len)[0].tolist()
+        assert r.output_ids == ref, "spec rollback corrupted a block"
+    assert eng.stats()["spec_accepted"] > 0   # boundary was exercised
+
+
+def test_paged_health_and_stats_surface(model):
+    """GET /health exposes block headroom; stats() carries the paged
+    block/prefix keys."""
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8],
+                        paged=True, block_size=4)
+    srv = ServingHTTPServer(eng, port=0)
+    srv.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+        c.request("GET", "/health")
+        h = json.loads(c.getresponse().read())
+        assert h["kv_blocks_free"] + h["kv_blocks_used"] == \
+            eng.cache.num_blocks
+        c.request("GET", "/v1/stats")
+        st = json.loads(c.getresponse().read())
+        for key in ("kv_blocks_used", "kv_blocks_free", "block_size",
+                    "prefix_hit_rate"):
+            assert key in st
         c.close()
     finally:
         srv.stop()

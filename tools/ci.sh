@@ -9,8 +9,11 @@
 #   3. full test suite on the virtual 8-device CPU mesh
 #   4. chaos suite (deterministic fault injection: retry/skip/rollback
 #      recovery paths under FLAGS_fault_spec-driven failures)
-#   5. serving plane (continuous-batching engine == sequential decode,
-#      compile-count budget, queue backpressure; reduced in quick mode)
+#   5. serving plane (continuous-batching engine == sequential decode
+#      over the paged KV cache — block tables, prefix reuse and COW
+#      token-identical with AND without the prefix cache, compile-count
+#      budget re-asserted on the paged step names, queue backpressure,
+#      block-pool exhaustion head-of-line; reduced in quick mode)
 #   6. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
@@ -63,12 +66,16 @@ else
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/11 serving plane"
+  echo "== 5/11 serving plane (incl. paged-KV equivalence)"
+  # the full file carries the paged oracle: engine output token-identical
+  # to sequential greedy with the prefix cache on AND off, plus the
+  # dense paged=False baseline and the paged compile-count pins
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 else
   echo "== 5/11 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
-    -k "matches_sequential or queue_full or slot_kv"
+    -k "matches_sequential or queue_full or slot_kv or block_allocator \
+or paged_engine_matches or dense_engine_still or prefix_reuse"
 fi
 
 echo "== 6/11 speculative decoding gate"
@@ -82,8 +89,10 @@ fi
 
 echo "== 7/11 observability gate"
 # tiny train + serving smoke under the run log: /metrics parses as
-# Prometheus text, compile tracker pins decode_step==1 compile and
-# one batched prefill dispatch, JSONL events feed trace_summary
+# Prometheus text (incl. KV block-pool gauges), compile tracker pins
+# decode_step_paged==1 compile and one batched prefill dispatch, a
+# repeated prompt scores a prefix-cache hit, JSONL events feed
+# trace_summary
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 echo "== 8/11 op coverage gate"

@@ -5,11 +5,13 @@ Asserts, end to end through the observability plane:
   - a guarded training run (with one injected-NaN batch) emits
     train_step / guardian_skip / fault_injected run-log events;
   - a serving run emits serving_admit / serving_finish events;
-  - the compile tracker reports decode_step compile-count == 1 and the
-    batched same-bucket prefill dispatched exactly once (the PR 3/4
-    invariants, regression-locked via the new plane);
+  - the compile tracker reports decode_step_paged compile-count == 1
+    and the batched same-bucket paged prefill dispatched exactly once
+    (the PR 3/4 invariants, regression-locked via the new plane);
+  - a repeated prompt scores a prefix-cache hit (STAT_serving_prefix_hits)
+    without adding a single compile;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
-    carries serving, fault, and compile metrics;
+    carries serving, fault, compile, and KV block-pool metrics;
   - tools/trace_summary.py consumes the emitted JSONL run log.
 
 Run from the repo root:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
@@ -81,7 +83,7 @@ def main() -> int:
     model = GPTForCausalLM(cfg)
     model.eval()
     eng = ServingEngine(model, max_slots=3, max_len=32,
-                        buckets=[8, 16], max_queue=16)
+                        buckets=[8, 16], max_queue=16, block_size=4)
     prompts = [rng.randint(1, 97, size=n).tolist() for n in (3, 5, 7)]
     reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
     eng.step()
@@ -92,11 +94,25 @@ def main() -> int:
     assert all(r.state == "done" for r in reqs)
 
     comp = observability.compiles()
-    assert comp["decode_step"]["count"] == 1, comp.get("decode_step")
-    assert comp["serving_prefill{bucket=8}"]["count"] == 1, comp
-    assert comp["decode_step"]["last_signature"], "no compile signature"
-    print(f"   compile tracker: decode_step=1, prefill{{bucket=8}}=1 "
-          f"({len(comp)} tracked sites)")
+    assert comp["decode_step_paged"]["count"] == 1, \
+        comp.get("decode_step_paged")
+    assert comp["serving_prefill_paged{bucket=8}"]["count"] == 1, comp
+    assert comp["decode_step_paged"]["last_signature"], \
+        "no compile signature"
+    print(f"   compile tracker: decode_step_paged=1, "
+          f"prefill_paged{{bucket=8}}=1 ({len(comp)} tracked sites)")
+
+    # -- prefix-cache reuse: repeat a prompt, expect a hit -------------
+    rep = eng.submit(prompts[2], max_new_tokens=4)
+    eng.run_until_idle()
+    assert rep.state == "done" and rep.output_ids == reqs[2].output_ids
+    hits = monitor.stat_get("STAT_serving_prefix_hits")
+    assert hits >= 1, f"repeated prompt scored no prefix hit ({hits})"
+    comp2 = observability.compiles()
+    assert comp2["decode_step_paged"]["count"] == 1, \
+        "prefix reuse must not retrace decode"
+    print(f"   prefix cache: repeat hit ({hits} hit admissions), "
+          f"0 new compiles")
 
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
@@ -112,7 +128,8 @@ def main() -> int:
     n = observability.validate_prometheus_text(text)
     for needle in ("STAT_serving_tokens", "STAT_fault_exec_step",
                    "STAT_guardian_skipped", "xla_compiles",
-                   "serving_ttft_seconds"):
+                   "serving_ttft_seconds", "serving_kv_blocks_used",
+                   "serving_kv_blocks_free", "STAT_serving_prefix_hits"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 

@@ -253,7 +253,20 @@ def render_serving_block():
         "free KV-cache slots (prefill padded to a length bucket, one",
         "compile per bucket — and all same-bucket admissions in a step",
         "share ONE dispatch of that compile) and runs one batched",
-        "decode over every occupied slot (one compile, total). With",
+        "decode over every occupied slot (one compile, total). KV",
+        "memory is block-paged by default (`FLAGS_serving_paged`): a",
+        "fixed pool of `[num_blocks, heads, block_size, head_dim]` KV",
+        "blocks per layer, host-side per-request block tables fed to",
+        "the jitted steps as plain inputs (block remapping never",
+        "retraces), a ref-counted allocator, and a rolling-hash prefix",
+        "cache — a shared system prompt prefills once and later",
+        "requests reference its full blocks (copy-on-write at the",
+        "boundary block), prefilling only their unshared suffix.",
+        "Physical block 0 is a permanently-allocated trash block that",
+        "backs table padding and absorbs overflow writes. Pool",
+        "exhaustion holds the head-of-line request (FIFO order is part",
+        "of the equivalence oracle) until retirements free blocks;",
+        "`paged=False` falls back to the dense per-slot rows. With",
         "`FLAGS_serving_spec_tokens` = K > 0 the decode becomes",
         "draft–verify speculative decoding: an n-gram self-drafter",
         "proposes K tokens per slot from the request's own generated",
@@ -272,7 +285,11 @@ def render_serving_block():
         "`engine.stats()` (merged into `GET /v1/stats`) adds",
         "time-to-first-token and time-per-output-token percentiles",
         "(`ttft_p50_ms` / `ttft_p99_ms` / `tpot_p50_ms` /",
-        "`tpot_p99_ms`) and the speculative `spec_acceptance_rate`.",
+        "`tpot_p99_ms`), the speculative `spec_acceptance_rate`, and —",
+        "paged — the block-pool accounting (`kv_blocks_used` /",
+        "`kv_blocks_free`, also exported as gauges on `GET /metrics`)",
+        "plus token-granular `prefix_hit_rate` from",
+        "`STAT_serving_prefix_hits` / `_misses`.",
         "",
         "Flags:",
         "",
