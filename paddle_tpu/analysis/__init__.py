@@ -1,0 +1,27 @@
+"""Static-analysis plane over the Program IR.
+
+- :mod:`abstract_interp` — shape/dtype inference by abstract
+  interpretation (the trace-free analog of Fluid's
+  ``InferShape``/``InferVarType``), surfaced through the registered
+  ``shapes.infer`` verifier check and ``FLAGS_check_shapes``;
+- :mod:`recompile` — static prediction of XLA compile counts for the
+  executor and serving entry points, cross-checked against the live
+  compile tracker in ``tools/obs_smoke.py``.
+
+The sharding-rule linter lives next to the rules it checks
+(``distributed.sharding.lint_sharding_rules``) with a CLI front end at
+``tools/lint_sharding.py``.
+"""
+
+from .abstract_interp import (AbstractVar, InferContext, InferError,
+                              InterpretResult, abstract_eval_op,
+                              interpret_program)
+from .recompile import (ExecutorCompilePredictor, RecompilePredictor,
+                        feed_signature, predict_serving_compiles)
+
+__all__ = [
+    "AbstractVar", "InferContext", "InferError", "InterpretResult",
+    "abstract_eval_op", "interpret_program",
+    "ExecutorCompilePredictor", "RecompilePredictor", "feed_signature",
+    "predict_serving_compiles",
+]

@@ -1,0 +1,184 @@
+"""Static recompile prediction for the jitted entry points.
+
+The observability plane (PR 5) *observes* XLA compiles after the fact
+via ``tracked_jit``; this module *predicts* them before any trace, by
+mirroring the two compile-cache keying disciplines in the codebase:
+
+- the executor's per-``run()`` cache key
+  (``executor.py``: program identity+version, sorted feed
+  name/shape/dtype signature, fetch names, scope identity+name-set,
+  flags version) — :class:`ExecutorCompilePredictor`;
+- the serving engine's geometry-keyed entries (one prefill compile per
+  length bucket, one decode/verify compile total) including the paged
+  prefix cache's effect on which bucket a prompt's unshared suffix
+  lands in — :func:`predict_serving_compiles`.
+
+``tools/obs_smoke.py`` cross-checks a prediction against the live
+``observability.compiles()`` counts (predicted == observed is a CI
+invariant), so drift between this model and the engine's real
+admission logic fails the gate rather than rotting silently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "RecompilePredictor", "ExecutorCompilePredictor",
+    "feed_signature", "predict_serving_compiles",
+]
+
+
+def feed_signature(feeds: Dict[str, Any]) -> Tuple:
+    """Normalize a feed dict to the executor's cache signature: sorted
+    ``(name, shape, dtype)`` triples. Values may be arrays or
+    ``(shape, dtype)`` pairs."""
+    sig = []
+    for k, v in feeds.items():
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is None and isinstance(v, (tuple, list)) and len(v) == 2:
+            shape, dtype = v
+        sig.append((k, tuple(int(d) for d in (shape or ())), str(dtype)))
+    return tuple(sorted(sig))
+
+
+class RecompilePredictor:
+    """Generic site-keyed signature tracker: ``observe(site, sig)``
+    returns True when that (site, signature) pair would trace fresh,
+    mirroring how ``tracked_jit`` attributes compiles to sites."""
+
+    def __init__(self):
+        self._seen: Dict[str, Set[Tuple]] = {}
+        self._counts: Dict[str, int] = {}
+
+    def observe(self, site: str, signature: Tuple) -> bool:
+        sigs = self._seen.setdefault(site, set())
+        if signature in sigs:
+            return False
+        sigs.add(signature)
+        self._counts[site] = self._counts.get(site, 0) + 1
+        return True
+
+    def predicted_counts(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+
+class ExecutorCompilePredictor(RecompilePredictor):
+    """Predicts ``executor_step`` compiles for a sequence of
+    ``Executor.run`` calls, using the same key fields as the executor's
+    build cache. Identity fields (program, scope) are taken as the
+    objects themselves; pass the flags version explicitly if a run
+    changes flags mid-sequence."""
+
+    SITE = "executor_step"
+
+    def would_compile(self, program, feeds: Dict[str, Any],
+                      fetch_list: Sequence[str] = (),
+                      scope=None, *,
+                      flags_version: Optional[int] = None) -> bool:
+        if flags_version is None:
+            from .. import flags as _flags
+            flags_version = _flags.version()
+        scope_names = (frozenset(scope.all_var_names())
+                       if scope is not None else frozenset())
+        key = (id(program), getattr(program, "_version", 0),
+               feed_signature(feeds),
+               tuple(str(f) for f in fetch_list),
+               id(scope), scope_names, flags_version)
+        return self.observe(self.SITE, key)
+
+
+# ---------------------------------------------------------------------------
+# serving engine
+# ---------------------------------------------------------------------------
+
+
+def _parse_buckets(buckets: Sequence[int], max_len: int) -> List[int]:
+    # mirror of serving.engine._parse_buckets
+    bs = sorted({int(b) for b in buckets})
+    bs = [b for b in bs if 0 < b <= max_len]
+    if not bs or bs[-1] != max_len:
+        bs.append(max_len)
+    return bs
+
+
+def _bucket_for(buckets: Sequence[int], length: int) -> int:
+    for b in buckets:
+        if length <= b:
+            return b
+    return buckets[-1]
+
+
+def predict_serving_compiles(
+        request_rounds: Iterable[Sequence[Tuple[Sequence[int], int]]], *,
+        buckets: Sequence[int], max_len: int, paged: bool = True,
+        block_size: int = 16, prefix_cache: bool = True,
+        spec_tokens: int = 0) -> Dict[str, int]:
+    """Predict the engine's ``tracked_jit`` compile counts for a
+    serving workload, before running it.
+
+    ``request_rounds`` is a list of admission rounds; each round is a
+    list of ``(prompt_token_ids, max_new_tokens)`` pairs admitted
+    together. Rounds matter because the paged prefix cache only
+    publishes a prompt's blocks *after* its prefill completes — two
+    identical prompts in one round share nothing, the same pair split
+    across rounds shares every full block.
+
+    Model (mirrors ``serving/engine.py`` + ``serving/kv_cache.py``):
+
+    - prefill compiles once per length bucket hit; the paged path
+      buckets the *unshared suffix* ``len(prompt) - shared`` where
+      ``shared = min(matched_blocks * block_size, len(prompt) - 1)``
+      (the last prompt token is always recomputed to emit the first
+      output token);
+    - decode (``decode_step[_paged]``) compiles once iff any request
+      needs tokens beyond the one its prefill emits
+      (``max_new_tokens > 1``) — with ``spec_tokens`` K > 0 the engine
+      takes the verify path exclusively, so the compile lands on
+      ``verify_step[_paged]{k=K}`` instead.
+    """
+    bks = _parse_buckets(buckets, max_len)
+    suffix = "_paged" if paged else ""
+    counts: Dict[str, int] = {}
+    seen_buckets: Set[int] = set()
+    published: Set[Tuple] = set()   # rolling chains of full-block chunks
+    needs_decode = False
+
+    for round_reqs in request_rounds:
+        round_published: List[Tuple[int, ...]] = []
+        for prompt, max_new_tokens in round_reqs:
+            prompt = tuple(int(t) for t in prompt)
+            shared = 0
+            if paged and prefix_cache:
+                matched, chain = 0, ()
+                for i in range(len(prompt) // block_size):
+                    chain = (chain,
+                             prompt[i * block_size:(i + 1) * block_size])
+                    if chain not in published:
+                        break
+                    matched += 1
+                shared = min(matched * block_size, len(prompt) - 1)
+                round_published.append(prompt)
+            length = len(prompt) - shared if paged else len(prompt)
+            b = _bucket_for(bks, length)
+            if b not in seen_buckets:
+                seen_buckets.add(b)
+                counts[f"serving_prefill{suffix}{{bucket={b}}}"] = \
+                    counts.get(f"serving_prefill{suffix}{{bucket={b}}}",
+                               0) + 1
+            if max_new_tokens > 1:
+                needs_decode = True
+        # prefix publication happens post-prefill, i.e. between rounds
+        for prompt in round_published:
+            chain: Tuple = ()
+            for i in range(len(prompt) // block_size):
+                chain = (chain, prompt[i * block_size:(i + 1) * block_size])
+                published.add(chain)
+
+    if needs_decode:
+        if spec_tokens > 0:
+            counts[f"verify_step{suffix}{{k={spec_tokens}}}"] = 1
+        else:
+            counts[f"decode_step{suffix}"] = 1
+    return counts

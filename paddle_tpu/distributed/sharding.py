@@ -15,8 +15,9 @@ whole dygraph train step SPMD across a mesh.
 
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -40,8 +41,8 @@ class ShardingRules:
                  mesh: Mesh) -> PartitionSpec:
         for pat, spec in self._rules:
             if pat.search(name):
-                return _fit_spec(spec, shape, mesh)
-        return _fit_spec(self.default, shape, mesh)
+                return _fit_spec(spec, shape, mesh, name=name)
+        return _fit_spec(self.default, shape, mesh, name=name)
 
     def merge(self, other: "ShardingRules",
               default: PartitionSpec = None) -> "ShardingRules":
@@ -56,7 +57,7 @@ class ShardingRules:
 
 
 def _fit_spec(spec: PartitionSpec, shape: Sequence[int],
-              mesh: Mesh) -> PartitionSpec:
+              mesh: Mesh, name: Optional[str] = None) -> PartitionSpec:
     if spec is None:
         return P()
     dims = list(spec)
@@ -71,8 +72,30 @@ def _fit_spec(spec: PartitionSpec, shape: Sequence[int],
         size = 1
         for a in axes:
             size *= mesh.shape[a]
-        out.append(ax if shape[i] % size == 0 else None)
+        if shape[i] % size == 0:
+            out.append(ax)
+        else:
+            # the downgrade keeps one rule set serving many model sizes,
+            # but a silently-replicated tensor is exactly how a big run
+            # quietly eats HBM — count it and put it on the run log
+            # (tools/lint_sharding.py reports the same thing statically)
+            _note_replicated_fallback(name, i, ax, size, shape[i])
+            out.append(None)
     return P(*out)
+
+
+def _note_replicated_fallback(name: Optional[str], dim: int, ax,
+                              axis_size: int, dim_size: int):
+    from .. import monitor
+    monitor.stat_add("STAT_sharding_replicated_fallback")
+    try:
+        from ..observability import runlog
+        runlog.log_event("sharding_fallback",
+                         param=name or "<unnamed>", dim=dim,
+                         axis=str(ax), axis_size=axis_size,
+                         dim_size=dim_size)
+    except Exception:
+        pass  # observability must never break a sharding decision
 
 
 # Megatron-style tensor parallelism for the GPT family over an "mp" axis:
@@ -114,12 +137,7 @@ def state_shardings(spec, mesh: Mesh, rules: ShardingRules):
     accumulators inherit their parameter's spec when shapes match
     (moments), else replicate (beta_pow scalars); buffers replicate.
     """
-    names = {}
-    for layer in spec.layers:
-        for name, p in layer.named_parameters():
-            names.setdefault(id(p), name)
-    p_specs = [rules.spec_for(names.get(id(p), p.name), p.value.shape, mesh)
-               for p in spec.params]
+    p_specs = param_partition_specs(spec, mesh, rules)
     p_sh = [NamedSharding(mesh, s) for s in p_specs]
     by_id = {id(p): sh for p, sh in zip(spec.params, p_sh)}
     shape_by_id = {id(p): tuple(p.value.shape) for p in spec.params}
@@ -144,13 +162,21 @@ def state_shardings(spec, mesh: Mesh, rules: ShardingRules):
     }
 
 
+def _param_names_by_id(layers) -> Dict[int, str]:
+    """Dotted ``named_parameters()`` path per parameter identity — the
+    name the rule regexes match against (first registration wins, the
+    way `named_parameters` deduplicates tied weights)."""
+    names: Dict[int, str] = {}
+    for layer in layers:
+        for name, p in layer.named_parameters():
+            names.setdefault(id(p), name)
+    return names
+
+
 def param_partition_specs(spec, mesh: Mesh,
                           rules: ShardingRules) -> List[PartitionSpec]:
     """PartitionSpec per spec.params entry (rule lookup by dotted name)."""
-    names = {}
-    for layer in spec.layers:
-        for name, p in layer.named_parameters():
-            names.setdefault(id(p), name)
+    names = _param_names_by_id(spec.layers)
     return [rules.spec_for(names.get(id(p), p.name), p.value.shape, mesh)
             for p in spec.params]
 
@@ -199,3 +225,220 @@ def data_parallel_shardings(mesh: Mesh, n_args: int,
     """Shard the leading (batch) dim of every step argument over `axis`."""
     sh = NamedSharding(mesh, P(axis))
     return tuple(sh for _ in range(n_args))
+
+
+# ---------------------------------------------------------------------------
+# static rule linting (tools/lint_sharding.py front end)
+# ---------------------------------------------------------------------------
+
+
+class _MeshShapeView:
+    """Shape-only mesh stand-in: rule fitting reads nothing but
+    ``mesh.shape[axis]``, so the linter can check a 2×2 ``dp``/``mp``
+    layout on a machine with one device (or none)."""
+
+    def __init__(self, shape: Dict[str, int]):
+        self.shape = dict(shape)
+
+    def __repr__(self):
+        return f"_MeshShapeView({self.shape})"
+
+
+def _as_mesh(mesh) -> Any:
+    return _MeshShapeView(mesh) if isinstance(mesh, dict) else mesh
+
+
+@dataclasses.dataclass
+class RuleReport:
+    """Match accounting for one rule (or the default, pattern=None)."""
+
+    pattern: Optional[str]
+    spec: PartitionSpec
+    matches: int = 0          # params whose name the regex matches at all
+    wins: int = 0             # params where this rule decided the spec
+
+
+@dataclasses.dataclass
+class ShardingLintResult:
+    diagnostics: List[Any]            # framework.analysis.Diagnostic
+    rules: List[RuleReport]
+    params: List[Tuple[str, Tuple[int, ...], PartitionSpec]]
+    total_bytes: int
+    per_device_bytes: int
+    replicated_bytes: int
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def _normalize_named_params(named_params) -> List[Tuple[str, Tuple[int, ...]]]:
+    if hasattr(named_params, "named_parameters"):
+        named_params = list(named_params.named_parameters())
+    out = []
+    for name, p in named_params:
+        if isinstance(p, (tuple, list)):
+            shape = tuple(int(d) for d in p)
+        elif hasattr(p, "value") and hasattr(p.value, "shape"):
+            shape = tuple(int(d) for d in p.value.shape)
+        else:
+            shape = tuple(int(d) for d in p.shape)
+        out.append((name, shape))
+    return out
+
+
+def lint_sharding_rules(rules: ShardingRules, named_params, mesh, *,
+                        dtype_bytes: int = 4,
+                        replicated_warn_mb: float = 64.0
+                        ) -> ShardingLintResult:
+    """Statically check a rule table against a model's parameters and a
+    mesh — the pre-flight for ``to_static(mesh=..., param_rules=...)``.
+
+    ``named_params``: a Layer (its ``named_parameters()`` is used) or an
+    iterable of ``(dotted_name, shape)`` pairs. ``mesh``: a real
+    ``jax.sharding.Mesh`` or a plain ``{axis: size}`` dict (no devices
+    needed). Findings, as verifier ``Diagnostic`` records:
+
+    - ``sharding.unknown-axis`` (ERROR): a spec names a mesh axis that
+      does not exist — at run time this is a ``KeyError`` deep inside
+      spec fitting;
+    - ``sharding.dead-rule`` (WARNING): regex matches no parameter;
+    - ``sharding.shadowed-rule`` (WARNING): regex matches parameters
+      but an earlier rule always wins them;
+    - ``sharding.replicated-fallback`` (WARNING): a matched axis is
+      dropped because the mesh-axis size does not divide the dim;
+    - ``sharding.large-replicated`` (WARNING): a fully-replicated
+      parameter bigger than ``replicated_warn_mb``.
+
+    Plus the per-device memory estimate (``per_device_bytes``) under
+    the final fitted specs.
+    """
+    from ..framework.analysis import ERROR, WARNING, Diagnostic
+
+    mesh = _as_mesh(mesh)
+    params = _normalize_named_params(named_params)
+    reports = [RuleReport(pat.pattern, spec)
+               for pat, spec in rules._rules]
+    default_report = RuleReport(None, rules.default)
+    # shadowed-rule attribution: rule idx -> {winner idx}
+    lost_to: Dict[int, set] = {}
+    seen_unknown_axis: set = set()
+    diags: List[Diagnostic] = []
+    final: List[Tuple[str, Tuple[int, ...], PartitionSpec]] = []
+    total = per_device = replicated = 0
+
+    def screen_axes(spec, rule_label) -> bool:
+        """ERROR once per (rule, axis) for axes missing from the mesh;
+        True when every axis exists."""
+        all_ok = True
+        for ax in spec or ():
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                if a is None:
+                    continue
+                if a not in mesh.shape:
+                    all_ok = False
+                    key = (rule_label, a)
+                    if key not in seen_unknown_axis:
+                        seen_unknown_axis.add(key)
+                        diags.append(Diagnostic(
+                            ERROR, "sharding.unknown-axis",
+                            f"rule {rule_label} names mesh axis {a!r}, "
+                            f"but the mesh only has "
+                            f"{sorted(mesh.shape)} — spec fitting "
+                            f"KeyErrors at run time", var=str(rule_label)))
+        return all_ok
+
+    for name, shape in params:
+        matched = [i for i, (pat, _) in enumerate(rules._rules)
+                   if pat.search(name)]
+        for i in matched:
+            reports[i].matches += 1
+        if matched:
+            winner = matched[0]
+            reports[winner].wins += 1
+            for i in matched[1:]:
+                lost_to.setdefault(i, set()).add(winner)
+            spec = rules._rules[winner][1]
+            label = f"#{winner} {reports[winner].pattern!r}"
+        else:
+            default_report.matches += 1
+            default_report.wins += 1
+            spec = rules.default
+            label = "<default>"
+
+        nbytes = dtype_bytes
+        for d in shape:
+            nbytes *= int(d)
+        total += nbytes
+
+        if not screen_axes(spec, label):
+            fitted = P()
+        else:
+            dims = list(spec or ())
+            if len(dims) > len(shape):
+                fitted = P()
+            else:
+                out_dims = []
+                for i, ax in enumerate(dims):
+                    if ax is None:
+                        out_dims.append(None)
+                        continue
+                    axes = ax if isinstance(ax, tuple) else (ax,)
+                    size = 1
+                    for a in axes:
+                        size *= mesh.shape[a]
+                    if shape[i] % size == 0:
+                        out_dims.append(ax)
+                    else:
+                        diags.append(Diagnostic(
+                            WARNING, "sharding.replicated-fallback",
+                            f"{name!r} dim {i} (size {shape[i]}) is not "
+                            f"divisible by axis {ax!r} (size {size}); "
+                            f"rule {label} silently replicates this dim",
+                            var=name))
+                        out_dims.append(None)
+                fitted = P(*out_dims)
+
+        shards = 1
+        for ax in fitted:
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                if a is not None:
+                    shards *= mesh.shape[a]
+        per_device += nbytes // shards
+        if shards == 1:
+            replicated += nbytes
+            if nbytes > replicated_warn_mb * 1024 * 1024:
+                diags.append(Diagnostic(
+                    WARNING, "sharding.large-replicated",
+                    f"{name!r} ({nbytes / 2**20:.1f} MiB, shape "
+                    f"{list(shape)}) is fully replicated on every "
+                    f"device (rule {label})", var=name))
+        final.append((name, shape, fitted))
+
+    for i, rep in enumerate(reports):
+        if rep.matches == 0:
+            diags.append(Diagnostic(
+                WARNING, "sharding.dead-rule",
+                f"rule #{i} {rep.pattern!r} matches no parameter",
+                var=rep.pattern))
+        elif rep.wins == 0:
+            winners = ", ".join(
+                f"#{w} {reports[w].pattern!r}"
+                for w in sorted(lost_to.get(i, ())))
+            diags.append(Diagnostic(
+                WARNING, "sharding.shadowed-rule",
+                f"rule #{i} {rep.pattern!r} matches {rep.matches} "
+                f"parameter(s) but never wins — shadowed by earlier "
+                f"rule(s) {winners}", var=rep.pattern))
+
+    return ShardingLintResult(
+        diagnostics=diags, rules=reports + [default_report],
+        params=final, total_bytes=total, per_device_bytes=per_device,
+        replicated_bytes=replicated)

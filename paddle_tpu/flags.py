@@ -138,6 +138,14 @@ define_flag("check_ir_passes", False,
             "PassManager.apply pipeline; a failure names the offending "
             "pass. The safety net for IR-rewriting passes (fusion, "
             "sharding, recompute).")
+define_flag("check_shapes", False,
+            "Add static shape/dtype inference (abstract interpretation, "
+            "paddle_tpu/analysis/) to the verifier suite wherever it "
+            "runs (Program.verify, FLAGS_check_program first-compile, "
+            "FLAGS_check_ir_passes): a mis-shaped program fails before "
+            "any XLA trace with a Diagnostic naming the op and the "
+            "mismatched dims. Off by default — it abstractly executes "
+            "every block twice (dynamic-batch probing).")
 
 # Resilience plane (paddle_tpu/resilience): fault injection + retry +
 # guardian knobs. All deterministic so chaos runs replay exactly.

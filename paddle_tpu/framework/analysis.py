@@ -46,11 +46,22 @@ WARNING = "warning"
 
 # Ops that are kept by dead-code analysis even when nothing consumes
 # their outputs: their effect is external to the dataflow graph
-# (collectives, PS pushes, host prints, barriers).
+# (collectives, PS pushes, host prints, barriers). The op registry's
+# ``side_effect`` OpDef field is the authoritative source
+# (ops/collective_ops.py, ops/ps_ops.py mark themselves); these static
+# sets are the fallback for ops the registry doesn't know — audited
+# against those modules so communication ops are never marked dead.
 SIDE_EFFECT_OP_PREFIXES = ("c_", "send", "recv", "print")
 SIDE_EFFECT_OP_TYPES = frozenset({
     "print", "send", "recv", "push_sparse", "push_dense",
     "optimization_barrier", "fetch_barrier", "send_barrier",
+    # bare-named collectives (no "c_" prefix to catch them)
+    "barrier", "allreduce", "partial_allgather",
+    # PS table traffic: the pull mutates host parameter-server state on
+    # trace; the grad op's only output is a non-persistable @PUSH token
+    # that nothing reads — without this entry dead-code would drop the
+    # gradient push itself
+    "distributed_lookup_table", "distributed_lookup_table_grad",
 })
 
 
@@ -171,6 +182,10 @@ class _Context:
         # set by structural.sub-blocks; dataflow recursion into nested
         # blocks is only safe when the block graph checked out
         self.blocks_ok = True
+        # set by verify_program when the caller explicitly selected
+        # "shapes.infer" — the shape pass then runs even with
+        # FLAGS_check_shapes off (it is costly: dual abstract runs)
+        self.shapes_requested = False
 
     # -- helpers shared by checks ------------------------------------------
     def valid_sub_indices(self, op: Operator, block: Block) -> List[int]:
@@ -460,9 +475,20 @@ def _check_write_after_write(ctx: _Context):
 
 def _has_side_effects(op: Operator) -> bool:
     t = op.type
-    return (t in SIDE_EFFECT_OP_TYPES
+    if (t in SIDE_EFFECT_OP_TYPES
             or any(t.startswith(p) for p in SIDE_EFFECT_OP_PREFIXES)
-            or not op.outputs)
+            or not op.outputs):
+        return True
+    # registry-declared effects; a <fw>_grad of a side-effecting forward
+    # inherits it (the default grad maker re-runs the forward's channel)
+    from ..ops import registry as _reg
+    d = _reg.OPS.get(t)
+    if d is not None and d.side_effect:
+        return True
+    if t.endswith("_grad"):
+        fw = _reg.OPS.get(t[:-5])
+        return fw is not None and fw.side_effect
+    return False
 
 
 @_register_check(
@@ -605,6 +631,30 @@ def _check_registry_contract(ctx: _Context):
 
 
 # ---------------------------------------------------------------------------
+# shape/dtype inference (abstract interpretation)
+# ---------------------------------------------------------------------------
+
+
+@_register_check(
+    "shapes.infer",
+    "static shape/dtype inference by abstract interpretation "
+    "(`paddle_tpu/analysis/`): per-op infer rules + eval_shape over the "
+    "registered lowerings, recursing into control-flow sub-blocks — "
+    "gated behind `FLAGS_check_shapes` (or select the check explicitly) "
+    "because it abstractly executes the whole program")
+def _check_shapes(ctx: _Context):
+    from .. import flags as _flags
+    if not (_flags.get_flag("check_shapes") or ctx.shapes_requested):
+        return
+    if not ctx.blocks_ok:
+        return  # structural checks already reported the block graph
+    from ..analysis import interpret_program
+    result = interpret_program(ctx.program, feeds=ctx.feeds)
+    for d in result.diagnostics:
+        yield d
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -622,6 +672,7 @@ def verify_program(program: Program, feeds: Sequence[str] = (),
     (default: all of ``ANALYSIS_CHECKS``).
     """
     ctx = _Context(program, feeds, fetches)
+    ctx.shapes_requested = checks is not None and "shapes.infer" in checks
     selected = (list(ANALYSIS_CHECKS) if checks is None else list(checks))
     unknown = [c for c in selected if c not in ANALYSIS_CHECKS]
     if unknown:

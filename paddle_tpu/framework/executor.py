@@ -455,7 +455,8 @@ class Executor:
 
     def _verify_once(self, program: Program, feed_arrays, fetch_names,
                      scope):
-        """FLAGS_check_program hook: static-verify the program at its
+        """FLAGS_check_program / FLAGS_check_shapes hook: static-verify
+        the program at its
         first compile (framework/analysis.py), so a malformed IR fails
         with block/op coordinates instead of a tracer error. Names held
         by the scope count as feeds — state residency is a runtime
@@ -471,7 +472,8 @@ class Executor:
         self._verified_programs.add(key)
 
     def _build(self, program: Program, feed_arrays, fetch_names, scope):
-        if _flags.get_flag("check_program"):
+        if (_flags.get_flag("check_program")
+                or _flags.get_flag("check_shapes")):
             self._verify_once(program, feed_arrays, fetch_names, scope)
         block = program.global_block()
         state_in, written = _collect_io(block, feed_arrays.keys(), scope)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import LoweringContext, register
+from .registry import LoweringContext, register, register_infer
 
 
 def _axis(ctx: LoweringContext, attrs) -> str | None:
@@ -39,7 +39,7 @@ def _axis(ctx: LoweringContext, attrs) -> str | None:
 
 
 def _allreduce(name, op):
-    @register(name)
+    @register(name, side_effect=True)
     def _lower(ctx, ins, attrs, _op=op):
         x = ins["X"][0]
         ax = _axis(ctx, attrs)
@@ -69,7 +69,7 @@ _allreduce("c_allreduce_avg", "avg")
 _allreduce("allreduce", "sum")  # legacy operators/collective/allreduce_op
 
 
-@register("c_broadcast")
+@register("c_broadcast", side_effect=True)
 def _c_broadcast(ctx, ins, attrs):
     x = ins["X"][0]
     ax = _axis(ctx, attrs)
@@ -83,7 +83,7 @@ def _c_broadcast(ctx, ins, attrs):
     return {"Out": [jax.lax.psum(masked, ax)]}
 
 
-@register("c_allgather")
+@register("c_allgather", side_effect=True)
 def _c_allgather(ctx, ins, attrs):
     x = ins["X"][0]
     ax = _axis(ctx, attrs)
@@ -93,7 +93,7 @@ def _c_allgather(ctx, ins, attrs):
     return {"Out": [g.reshape((-1,) + x.shape[1:])]}
 
 
-@register("c_reducescatter")
+@register("c_reducescatter", side_effect=True)
 def _c_reducescatter(ctx, ins, attrs):
     x = ins["X"][0]
     ax = _axis(ctx, attrs)
@@ -102,7 +102,7 @@ def _c_reducescatter(ctx, ins, attrs):
     return {"Out": [jax.lax.psum_scatter(x, ax, tiled=True)]}
 
 
-@register("c_reduce_sum")
+@register("c_reduce_sum", side_effect=True)
 def _c_reduce_sum(ctx, ins, attrs):
     # reduce-to-root: psum everywhere, callers on non-root ignore (XLA has
     # no rooted reduce; GSPMD would DCE unused results)
@@ -113,7 +113,7 @@ def _c_reduce_sum(ctx, ins, attrs):
     return {"Out": [jax.lax.psum(x, ax)]}
 
 
-@register("c_scatter")
+@register("c_scatter", side_effect=True)
 def _c_scatter(ctx, ins, attrs):
     x = ins["X"][0]
     ax = _axis(ctx, attrs)
@@ -125,12 +125,12 @@ def _c_scatter(ctx, ins, attrs):
     return {"Out": [jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, 0)]}
 
 
-@register("c_concat")
+@register("c_concat", side_effect=True)
 def _c_concat(ctx, ins, attrs):
     return _c_allgather(ctx, ins, attrs)
 
 
-@register("c_split")
+@register("c_split", side_effect=True)
 def _c_split(ctx, ins, attrs):
     x = ins["X"][0]
     ax = _axis(ctx, attrs)
@@ -142,23 +142,23 @@ def _c_split(ctx, ins, attrs):
     return {"Out": [jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, -1)]}
 
 
-@register("c_identity")
+@register("c_identity", side_effect=True)
 def _c_identity(ctx, ins, attrs):
     return {"Out": [ins["X"][0]]}
 
 
-@register("c_sync_calc_stream", not_differentiable=True)
+@register("c_sync_calc_stream", not_differentiable=True, side_effect=True)
 def _c_sync_calc(ctx, ins, attrs):
     # stream sync is a no-op under XLA's dataflow execution model
     return {"Out": [ins["X"][0]]}
 
 
-@register("c_sync_comm_stream", not_differentiable=True)
+@register("c_sync_comm_stream", not_differentiable=True, side_effect=True)
 def _c_sync_comm(ctx, ins, attrs):
     return {"Out": [ins["X"][0]]}
 
 
-@register("barrier", not_differentiable=True)
+@register("barrier", not_differentiable=True, side_effect=True)
 def _barrier(ctx, ins, attrs):
     x = ins["X"][0] if ins.get("X") else jnp.zeros((1,), jnp.float32)
     ax = _axis(ctx, attrs)
@@ -168,7 +168,7 @@ def _barrier(ctx, ins, attrs):
     return {"Out": [x + 0 * jax.lax.psum(jnp.zeros((), x.dtype), ax)]}
 
 
-@register("c_embedding", no_grad_slots=("Ids",))
+@register("c_embedding", no_grad_slots=("Ids",), side_effect=True)
 def _c_embedding(ctx, ins, attrs):
     """Vocab-sharded embedding lookup (model parallel): each rank holds a
     vocab shard; out-of-shard ids produce zeros, psum combines."""
@@ -186,7 +186,7 @@ def _c_embedding(ctx, ins, attrs):
     return {"Out": [jax.lax.psum(emb, ax)]}
 
 
-@register("partial_allgather")
+@register("partial_allgather", side_effect=True)
 def _partial_allgather(ctx, ins, attrs):
     return _c_allgather(ctx, ins, attrs)
 
@@ -233,3 +233,80 @@ def _sync_batch_norm(ctx, ins, attrs):
         scale.reshape(bshape) + bias.reshape(bshape)
     return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
             "SavedMean": [m], "SavedVariance": [v]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (paddle_tpu/analysis abstract interpreter)
+#
+# Collectives are marked side_effect=True (dead-code analysis must never
+# drop communication), which also keeps the interpreter from eval_shape-
+# ing them — the lowering's axis-less fallback is identity, which would
+# silently report wrong shapes for a real multi-rank graph. The rules
+# below instead key off the ``nranks`` attr (absent/1 = single-process
+# identity, matching the lowering outside any mesh).
+# ---------------------------------------------------------------------------
+
+
+def _identity_infer(ictx, ins, attrs):
+    return {"Out": list(ins.get("X", []))}
+
+
+for _name in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+              "c_allreduce_prod", "c_allreduce_avg", "allreduce",
+              "c_broadcast", "c_reduce_sum", "c_identity",
+              "c_sync_calc_stream", "c_sync_comm_stream"):
+    register_infer(_name)(_identity_infer)
+
+
+def _nranks(attrs) -> int:
+    return int(attrs.get("nranks", 1) or 1)
+
+
+def _scaled_dim_infer(dim, mode):
+    """Factory: Out = X with ``dim`` multiplied (gather) or divided
+    (scatter) by nranks; divisibility is a static contract."""
+    def rule(ictx, ins, attrs):
+        x = ins["X"][0]
+        n = _nranks(attrs)
+        if n <= 1 or not x.known:
+            return {"Out": [x]}
+        shape = list(x.shape)
+        d = x.shape[dim]
+        if mode == "mul":
+            shape[dim] = d * n if d >= 0 else -1
+        else:
+            if d >= 0 and d % n:
+                ictx.fail(
+                    f"dim {dim} of X ({d}) is not divisible by "
+                    f"nranks={n}")
+            shape[dim] = d // n if d >= 0 else -1
+        from ..analysis.abstract_interp import AbstractVar
+        return {"Out": [AbstractVar(tuple(shape), x.dtype)]}
+    return rule
+
+
+register_infer("c_allgather")(_scaled_dim_infer(0, "mul"))
+register_infer("c_concat")(_scaled_dim_infer(0, "mul"))
+register_infer("partial_allgather")(_scaled_dim_infer(0, "mul"))
+register_infer("c_reducescatter")(_scaled_dim_infer(0, "div"))
+register_infer("c_scatter")(_scaled_dim_infer(0, "div"))
+register_infer("c_split")(_scaled_dim_infer(-1, "div"))
+
+
+@register_infer("barrier")
+def _barrier_infer(ictx, ins, attrs):
+    from ..analysis.abstract_interp import AbstractVar
+    if ins.get("X"):
+        return {"Out": [ins["X"][0]]}
+    return {"Out": [AbstractVar((1,), "float32")]}
+
+
+@register_infer("c_embedding")
+def _c_embedding_infer(ictx, ins, attrs):
+    from ..analysis.abstract_interp import AbstractVar
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if not (w.known and ids.known):
+        return {"Out": [AbstractVar()]}
+    if len(w.shape) != 2:
+        ictx.fail(f"W must be rank-2 (vocab_shard, dim), got {w}")
+    return {"Out": [AbstractVar(ids.shape + (w.shape[1],), w.dtype)]}

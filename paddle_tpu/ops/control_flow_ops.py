@@ -31,7 +31,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import register, register_infer
 
 
 def _runner(ctx, op_name):
@@ -176,3 +176,82 @@ def _switch_case(ctx, ins, attrs):
     outs = jax.lax.switch(idx, [make_branch(i, b)
                                 for i, b in enumerate(blocks)], vals)
     return {"Out": list(outs)}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (paddle_tpu/analysis abstract interpreter)
+#
+# These lowerings cannot be eval_shape'd: they re-enter the executor's
+# block runner to trace sub-blocks. The rules mirror the name plumbing
+# above and statically enforce the two XLA contracts the lowerings
+# discover only at trace time — loop-carry shape/dtype invariance
+# (while) and branch-signature agreement (cond / switch_case).
+# ---------------------------------------------------------------------------
+
+
+def _seed_env(attrs, ins):
+    env = dict(zip(attrs.get("param_names", []), ins.get("Params", [])))
+    return env
+
+
+@register_infer("while")
+def _while_infer(ictx, ins, attrs):
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    carries = list(ins.get("X", []))
+    env = _seed_env(attrs, ins)
+    env.update(zip(carry_names, carries))
+    if ins.get("Condition"):
+        env[cond_name] = ins["Condition"][0]
+    out_env = ictx.infer_block(int(attrs["sub_block"]), env)
+    for name, before in zip(carry_names, carries):
+        after = out_env.get(name)
+        if (after is not None and before.known and after.known
+                and (before.shape != after.shape
+                     or before.dtype != after.dtype)):
+            ictx.report(
+                "shapes.loop-carry",
+                f"loop carry {name!r} changes from {before} to {after} "
+                f"across one iteration — while carries must be "
+                f"shape/dtype invariant (the lax.while_loop contract)",
+                var=name)
+    # invariance means the entry carries ARE the loop's fixed point
+    return {"Out": carries}
+
+
+def _join_branches(ictx, attrs, branch_outs, what):
+    out_names = list(attrs["out_names"])
+    joined = []
+    for j, name in enumerate(out_names):
+        vals = [outs.get(name) for outs in branch_outs]
+        known = [v for v in vals if v is not None and v.known]
+        agree = all(v.shape == known[0].shape and v.dtype == known[0].dtype
+                    for v in known) if known else True
+        if not agree:
+            ictx.report(
+                "shapes.branch-mismatch",
+                f"{what} output {name!r} disagrees across branches: "
+                f"{', '.join(str(v) if v is not None else '?' for v in vals)}"
+                f" — XLA compiles every branch to one signature",
+                var=name)
+            joined.append(None)
+        else:
+            joined.append(known[0] if known and len(known) == len(vals)
+                          else None)
+    from ..analysis.abstract_interp import AbstractVar
+    return {"Out": [v if v is not None else AbstractVar()
+                    for v in joined]}
+
+
+@register_infer("cond")
+def _cond_infer(ictx, ins, attrs):
+    outs = [ictx.infer_block(int(attrs[k]), _seed_env(attrs, ins))
+            for k in ("sub_block_t", "sub_block_f")]
+    return _join_branches(ictx, attrs, outs, "cond")
+
+
+@register_infer("switch_case")
+def _switch_case_infer(ictx, ins, attrs):
+    outs = [ictx.infer_block(int(b), _seed_env(attrs, ins))
+            for b in attrs["sub_blocks"]]
+    return _join_branches(ictx, attrs, outs, "switch_case")

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import io_callback
 
-from .registry import register
+from .registry import register, register_infer
 
 
 def _table_name(attrs):
@@ -52,7 +52,7 @@ def _lookup_table_grad_maker(op, out_grad_names, wanted_input_grads):
 
 @register("distributed_lookup_table", no_grad_slots=("Ids",),
           grad_drops_inputs=("W",), virtual_param=True,
-          custom_grad_maker=_lookup_table_grad_maker)
+          custom_grad_maker=_lookup_table_grad_maker, side_effect=True)
 def _distributed_lookup_table(ctx, ins, attrs):
     """Pull rows from the host sparse table (init-on-miss)."""
     from ..distributed.ps.sparse_table import REGISTRY
@@ -75,7 +75,7 @@ def _distributed_lookup_table(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register("distributed_lookup_table_grad")
+@register("distributed_lookup_table_grad", side_effect=True)
 def _distributed_lookup_table_grad(ctx, ins, attrs):
     """Push: route the gradient to the communicator (send_op analog)."""
     from ..distributed.ps import runtime as ps_runtime
@@ -103,7 +103,7 @@ def _distributed_lookup_table_grad(ctx, ins, attrs):
     return {"W@GRAD": [token]}
 
 
-@register("send", not_differentiable=True)
+@register("send", not_differentiable=True, side_effect=True)
 def _send(ctx, ins, attrs):
     """Dense var push to the PS tier (send_op.cc analog): in the
     single-process backend, a host callback storing into the registry."""
@@ -122,7 +122,7 @@ def _send(ctx, ins, attrs):
     return {"Out": [token]}
 
 
-@register("recv", not_differentiable=True)
+@register("recv", not_differentiable=True, side_effect=True)
 def _recv(ctx, ins, attrs):
     from ..distributed.ps.sparse_table import REGISTRY
     name = attrs.get("recv_varnames", ["var"])[0]
@@ -137,3 +137,43 @@ def _recv(ctx, ins, attrs):
     out = io_callback(_load, jax.ShapeDtypeStruct(shape, jnp.float32),
                       ordered=True)
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (paddle_tpu/analysis abstract interpreter)
+#
+# PS lowerings call REGISTRY.get_or_create / the communicator at TRACE
+# time — a host side effect, so they are marked side_effect=True and
+# must never be eval_shape'd (even an abstract trace would create
+# tables). Shapes are fully attr-determined instead.
+# ---------------------------------------------------------------------------
+
+
+@register_infer("distributed_lookup_table")
+def _lookup_infer(ictx, ins, attrs):
+    from ..analysis.abstract_interp import AbstractVar
+    ids = ins["Ids"][0]
+    dim = int(attrs["value_dim"])
+    if not ids.known:
+        return {"Out": [AbstractVar()]}
+    return {"Out": [AbstractVar(ids.shape + (dim,), "float32")]}
+
+
+@register_infer("distributed_lookup_table_grad")
+def _lookup_grad_infer(ictx, ins, attrs):
+    from ..analysis.abstract_interp import AbstractVar
+    # the push emits a scalar completion token, not a dense grad
+    return {"W@GRAD": [AbstractVar((), "float32")]}
+
+
+@register_infer("send")
+def _send_infer(ictx, ins, attrs):
+    from ..analysis.abstract_interp import AbstractVar
+    return {"Out": [AbstractVar((), "float32")]}
+
+
+@register_infer("recv")
+def _recv_infer(ictx, ins, attrs):
+    from ..analysis.abstract_interp import AbstractVar
+    return {"Out": [AbstractVar(tuple(int(d) for d in attrs["shape"]),
+                                "float32")]}

@@ -102,6 +102,21 @@ Lowering = Callable[[LoweringContext, Dict[str, List[Any]], Dict[str, Any]],
 #   slot -> list of target grad names (None where grad not needed).
 GradMaker = Callable[..., List[Tuple[str, dict, dict, dict]]]
 
+# Infer-rule signature — the static mirror of Lowering, over
+# AbstractVar(shape, dtype) instead of arrays:
+#   (ictx, ins, attrs) -> outs
+#   ins:  {slot: [AbstractVar, ...]}
+#   outs: {slot: [AbstractVar, ...]}
+# ictx is analysis.abstract_interp.InferContext (sub-block recursion via
+# ictx.infer_block, structured failure via ictx.fail). Most ops need no
+# rule: the abstract interpreter derives shapes by jax.eval_shape over
+# the registered lowering. Explicit rules exist for ops whose lowering
+# cannot run abstractly (control flow needs the executor's block runner,
+# PS ops touch host state at trace time) or whose shape depends on
+# execution context (collectives outside a mesh).
+InferRule = Callable[[Any, Dict[str, List[Any]], Dict[str, Any]],
+                     Dict[str, List[Any]]]
+
 
 @dataclasses.dataclass
 class OpDef:
@@ -129,6 +144,18 @@ class OpDef:
     # registry): bump when an op's attrs/slots/semantics change so saved
     # programs can detect incompatibility at load.
     version: int = 1
+    # Static shape/dtype inference rule (InferRule) used by the abstract
+    # interpreter instead of eval_shape-over-lowering. Register inline
+    # (``register(op_type, infer=...)``) or attach later with
+    # :func:`register_infer`.
+    infer: Optional[InferRule] = None
+    # True when the op's effect is external to the dataflow graph
+    # (collectives rendezvous, PS pulls/pushes mutate host tables, prints
+    # reach the console): dead-code analysis must keep it even when no
+    # output is consumed, and the abstract interpreter must never run its
+    # lowering (even abstractly — PS lowerings touch host state at trace
+    # time).
+    side_effect: bool = False
 
 
 OPS: Dict[str, OpDef] = {}
@@ -140,6 +167,23 @@ def register(op_type: str, **kw):
         if op_type in OPS:
             raise ValueError(f"op {op_type!r} already registered")
         OPS[op_type] = OpDef(type=op_type, lowering=fn, **kw)
+        return fn
+    return deco
+
+
+def register_infer(op_type: str):
+    """Decorator: attach a static infer rule to an already-registered op
+    (the inline form is ``register(op_type, infer=...)``)."""
+    def deco(fn: InferRule) -> InferRule:
+        d = OPS.get(op_type)
+        if d is None:
+            raise ValueError(
+                f"cannot register infer rule: op {op_type!r} has no "
+                f"registered lowering")
+        if d.infer is not None:
+            raise ValueError(
+                f"op {op_type!r} already has an infer rule")
+        d.infer = fn
         return fn
     return deco
 

@@ -337,6 +337,56 @@ def test_real_pass_pipeline_stays_clean_under_check():
 # ---------------------------------------------------------------------
 
 
+def test_dead_code_never_flags_communication_ops():
+    """Regression (audit vs ops/collective_ops.py + ops/ps_ops.py):
+    communication ops whose effect is external to the dataflow graph —
+    including the bare-named ones no prefix rule catches and the PS
+    grad-push whose only output is an unread token — must survive
+    dead-code analysis."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("x", is_data=True, shape=[4, 8], dtype="float32")
+    blk.create_var("ids", is_data=True, shape=[4], dtype="int64")
+    blk.create_var("y")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    cases = [
+        ("barrier", {"X": "x"}, {"Out": "b_out"}, {}),
+        ("allreduce", {"X": "x"}, {"Out": "ar_out"}, {}),
+        ("partial_allgather", {"X": "x"}, {"Out": "pg_out"}, {}),
+        ("distributed_lookup_table", {"Ids": "ids"}, {"Out": "dl_out"},
+         {"table_name": "t", "value_dim": 8}),
+        ("distributed_lookup_table_grad",
+         {"Ids": "ids", "Out@GRAD": "y"}, {"W@GRAD": "push_token"},
+         {"table_name": "t", "value_dim": 8}),
+    ]
+    for op_type, ins, outs, attrs in cases:
+        for names in outs.values():
+            blk.create_var(names)
+        blk.append_op(op_type, ins, outs, attrs)
+    result = prog.verify(fetches=["y"])
+    dead = _find(result, "dataflow.dead-code")
+    assert not dead, "\n".join(str(d) for d in dead)
+
+
+def test_registry_side_effect_field_drives_dead_code():
+    """An op marked side_effect=True in the registry is kept without
+    any entry in the static name sets (the registry is authoritative),
+    and so is its derived `<fw>_grad`."""
+    from paddle_tpu.framework import analysis as fa
+    from paddle_tpu.ops import registry as reg
+    assert reg.OPS["c_allgather"].side_effect
+    op_like = type("O", (), {})()
+    op_like.type = "c_allgather"
+    op_like.outputs = {"Out": ["g"]}
+    assert fa._has_side_effects(op_like)
+    op_like.type = "c_allgather_grad"  # not separately registered
+    assert fa._has_side_effects(op_like)
+    # the static sets cover the audited bare names too
+    for t in ("barrier", "partial_allgather", "distributed_lookup_table",
+              "distributed_lookup_table_grad"):
+        assert t in fa.SIDE_EFFECT_OP_TYPES, t
+
+
 def test_book_programs_verify_clean():
     from tools.book_programs import build_all
     names = []

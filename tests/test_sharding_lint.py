@@ -1,0 +1,203 @@
+"""Sharding-rule table semantics + the GSPMD sharding linter
+(distributed/sharding.py, tools/lint_sharding.py)."""
+
+import os
+import sys
+
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import monitor
+from paddle_tpu.distributed import sharding as sh
+
+MESH = {"dp": 2, "mp": 2}
+
+
+def _by_check(result, check):
+    return [d for d in result.diagnostics if d.check == check]
+
+
+# ---------------------------------------------------------------------
+# rule-table semantics
+# ---------------------------------------------------------------------
+
+
+def test_merge_precedence_and_default():
+    tp = sh.ShardingRules([(r"\.weight$", P(None, "mp"))])
+    zero = sh.ShardingRules([(r"\.weight$", P("dp"))], default=P("dp"))
+    merged = tp.merge(zero)
+    mesh = sh._as_mesh(MESH)
+    # both regexes match; self (tp) comes first and wins
+    assert merged.spec_for("fc.weight", (8, 4), mesh) == P(None, "mp")
+    # unmatched names take the default, which comes from `other`
+    assert merged.spec_for("fc.bias", (8,), mesh) == P("dp")
+    # an explicit default overrides other's
+    assert tp.merge(zero, default=P()).default == P()
+    # merge does not mutate the operands
+    assert len(tp._rules) == 1 and len(zero._rules) == 1
+    assert len(merged._rules) == 2
+
+
+def test_fit_spec_divisibility():
+    mesh = sh._as_mesh(MESH)
+    assert sh._fit_spec(P("dp", "mp"), (8, 4), mesh) == P("dp", "mp")
+    # 7 % 2 != 0: that dim degrades to replicated, the other survives
+    assert sh._fit_spec(P("dp", "mp"), (7, 4), mesh) == P(None, "mp")
+    # rank mismatch (spec longer than the tensor): fully replicated
+    assert sh._fit_spec(P("dp", "mp"), (8,), mesh) == P()
+    assert sh._fit_spec(None, (8, 4), mesh) == P()
+
+
+def test_fit_spec_tuple_axes():
+    mesh = sh._as_mesh(MESH)
+    # ("dp","mp") folds both axes onto one dim: size 4
+    assert (sh._fit_spec(P(("dp", "mp")), (8, 3), mesh)
+            == P(("dp", "mp")))
+    # 6 % 4 != 0 even though 6 % 2 == 0 — the tuple is all-or-nothing
+    assert sh._fit_spec(P(("dp", "mp")), (6, 3), mesh) == P(None)
+
+
+def test_fit_spec_downgrade_bumps_counter():
+    mesh = sh._as_mesh(MESH)
+    before = monitor.stat_get("STAT_sharding_replicated_fallback")
+    sh._fit_spec(P("mp"), (7,), mesh, name="odd.weight")
+    after = monitor.stat_get("STAT_sharding_replicated_fallback")
+    assert after == before + 1
+    # a clean fit must not count
+    sh._fit_spec(P("mp"), (8,), mesh, name="even.weight")
+    assert monitor.stat_get(
+        "STAT_sharding_replicated_fallback") == after
+
+
+# ---------------------------------------------------------------------
+# the linter on synthetic tables
+# ---------------------------------------------------------------------
+
+
+def test_lint_flags_dead_rule():
+    rules = sh.ShardingRules([
+        (r"\.weight$", P(None, "mp")),
+        (r"encoder\.layers\.", P("mp")),      # nothing matches this
+    ])
+    r = sh.lint_sharding_rules(
+        rules, [("fc.weight", (8, 4))], MESH)
+    dead = _by_check(r, "sharding.dead-rule")
+    assert len(dead) == 1 and "encoder" in dead[0].message
+    assert r.ok()                             # dead rules warn, not fail
+
+
+def test_lint_flags_shadowed_rule():
+    rules = sh.ShardingRules([
+        (r"\.weight$", P(None, "mp")),
+        (r"fc\.weight$", P("dp", None)),      # always loses to rule #0
+    ])
+    r = sh.lint_sharding_rules(
+        rules, [("fc.weight", (8, 4)), ("out.weight", (4, 4))], MESH)
+    shadowed = _by_check(r, "sharding.shadowed-rule")
+    assert len(shadowed) == 1
+    assert "#1" in shadowed[0].message and "#0" in shadowed[0].message
+    # accounting: rule 1 matched once but never decided a spec
+    assert r.rules[1].matches == 1 and r.rules[1].wins == 0
+    assert r.rules[0].wins == 2
+
+
+def test_lint_flags_replicated_fallback_and_unknown_axis():
+    rules = sh.ShardingRules([
+        (r"odd\.weight$", P("mp")),           # 7 % 2 != 0
+        (r"fc\.weight$", P("tp", None)),      # no such axis
+    ])
+    r = sh.lint_sharding_rules(
+        rules, [("odd.weight", (7,)), ("fc.weight", (8, 4))], MESH)
+    fb = _by_check(r, "sharding.replicated-fallback")
+    assert len(fb) == 1 and "odd.weight" in fb[0].message
+    assert "7" in fb[0].message               # names the offending dim
+    errs = _by_check(r, "sharding.unknown-axis")
+    assert len(errs) == 1 and errs[0].severity == "error"
+    assert "'tp'" in errs[0].message
+    assert not r.ok()
+
+
+def test_lint_large_replicated_threshold():
+    params = [("huge.bias", (1024, 1024))]    # 4 MiB, default-replicated
+    loose = sh.lint_sharding_rules(sh.ShardingRules([]), params, MESH)
+    assert not _by_check(loose, "sharding.large-replicated")
+    tight = sh.lint_sharding_rules(sh.ShardingRules([]), params, MESH,
+                                   replicated_warn_mb=1.0)
+    assert len(_by_check(tight, "sharding.large-replicated")) == 1
+
+
+def test_lint_per_device_bytes_accounting():
+    rules = sh.ShardingRules([(r"\.weight$", P("dp", "mp"))])
+    r = sh.lint_sharding_rules(
+        rules, [("a.weight", (8, 4)), ("b.bias", (6,))], MESH)
+    # a.weight: 128 B over 4 shards -> 32; b.bias: 24 B replicated
+    assert r.total_bytes == 128 + 24
+    assert r.per_device_bytes == 32 + 24
+    assert r.replicated_bytes == 24
+    specs = dict((n, s) for n, _, s in r.params)
+    assert specs["a.weight"] == P("dp", "mp")
+    assert specs["b.bias"] == P()
+
+
+def test_lint_accepts_layer_and_real_mesh_types():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+    pt.seed(0)
+    r = sh.lint_sharding_rules(
+        sh.ShardingRules([(r"\.weight$", P(None, "mp"))]), M(), MESH)
+    assert r.ok()
+    names = [n for n, _, _ in r.params]
+    assert any(n.endswith("fc.weight") for n in names)
+    assert any(n.endswith("fc.bias") for n in names)
+
+
+# ---------------------------------------------------------------------
+# the CLI tool over the shipped GPT presets (the CI-gate invocation)
+# ---------------------------------------------------------------------
+
+
+def test_gpt_tp_preset_findings_on_2x2_mesh():
+    from tools import lint_sharding as tool
+    rules = tool.resolve_rules("gpt_tp")
+    r = sh.lint_sharding_rules(rules, tool.build_model(), MESH)
+    # the tiny GPT decoder has no q/k/v/linear1/linear2/word_embeddings
+    # targets: those rules are dead or shadowed by the fused-qkv rules,
+    # and vocab 97 defeats wte's vocab-parallel split — all WARNINGs,
+    # so the CI gate stays green
+    assert r.ok()
+    assert len(_by_check(r, "sharding.dead-rule")) == 4
+    assert len(_by_check(r, "sharding.shadowed-rule")) == 2
+    fb = _by_check(r, "sharding.replicated-fallback")
+    assert len(fb) == 1 and "wte.weight" in fb[0].message
+    assert 0 < r.per_device_bytes < r.total_bytes
+    # sharding must actually save memory: >=25% off the replicated cost
+    assert r.per_device_bytes <= 0.75 * r.total_bytes
+
+
+def test_lint_sharding_cli_exit_codes(capsys):
+    import json
+
+    from tools import lint_sharding as tool
+    assert tool.main(["--preset", "gpt_tp", "--mesh", "dp=2,mp=2"]) == 0
+    capsys.readouterr()
+    # warnings exist -> --strict flips the exit code
+    assert tool.main(["--preset", "gpt_tp", "--mesh", "dp=2,mp=2",
+                      "--strict"]) == 1
+    capsys.readouterr()
+    assert tool.main(["--preset", "gpt_tp+fully_sharded",
+                      "--mesh", "dp=2,mp=2", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] and rep["mesh"] == {"dp": 2, "mp": 2}
+    assert rep["per_device_bytes"] < rep["total_bytes"]
+    assert any(d["check"] == "sharding.shadowed-rule"
+               for d in rep["diagnostics"])
+    assert tool.main(["--preset", "gpt_tp", "--mesh", "dp=2"]) == 1
+    capsys.readouterr()                       # unknown 'mp' axis: ERROR

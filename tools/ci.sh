@@ -4,28 +4,30 @@
 # pure-python+native-extension tree):
 #
 #   1. import smoke (the package must import with no toolchain at all)
-#   2. lint: static program verifier over the eight book programs +
-#      op-registry grad-contract diff vs the committed baseline
-#   3. full test suite on the virtual 8-device CPU mesh
-#   4. chaos suite (deterministic fault injection: retry/skip/rollback
+#   2. lint: static program verifier + shape/dtype inference over the
+#      eight book programs + op-registry grad-contract diff vs baseline
+#   3. sharding-rule lint (GSPMD pre-flight: dead/shadowed rules,
+#      divisibility fallbacks, per-device memory estimate)
+#   4. full test suite on the virtual 8-device CPU mesh
+#   5. chaos suite (deterministic fault injection: retry/skip/rollback
 #      recovery paths under FLAGS_fault_spec-driven failures)
-#   5. serving plane (continuous-batching engine == sequential decode
+#   6. serving plane (continuous-batching engine == sequential decode
 #      over the paged KV cache — block tables, prefix reuse and COW
 #      token-identical with AND without the prefix cache, compile-count
 #      budget re-asserted on the paged step names, queue backpressure,
 #      block-pool exhaustion head-of-line; reduced in quick mode)
-#   6. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
+#   7. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
 #      tiny model: tokens/s + acceptance rate vs the plain engine)
-#   7. observability gate (train + serving smoke under the run log;
+#   8. observability gate (train + serving smoke under the run log;
 #      /metrics parses as Prometheus text, compile tracker pins the
 #      decode/prefill compile budget, run-log events feed
 #      tools/trace_summary.py)
-#   8. op coverage gate (>= 80% of the reference forward-op surface)
-#   9. API-freeze check (public signature snapshot diff)
-#  10. multi-chip dry-run (GSPMD train step on N virtual devices)
-#  11. README generated fragments vs their registries (no drift)
+#   9. op coverage gate (>= 80% of the reference forward-op surface)
+#  10. API-freeze check (public signature snapshot diff)
+#  11. multi-chip dry-run (GSPMD train step on N virtual devices)
+#  12. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -33,7 +35,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/11 import smoke"
+echo "== 1/12 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -42,43 +44,51 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/11 lint (program verifier + op-desc compat)"
-JAX_PLATFORMS=cpu python tools/lint_program.py --books
+echo "== 2/12 lint (program verifier + shape inference + op-desc compat)"
+JAX_PLATFORMS=cpu python tools/lint_program.py --books --shapes
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
+echo "== 3/12 sharding-rule lint (GSPMD pre-flight)"
+# the GPT TP table and the ZeRO-style fully-sharded table against the
+# GPT benchmark model on a 2x2 dp/mp mesh: no unknown axes (ERROR);
+# expected findings (dead encoder rules on a GPT model, shadowed
+# v_proj regex, vocab-97 divisibility fallback) stay WARNINGs
+JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp --mesh dp=2,mp=2
+JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp+fully_sharded --mesh dp=2,mp=2 --json > /dev/null
+
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 3/11 test suite (virtual 8-device CPU mesh)"
+  echo "== 4/12 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 3/11 test suite: SKIPPED (quick mode)"
+  echo "== 4/12 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/11 chaos suite (deterministic fault injection)"
+  echo "== 5/12 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 4/11 chaos suite: reduced subset (quick mode)"
+  echo "== 5/12 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/11 serving plane (incl. paged-KV equivalence)"
+  echo "== 6/12 serving plane (incl. paged-KV equivalence)"
   # the full file carries the paged oracle: engine output token-identical
   # to sequential greedy with the prefix cache on AND off, plus the
   # dense paged=False baseline and the paged compile-count pins
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 else
-  echo "== 5/11 serving plane: reduced subset (quick mode)"
+  echo "== 6/12 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv or block_allocator \
 or paged_engine_matches or dense_engine_still or prefix_reuse"
 fi
 
-echo "== 6/11 speculative decoding gate"
+echo "== 7/12 speculative decoding gate"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -k "spec"
 if [[ "${1:-}" != "quick" ]]; then
   echo "   bench: spec vs non-spec on the repetitive-suffix workload"
@@ -87,7 +97,7 @@ if [[ "${1:-}" != "quick" ]]; then
     BENCH_SERVING_COMPARE=0 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== 7/11 observability gate"
+echo "== 8/12 observability gate"
 # tiny train + serving smoke under the run log: /metrics parses as
 # Prometheus text (incl. KV block-pool gauges), compile tracker pins
 # decode_step_paged==1 compile and one batched prefill dispatch, a
@@ -95,14 +105,14 @@ echo "== 7/11 observability gate"
 # trace_summary
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
-echo "== 8/11 op coverage gate"
+echo "== 9/12 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 9/11 API freeze"
+echo "== 10/12 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -121,7 +131,7 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 10/11 multi-chip dry run"
+echo "== 11/12 multi-chip dry run"
 # needs the jax_num_cpu_devices config option to carve out virtual CPU
 # devices; older jax builds (0.4.x) don't have it
 if JAX_PLATFORMS=cpu python -c "
@@ -137,7 +147,7 @@ else
   echo "   installed jax has no jax_num_cpu_devices — skipped"
 fi
 
-echo "== 11/11 README generated-fragment sync"
+echo "== 12/12 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

@@ -13,13 +13,20 @@ Two input modes:
         each main+startup pair, with the training fetches as dead-code
         roots. This is the CI lint gate's zero-false-positive sweep.
 
-Exit status 1 if any program has errors; --strict also fails on
-warnings. --verbose prints every diagnostic of clean programs too.
+--shapes adds static shape/dtype inference (the paddle_tpu/analysis
+abstract interpreter, same as FLAGS_check_shapes) to the suite. --json
+replaces the human-readable report with one JSON document on stdout
+(per-program diagnostics as structured records) for tooling.
+
+Exit status 1 if any program has ERROR diagnostics; --strict also fails
+on warnings. --verbose prints every diagnostic of clean programs too.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import os
 import sys
 
@@ -30,10 +37,23 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def lint_one(label, program, feeds=(), fetches=None, strict=False,
-             verbose=False):
-    """Verify one program; print diagnostics; return True if it passes."""
+             verbose=False, report=None):
+    """Verify one program; print diagnostics; return True if it passes.
+
+    With ``report`` (a list), append a structured record instead of
+    printing (--json mode).
+    """
     result = program.verify(feeds=feeds, fetches=fetches)
     failed = bool(result.errors) or (strict and result.warnings)
+    if report is not None:
+        report.append({
+            "program": label,
+            "ok": not failed,
+            "errors": len(result.errors),
+            "warnings": len(result.warnings),
+            "diagnostics": [dataclasses.asdict(d) for d in result],
+        })
+        return not failed
     shown = result.diagnostics if (failed or verbose) else ()
     for d in shown:
         print(f"  {d}")
@@ -41,24 +61,25 @@ def lint_one(label, program, feeds=(), fetches=None, strict=False,
     return not failed
 
 
-def lint_books(strict, verbose):
+def lint_books(strict, verbose, report=None):
     from tools.book_programs import build_all
     ok = True
     for name, main, startup, fetches in build_all():
         ok &= lint_one(f"{name} (main)", main, fetches=fetches,
-                       strict=strict, verbose=verbose)
+                       strict=strict, verbose=verbose, report=report)
         ok &= lint_one(f"{name} (startup)", startup, strict=strict,
-                       verbose=verbose)
+                       verbose=verbose, report=report)
     return ok
 
 
-def lint_files(paths, strict, verbose):
+def lint_files(paths, strict, verbose, report=None):
     from paddle_tpu.framework import Program
     ok = True
     for path in paths:
         with open(path) as f:
             program = Program.from_json(f.read())
-        ok &= lint_one(path, program, strict=strict, verbose=verbose)
+        ok &= lint_one(path, program, strict=strict, verbose=verbose,
+                       report=report)
     return ok
 
 
@@ -74,13 +95,25 @@ def main(argv=None):
                    help="treat warnings as fatal too")
     p.add_argument("--verbose", action="store_true",
                    help="print diagnostics even for passing programs")
+    p.add_argument("--shapes", action="store_true",
+                   help="also run static shape/dtype inference "
+                        "(FLAGS_check_shapes / paddle_tpu/analysis)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON report on stdout instead of text")
     args = p.parse_args(argv)
     if args.books == bool(args.files):
         p.error("pass either JSON files or --books (exactly one)")
+    if args.shapes:
+        import paddle_tpu as pt
+        pt.set_flags({"check_shapes": True})
+    report = [] if args.as_json else None
     if args.books:
-        ok = lint_books(args.strict, args.verbose)
+        ok = lint_books(args.strict, args.verbose, report=report)
     else:
-        ok = lint_files(args.files, args.strict, args.verbose)
+        ok = lint_files(args.files, args.strict, args.verbose,
+                        report=report)
+    if report is not None:
+        print(json.dumps({"ok": ok, "programs": report}, indent=2))
     return 0 if ok else 1
 
 

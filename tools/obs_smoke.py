@@ -114,6 +114,23 @@ def main() -> int:
     print(f"   prefix cache: repeat hit ({hits} hit admissions), "
           f"0 new compiles")
 
+    # -- static recompile prediction == observed compile tracker ------
+    # The same workload, predicted before-the-fact by the abstract
+    # model in paddle_tpu/analysis/recompile.py: round 1 admits the
+    # three prompts together, round 2 re-submits prompts[2] (whose
+    # full-block prefix is published by then). Predicted tracked_jit
+    # counts must equal the observed ones, both directions.
+    from paddle_tpu.analysis import predict_serving_compiles
+    predicted = predict_serving_compiles(
+        [[(p, 4) for p in prompts], [(prompts[2], 4)]],
+        buckets=[8, 16], max_len=32, block_size=4)
+    observed = {site: c["count"] for site, c in comp2.items()
+                if site.startswith(("serving_", "decode_", "verify_"))}
+    assert predicted == observed, (
+        f"recompile prediction drifted from the live tracker:\n"
+        f"  predicted {predicted}\n  observed  {observed}")
+    print(f"   recompile predictor: {predicted} == observed")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()

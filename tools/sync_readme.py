@@ -104,7 +104,7 @@ def sync_headline(text, check):
 
 _CHECKS_BEGIN = "<!-- BEGIN GENERATED: verifier-checks -->"
 _CHECKS_END = "<!-- END GENERATED: verifier-checks -->"
-_VERIFIER_FLAGS = ("check_program", "check_ir_passes")
+_VERIFIER_FLAGS = ("check_program", "check_ir_passes", "check_shapes")
 
 
 def render_checks_block():
@@ -129,6 +129,19 @@ def render_checks_block():
         d = defs[name]
         lines.append(bullet(
             f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
+    lines += ["", "Command line:", ""]
+    lines.append(bullet(
+        "`python tools/lint_program.py --books --shapes [--json]`",
+        "the CI sweep: verifier + static shape/dtype inference over the "
+        "eight book programs (exit 1 on ERROR diagnostics; `--json` for "
+        "structured output)."))
+    lines.append(bullet(
+        "`python tools/lint_sharding.py --preset gpt_tp --mesh dp=2,mp=2`",
+        "GSPMD sharding-rule lint (`distributed.sharding."
+        "lint_sharding_rules`): dead rules, shadowed regexes, "
+        "`_fit_spec` replicated fallbacks, unknown mesh axes, and the "
+        "per-device parameter-memory estimate — no devices needed "
+        "(the mesh is plain axis sizes)."))
     return "\n".join(lines)
 
 
