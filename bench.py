@@ -355,6 +355,18 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     engine packs more in-flight requests into the fixed pool because
     shared prefix blocks are stored once and each request pays only
     its actual need, not a full max_len row).
+
+    Unless BENCH_SERVING_ATTN=0, two more paged comparisons run:
+
+    - FLAGS_serving_attn_impl pallas vs xla on the same workload (the
+      fused paged-decode kernel vs the gather-compose reference). The
+      token streams must match exactly; the >=1.5x tokens/s target is
+      asserted on TPU only — on CPU the kernel runs under the Pallas
+      interpreter, so only parity is meaningful there.
+    - FLAGS_serving_kv_dtype int8 vs f32 at EQUAL KV pool bytes: the
+      int8 pool holds ~4x the blocks, so the engine packs >=1.8x the
+      concurrent requests into the same memory (asserted; concurrency
+      is a scheduling fact, valid on any backend).
     """
     import jax
 
@@ -506,6 +518,103 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
                 "prefix_hit_rate": st.get("prefix_hit_rate"),
                 "prefix_hit_requests": st.get("prefix_hit_requests"),
             }
+        attn_cmp = None
+        kv_quant_cmp = None
+        if os.environ.get("BENCH_SERVING_ATTN", "1") != "0":
+            bs = int(os.environ.get("BENCH_SERVING_BLOCK", "8"))
+            on_tpu = getattr(dev, "platform", "cpu") == "tpu"
+
+            def serve_paged(ps, impl, kv_dtype="f32", slots=None,
+                            num_blocks=None, mnt=new_tokens):
+                pt.set_flags({"serving_attn_impl": impl,
+                              "serving_kv_dtype": kv_dtype})
+                eng = ServingEngine(
+                    model, max_slots=slots or batch, max_len=seq,
+                    max_queue=len(ps) + (slots or batch), paged=True,
+                    block_size=bs, num_blocks=num_blocks,
+                    prefix_cache=False)
+                rs = [eng.submit(p, max_new_tokens=mnt) for p in ps]
+                peak = 0
+                while eng._queue or eng._active:
+                    eng.step()
+                    peak = max(peak, len(eng._active))
+                assert all(rq.state == "done" for rq in rs)
+                return rs, eng, peak
+
+            try:
+                # -- pallas fused kernel vs XLA gather-compose --------
+                r = np.random.RandomState(6)
+                attn_ps = prompts(nreq, r)
+                warm = prompts(batch, np.random.RandomState(7))
+                serve_paged(warm, "xla")       # compile outside window
+                t0 = time.perf_counter()
+                x_reqs, _, _ = serve_paged(attn_ps, "xla")
+                x_dt = time.perf_counter() - t0
+                serve_paged(warm, "pallas")
+                t0 = time.perf_counter()
+                f_reqs, _, _ = serve_paged(attn_ps, "pallas")
+                f_dt = time.perf_counter() - t0
+                for a, b2 in zip(x_reqs, f_reqs):
+                    assert a.output_ids == b2.output_ids, \
+                        "pallas paged decode diverged from the XLA " \
+                        "reference"
+                x_toks = sum(len(rq.tokens) for rq in x_reqs)
+                f_toks = sum(len(rq.tokens) for rq in f_reqs)
+                attn_speedup = (f_toks / f_dt) / (x_toks / x_dt)
+                if on_tpu and os.environ.get(
+                        "BENCH_SERVING_ATTN_ASSERT", "1") != "0":
+                    assert attn_speedup >= 1.5, (
+                        f"fused paged kernel speedup {attn_speedup:.2f}x "
+                        "< 1.5x target")
+                attn_cmp = {
+                    "xla_tokens_per_sec": round(x_toks / x_dt, 1),
+                    "pallas_tokens_per_sec": round(f_toks / f_dt, 1),
+                    "speedup": round(attn_speedup, 2),
+                    "token_parity": True,
+                    "interpret_mode": not on_tpu,
+                }
+
+                # -- int8 vs f32 concurrency at EQUAL pool bytes ------
+                hd = cfg.hidden_size // cfg.num_heads
+                f32_block_bytes = cfg.num_heads * bs * hd * 4
+                int8_block_bytes = cfg.num_heads * (bs * hd + 4)
+                L = min(max_prompt, 2 * bs)       # uniform prompt length
+                mnt8 = min(new_tokens, seq - L)
+                blocks_per_req = -(-(L + mnt8) // bs)
+                f32_blocks = batch * blocks_per_req + 1
+                int8_blocks = (f32_blocks - 1) * f32_block_bytes \
+                    // int8_block_bytes + 1
+                r = np.random.RandomState(8)
+                nq8 = max(nreq, 6 * batch)
+                q_ps = [r.randint(1, cfg.vocab_size, size=L).tolist()
+                        for _ in range(nq8)]
+                slots8 = nq8                      # pool is the binding cap
+                f_out, _, f_peak = serve_paged(
+                    q_ps, "xla", "f32", slots=slots8,
+                    num_blocks=f32_blocks, mnt=mnt8)
+                q_out, q_eng, q_peak = serve_paged(
+                    q_ps, "xla", "int8", slots=slots8,
+                    num_blocks=int8_blocks, mnt=mnt8)
+                gain = q_peak / max(f_peak, 1)
+                assert gain >= 1.8, (
+                    f"int8 concurrency gain {gain:.2f}x < 1.8x at equal "
+                    f"pool bytes ({f_peak} -> {q_peak} concurrent)")
+                parity = sum(a.output_ids == b2.output_ids
+                             for a, b2 in zip(f_out, q_out))
+                kv_quant_cmp = {
+                    "pool_bytes": f32_blocks * f32_block_bytes,
+                    "f32_blocks": f32_blocks,
+                    "int8_blocks": int8_blocks,
+                    "f32_max_concurrent": f_peak,
+                    "int8_max_concurrent": q_peak,
+                    "concurrency_gain": round(gain, 2),
+                    "token_parity_requests": f"{parity}/{nq8}",
+                    "kv_quant_max_abs_err":
+                        q_eng.stats().get("kv_quant_max_abs_err"),
+                }
+            finally:
+                pt.set_flags({"serving_attn_impl": "xla",
+                              "serving_kv_dtype": "f32"})
     except Exception as e:
         msg = str(e)
         if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
@@ -536,6 +645,10 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
         out["spec"] = spec
     if paged_cmp is not None:
         out["paged"] = paged_cmp
+    if attn_cmp is not None:
+        out["attn"] = attn_cmp
+    if kv_quant_cmp is not None:
+        out["kv_quant"] = kv_quant_cmp
     # full observability snapshot (counters + histogram percentiles +
     # compile records, never raw samples) rides along in BENCH_*.json
     from paddle_tpu import observability

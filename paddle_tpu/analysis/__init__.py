@@ -17,11 +17,12 @@ from .abstract_interp import (AbstractVar, InferContext, InferError,
                               InterpretResult, abstract_eval_op,
                               interpret_program)
 from .recompile import (ExecutorCompilePredictor, RecompilePredictor,
-                        feed_signature, predict_serving_compiles)
+                        feed_signature, merge_compile_counts,
+                        predict_serving_compiles)
 
 __all__ = [
     "AbstractVar", "InferContext", "InferError", "InterpretResult",
     "abstract_eval_op", "interpret_program",
     "ExecutorCompilePredictor", "RecompilePredictor", "feed_signature",
-    "predict_serving_compiles",
+    "merge_compile_counts", "predict_serving_compiles",
 ]

@@ -26,6 +26,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 __all__ = [
     "RecompilePredictor", "ExecutorCompilePredictor",
     "feed_signature", "predict_serving_compiles",
+    "merge_compile_counts",
 ]
 
 
@@ -114,7 +115,8 @@ def predict_serving_compiles(
         request_rounds: Iterable[Sequence[Tuple[Sequence[int], int]]], *,
         buckets: Sequence[int], max_len: int, paged: bool = True,
         block_size: int = 16, prefix_cache: bool = True,
-        spec_tokens: int = 0) -> Dict[str, int]:
+        spec_tokens: int = 0, attn_impl: str = "xla",
+        kv_dtype: str = "f32") -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -137,7 +139,28 @@ def predict_serving_compiles(
       (``max_new_tokens > 1``) — with ``spec_tokens`` K > 0 the engine
       takes the verify path exclusively, so the compile lands on
       ``verify_step[_paged]{k=K}`` instead.
+
+    ``attn_impl`` (``FLAGS_serving_attn_impl``) and ``kv_dtype``
+    (``FLAGS_serving_kv_dtype``) are part of the compiled steps' cache
+    key — the step caches are keyed on the flags version, and the int8
+    pool changes every step's input signature — but they do NOT change
+    the per-site compile counts *within* one settings phase: the same
+    sites trace the same number of times whichever lowering and pool
+    dtype they trace with. A workload that flips settings mid-run is
+    two phases; predict each phase separately and sum the site counts
+    with :func:`merge_compile_counts` (that is exactly how
+    ``tracked_jit`` accumulates counts across retraces at one site).
     """
+    for val, ok, flag in ((attn_impl, ("xla", "pallas"),
+                           "attn_impl"),
+                          (kv_dtype, ("f32", "bf16", "int8"),
+                           "kv_dtype")):
+        if val not in ok:
+            raise ValueError(f"{flag} must be one of {ok}, got {val!r}")
+    if kv_dtype != "f32" and not paged:
+        raise ValueError(
+            f"kv_dtype={kv_dtype!r} requires paged=True (the engine "
+            "rejects non-f32 dense caches)")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}
@@ -182,3 +205,16 @@ def predict_serving_compiles(
         else:
             counts[f"decode_step{suffix}"] = 1
     return counts
+
+
+def merge_compile_counts(*phase_counts: Dict[str, int]) -> Dict[str, int]:
+    """Sum per-site compile counts across settings phases (e.g. an
+    xla/f32 warm-up followed by a pallas/int8 run after ``set_flags``
+    bumped the flags version): ``tracked_jit`` keeps one counter per
+    site name across retraces, so the observed count at each site is
+    the sum of the per-phase predictions."""
+    merged: Dict[str, int] = {}
+    for counts in phase_counts:
+        for site, n in counts.items():
+            merged[site] = merged.get(site, 0) + int(n)
+    return merged

@@ -249,6 +249,27 @@ define_flag("serving_num_blocks", 0,
             "oversubscribe memory and rely on short requests + "
             "prefix sharing (admission blocks head-of-line when the "
             "pool runs dry).")
+define_flag("serving_attn_impl", "xla",
+            "Paged decode/verify/prefill attention implementation: "
+            "'xla' composes block_gather + masked softmax (the "
+            "reference oracle); 'pallas' runs the fused paged "
+            "decode-attention kernel (ops/pallas/paged_attention.py) "
+            "that walks each request's block table inside the kernel — "
+            "gather + QK^T + online softmax + V-accumulate in one "
+            "pass, never materializing the gathered cache. Greedy "
+            "output is token-identical either way (the tested "
+            "contract). On CPU backends the kernel runs in Pallas "
+            "interpreter mode.")
+define_flag("serving_kv_dtype", "f32",
+            "Paged serving KV pool element type: 'f32', 'bf16' (half "
+            "the bytes, plain cast), or 'int8' (quarter the bytes: "
+            "per-block-per-head absmax scales stored alongside the "
+            "pools, quantize on block_scatter_write, dequantize "
+            "inside the attention kernel/reference). Smaller KV bytes "
+            "per block => more blocks at a fixed pool budget => more "
+            "concurrent requests. Greedy top-1 output on the bench "
+            "models is unchanged; the max-abs dequant error is "
+            "tracked per engine (serving_kv_dequant_max_abs_err).")
 define_flag("serving_prefix_cache", True,
             "Paged serving: cache full prompt blocks under a rolling "
             "token-prefix hash so a repeated system prompt prefills "

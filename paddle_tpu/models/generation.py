@@ -129,18 +129,46 @@ def verify_step(model, spec_tokens: int):
     return ent
 
 
+def _wrap_pools(pools):
+    """Lift raw per-layer pool tuples into Tensors, generically over
+    the tuple width: (k, v) float pools or (k, v, k_scale, v_scale)
+    int8 pools — the attention layer dispatches on the width."""
+    return [tuple(Tensor(a, stop_gradient=True) for a in layer)
+            for layer in pools]
+
+
+def _unwrap_pools(newp):
+    """Strip Tensors from a forward's returned caches and split off
+    the quantization-error scalar int8 layers append (5th element):
+    returns ``(pools, max_qerr)`` with ``max_qerr`` the max over
+    layers (exact 0.0 for float pools, so the step's return structure
+    is identical across KV dtypes)."""
+    qerr = jnp.zeros((), jnp.float32)
+    pools = []
+    for layer in newp:
+        vals = [t.value for t in layer]
+        if len(vals) == 5:
+            qerr = jnp.maximum(qerr, vals[4])
+            vals = vals[:4]
+        pools.append(tuple(vals))
+    return pools, qerr
+
+
 def decode_step_paged(model):
     """The block-paged sibling of :func:`decode_step`.
 
     Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn``
     maps ``(tokens [b] i32, pos [b] i32, tables [b, T] i32, pools
-    [(k, v) block arrays])`` to ``(next_tokens [b] i32, last_logits
-    [b, V], new_pools)``. Identical semantics to ``decode_step`` — each
-    row's token is written at its own offset, now routed through the
-    row's block table into the shared [num_blocks, h, block_size, d]
-    pools — with the same compile-once contract: pools AND tables are
-    fixed-shape jit inputs, so block remapping (admission, prefix
-    sharing, COW) never retraces.
+    [per-layer block arrays])`` to ``(next_tokens [b] i32, last_logits
+    [b, V], new_pools, max_qerr)``. Identical semantics to
+    ``decode_step`` — each row's token is written at its own offset,
+    now routed through the row's block table into the shared
+    [num_blocks, h, block_size, d] pools — with the same compile-once
+    contract: pools AND tables are fixed-shape jit inputs, so block
+    remapping (admission, prefix sharing, COW) never retraces. Pools
+    are (k, v) pairs or int8 (k, v, k_scale, v_scale) 4-tuples;
+    ``max_qerr`` is the int8 path's max-abs dequantization error over
+    the rows written this step (0.0 for float pools).
     """
     from .. import flags as _flags
     from ..observability import compile_tracker as _ct
@@ -150,13 +178,13 @@ def decode_step_paged(model):
 
     def _step(tokens, pos, tables, pools):
         with no_grad():
-            tpools = [(Tensor(k, stop_gradient=True),
-                       Tensor(v, stop_gradient=True)) for k, v in pools]
-            logits, newp = model(_t(tokens[:, None]), cache=tpools,
+            logits, newp = model(_t(tokens[:, None]),
+                                 cache=_wrap_pools(pools),
                                  cache_pos=pos, block_tables=tables)
         lg = logits.value[:, -1]
         nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return nxt, lg, [(c[0].value, c[1].value) for c in newp]
+        pools_out, qerr = _unwrap_pools(newp)
+        return nxt, lg, pools_out, qerr
 
     fn = _ct.tracked_jit("decode_step_paged", _step)
     ent = {"fn": fn, "traces": fn.traces,
@@ -173,7 +201,9 @@ def verify_step_paged(model, spec_tokens: int):
     verify step — rejected rows are stale pool contents past the
     row's valid length, hidden by the position mask (blocks stay
     reserved, so rollback across a block boundary is pure host-side
-    length arithmetic). Compiled once per (model, K).
+    length arithmetic). Compiled once per (model, K). Returns shaped
+    like :func:`decode_step_paged`: ``(next [b, K+1] i32, logits
+    [b, K+1, V], new_pools, max_qerr)``.
     """
     from .. import flags as _flags
     k = int(spec_tokens)
@@ -189,14 +219,12 @@ def verify_step_paged(model, spec_tokens: int):
 
     def _step(tokens, pos, tables, pools):
         with no_grad():
-            tpools = [(Tensor(kk, stop_gradient=True),
-                       Tensor(vv, stop_gradient=True))
-                      for kk, vv in pools]
-            logits, newp = model(_t(tokens), cache=tpools,
+            logits, newp = model(_t(tokens), cache=_wrap_pools(pools),
                                  cache_pos=pos, block_tables=tables)
         lg = logits.value                                # [b, K+1, V]
         nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return nxt, lg, [(c[0].value, c[1].value) for c in newp]
+        pools_out, qerr = _unwrap_pools(newp)
+        return nxt, lg, pools_out, qerr
 
     from ..observability import compile_tracker as _ct
     fn = _ct.tracked_jit("verify_step_paged", _step,

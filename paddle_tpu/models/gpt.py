@@ -118,27 +118,69 @@ class GPTAttention(Layer):
             # same compile-once contract as the slotted path below,
             # with per-request memory paid in blocks instead of a full
             # max_len row. Inference-only by construction.
+            # The cache tuple's width selects the storage format
+            # structurally (no dtype flag reaches the model): 2 wide is
+            # a float pool, 4 wide is int8 codes + per-block-per-head
+            # absmax scales (written by block_scatter_write_quant; an
+            # int8 step returns a 5th element, the max-abs dequant error
+            # of the rows just written, which the engine surfaces as a
+            # drift metric). FLAGS_serving_attn_impl picks the read
+            # path: 'xla' composes gather (+ dequant) with the masked
+            # softmax — the correctness oracle — while 'pallas' streams
+            # blocks through the fused paged-attention kernel without
+            # materializing the gathered cache. Read at trace time: the
+            # compiled step caches key on the flags version, so
+            # flipping the flag retraces instead of going stale.
+            from .. import flags as _flags
             from ..ops.attention_ops import (block_gather,
+                                             block_gather_dequant,
                                              block_scatter_write,
+                                             block_scatter_write_quant,
                                              decode_attention_mask)
-            kp, vp = cache[0].value, cache[1].value
             pos = jnp.asarray(cache_pos, jnp.int32)
             if pos.ndim == 0:
                 pos = jnp.broadcast_to(pos, (b,))
             tables = jnp.asarray(block_tables, jnp.int32)
-            kp = block_scatter_write(kp, k.value, pos, tables)
-            vp = block_scatter_write(vp, v.value, pos, tables)
-            kg = block_gather(kp, tables)        # [b, h, T*bs, d]
-            vg = block_gather(vp, tables)
-            mask = decode_attention_mask(pos, s, kg.shape[2], kg.dtype)
-            cache = (Tensor(kp, stop_gradient=True),
-                     Tensor(vp, stop_gradient=True))
-            out = run_op("fused_attention_qkv",
-                         {"Q": [q],
-                          "K": [Tensor(kg, stop_gradient=True)],
-                          "V": [Tensor(vg, stop_gradient=True)],
-                          "Mask": [Tensor(mask, stop_gradient=True)]},
-                         {"causal": False})["Out"][0]
+            quant = len(cache) >= 4
+            if quant:
+                kp, vp, ksc, vsc = (c.value for c in cache[:4])
+                kp, ksc, kerr = block_scatter_write_quant(
+                    kp, ksc, k.value, pos, tables)
+                vp, vsc, verr = block_scatter_write_quant(
+                    vp, vsc, v.value, pos, tables)
+                cache = (Tensor(kp, stop_gradient=True),
+                         Tensor(vp, stop_gradient=True),
+                         Tensor(ksc, stop_gradient=True),
+                         Tensor(vsc, stop_gradient=True),
+                         Tensor(jnp.maximum(kerr, verr),
+                                stop_gradient=True))
+            else:
+                kp, vp = cache[0].value, cache[1].value
+                ksc = vsc = None
+                kp = block_scatter_write(kp, k.value, pos, tables)
+                vp = block_scatter_write(vp, v.value, pos, tables)
+                cache = (Tensor(kp, stop_gradient=True),
+                         Tensor(vp, stop_gradient=True))
+            if _flags.get_flag("serving_attn_impl") == "pallas":
+                from ..ops.pallas.paged_attention import paged_attention
+                out = Tensor(paged_attention(q.value, kp, vp, tables, pos,
+                                             k_scale=ksc, v_scale=vsc),
+                             stop_gradient=True)
+            else:
+                if quant:
+                    kg = block_gather_dequant(kp, ksc, tables)
+                    vg = block_gather_dequant(vp, vsc, tables)
+                else:
+                    kg = block_gather(kp, tables)    # [b, h, T*bs, d]
+                    vg = block_gather(vp, tables)
+                mask = decode_attention_mask(pos, s, kg.shape[2],
+                                             kg.dtype)
+                out = run_op("fused_attention_qkv",
+                             {"Q": [q],
+                              "K": [Tensor(kg, stop_gradient=True)],
+                              "V": [Tensor(vg, stop_gradient=True)],
+                              "Mask": [Tensor(mask, stop_gradient=True)]},
+                             {"causal": False})["Out"][0]
             out = out.transpose([0, 2, 1, 3]).reshape(
                 [b, s, cfg.hidden_size])
             return self.dropout(self.out_proj(out)), cache
@@ -300,15 +342,24 @@ class GPTModel(Layer):
                    stop_gradient=True)
         return [(z, z) for _ in range(self.cfg.num_layers)]
 
-    def gen_block_pool(self, num_blocks, block_size):
+    def gen_block_pool(self, num_blocks, block_size, kv_dtype="f32"):
         """Preallocated block-paged KV pool: one
         [num_blocks, h, block_size, d] zero pair per layer, addressed
         through per-request block tables (``block_tables`` forward
         kwarg). Physical block 0 is reserved by the serving plane as
-        the trash block for padding/overflow writes."""
-        z = Tensor(jnp.zeros((num_blocks, self.cfg.num_heads, block_size,
-                              self.cfg.head_dim), jnp.float32),
-                   stop_gradient=True)
+        the trash block for padding/overflow writes. ``kv_dtype``
+        'int8' yields 4-wide layers (code pools + zeroed
+        [num_blocks, h] absmax scale pair) matching BlockKVCache's
+        int8 layout; 'bf16' halves the pool bytes without scales."""
+        shape = (num_blocks, self.cfg.num_heads, block_size,
+                 self.cfg.head_dim)
+        if kv_dtype == "int8":
+            z = Tensor(jnp.zeros(shape, jnp.int8), stop_gradient=True)
+            sc = Tensor(jnp.zeros((num_blocks, self.cfg.num_heads),
+                                  jnp.float32), stop_gradient=True)
+            return [(z, z, sc, sc) for _ in range(self.cfg.num_layers)]
+        dt = jnp.bfloat16 if kv_dtype == "bf16" else jnp.float32
+        z = Tensor(jnp.zeros(shape, dt), stop_gradient=True)
         return [(z, z) for _ in range(self.cfg.num_layers)]
 
 

@@ -43,6 +43,16 @@ INSTRUMENT_DOCS = {
         "includes the trash block and prefix-cache holds)",
     "serving_kv_blocks_free{engine=...}":
         "gauge — physical KV blocks on the free list (paged serving)",
+    "serving_attn_impl{engine=..., impl=..., kv_dtype=...}":
+        "gauge — 1 on the attention-implementation/KV-dtype series an "
+        "engine traced with (pallas fused paged kernel vs XLA-composed "
+        "reference; f32/bf16/int8 pools)",
+    "serving_kv_dequant_max_abs_err{engine=...}":
+        "gauge — high-water max-abs int8 KV dequantization error over "
+        "rows written by the compiled steps (quantization drift watch)",
+    "STAT_serving_kv_quant_writes / _rows":
+        "counters — int8-quantizing step dispatches and KV rows "
+        "quantized through them",
     "STAT_serving_prefix_hits / _misses":
         "counters — paged admissions that reused >=1 prefix-cached KV "
         "block vs prefilled from scratch (token-granular rates in "
@@ -71,6 +81,8 @@ EVENT_DOCS = {
     "serving_finish": "request retired (tokens, ttft_ms, tpot_ms)",
     "serving_shed": "request shed by backpressure/deadline",
     "serving_spec": "speculative decoding round (proposed, accepted)",
+    "serving_kv_quant": "int8 KV dequantization error reached a new "
+                        "high-water mark (max_abs_err, rows)",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

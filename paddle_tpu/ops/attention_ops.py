@@ -115,6 +115,78 @@ def block_scatter_write(pool, new, pos, tables, overflow_block=0):
     return pool.at[phys.reshape(-1), :, offset.reshape(-1)].set(rows)
 
 
+def block_scatter_write_quant(pool, scales, new, pos, tables,
+                              overflow_block=0):
+    """Quantizing variant of :func:`block_scatter_write` for the int8
+    KV pool: ``pool`` [num_blocks, h, block_size, d] int8 codes with
+    per-block-per-head absmax ``scales`` [num_blocks, h] f32. Returns
+    ``(pool, scales, max_abs_err)`` where the error scalar is the max
+    abs dequantization error over the rows just written (live rows
+    only — overflow rows routed to the trash block are excluded).
+
+    Only the statically-bounded window of blocks a write can touch
+    (``(s-1)//block_size + 2`` per request) is gathered, dequantized,
+    updated, and requantized; untouched neighbour blocks keep their
+    exact codes AND scales so repeated decode steps never drift them.
+    Scales grow monotonically (``max(old, new content absmax)``): at an
+    unchanged scale the dequantize->requantize round trip of existing
+    rows is exactly idempotent, so a block's committed rows only ever
+    re-encode when a louder row actually lands in that block.
+    """
+    pos = jnp.asarray(pos, jnp.int32)
+    b, h, s, d = new.shape
+    bs = pool.shape[2]
+    T = tables.shape[1]
+    new = jnp.asarray(new, jnp.float32)
+
+    from .quant_ops import quantize_int8, dequantize_int8
+
+    lo = pos // bs                                       # [b] first block
+    n_aff = (s - 1) // bs + 2                            # static bound
+    jblocks = lo[:, None] + jnp.arange(n_aff, dtype=jnp.int32)[None]
+    phys = jnp.take_along_axis(
+        jnp.asarray(tables, jnp.int32),
+        jnp.minimum(jblocks, T - 1), axis=1)             # [b, n_aff]
+    phys = jnp.where(jblocks < T, phys, jnp.int32(overflow_block))
+
+    codes = pool[phys]                                   # [b,n_aff,h,bs,d]
+    sc = scales[phys]                                    # [b,n_aff,h]
+    vals = dequantize_int8(codes, sc[..., None, None])   # f32
+
+    # insert the new rows at their in-window offsets (window-local
+    # position = global position - lo*bs, always within n_aff*bs)
+    win = jnp.swapaxes(vals, 2, 3).reshape(b, n_aff * bs, h, d)
+    local = (pos % bs)[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    newrows = jnp.swapaxes(new, 1, 2)                    # [b, s, h, d]
+    win = win.at[jnp.arange(b)[:, None], local].set(newrows)
+    win = jnp.swapaxes(win.reshape(b, n_aff, bs, h, d), 2, 3)
+
+    # which window blocks actually received a row this call
+    wrote = jnp.arange(n_aff, dtype=jnp.int32)[None] \
+        <= ((pos % bs) + s - 1)[:, None] // bs           # [b, n_aff]
+
+    amax = jnp.max(jnp.abs(win), axis=(3, 4))            # [b, n_aff, h]
+    new_sc = jnp.where(wrote[..., None], jnp.maximum(sc, amax), sc)
+    new_codes = jnp.where(wrote[..., None, None, None],
+                          quantize_int8(win, new_sc[..., None, None]),
+                          codes)
+
+    pool = pool.at[phys.reshape(-1)].set(
+        new_codes.reshape(b * n_aff, h, bs, d))
+    scales = scales.at[phys.reshape(-1)].set(
+        new_sc.reshape(b * n_aff, h))
+
+    # max abs dequant error over the live rows just written
+    recon = jnp.swapaxes(
+        dequantize_int8(new_codes, new_sc[..., None, None]), 2, 3)
+    recon = recon.reshape(b, n_aff * bs, h, d)
+    recon_rows = recon[jnp.arange(b)[:, None], local]    # [b, s, h, d]
+    rowpos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    live = (rowpos // bs < T)[..., None, None]
+    err = jnp.max(jnp.where(live, jnp.abs(recon_rows - newrows), 0.0))
+    return pool, scales, err
+
+
 def block_gather(pool, tables):
     """Materialize each request's logical KV row from the paged pool:
     ``pool`` [num_blocks, h, block_size, d] gathered through ``tables``
@@ -127,6 +199,46 @@ def block_gather(pool, tables):
     g = pool[jnp.asarray(tables, jnp.int32)]        # [b, T, h, bs, d]
     b, T, h, bs, d = g.shape
     return jnp.swapaxes(g, 1, 2).reshape(b, h, T * bs, d)
+
+
+def block_gather_dequant(pool, scales, tables):
+    """:func:`block_gather` for the int8 pool: gather code blocks and
+    their per-block-per-head scales through ``tables`` and dequantize to
+    f32 -> [b, h, T*block_size, d]. This is the XLA half of the int8
+    read contract; the Pallas paged kernel applies the identical
+    ``codes * scale / 127`` math per streamed block."""
+    from .quant_ops import dequantize_int8
+    tables = jnp.asarray(tables, jnp.int32)
+    g = dequantize_int8(pool[tables],
+                        scales[tables][..., None, None])  # [b,T,h,bs,d]
+    b, T, h, bs, d = g.shape
+    return jnp.swapaxes(g, 1, 2).reshape(b, h, T * bs, d)
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, pos, *,
+                              k_scale=None, v_scale=None, scale=None):
+    """XLA-composed paged decode/verify attention — the correctness
+    oracle for :func:`~paddle_tpu.ops.pallas.paged_attention.paged_attention`:
+    gather (+ dequantize when int8 scales are given) each request's
+    logical KV rows through its block table, mask everything past
+    ``pos[b] + row`` (which covers trash-block padding: positions backed
+    by the trash block sit at/beyond the reservation, hence beyond
+    ``pos + s``), softmax, V-accumulate. q: [b, h, s, d] -> [b, h, s, d].
+    """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if k_scale is not None:
+        k = block_gather_dequant(k_pool, k_scale, tables)
+        v = block_gather_dequant(v_pool, v_scale, tables)
+    else:
+        k = block_gather(k_pool, tables)
+        v = block_gather(v_pool, tables)
+    b, h, s, d = q.shape
+    mask = decode_attention_mask(pos, s, k.shape[2], q.dtype)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    return _composed_attention(q, k, v, mask, causal=False,
+                               scale=float(scale))
 
 
 def _composed_attention(q, k, v, mask, causal, scale):

@@ -8,3 +8,4 @@ numerically validated against the XLA-composed lowerings in tests.
 
 from .flash_attention import flash_attention  # noqa: F401
 from .layer_norm import fused_layer_norm  # noqa: F401
+from .paged_attention import paged_attention  # noqa: F401

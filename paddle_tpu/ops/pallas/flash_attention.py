@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .utils import interpret_mode as _interpret, pick_block
+from .utils import interpret_mode as _interpret, pad_lane_dim, pick_block
 
 NEG_INF = float("-inf")
 
@@ -268,7 +268,17 @@ def flash_attention(q, k, v, causal=False, scale=None,
             f"flash_attention: cannot tile seq_q={seq_q}, seq_k={seq_k}")
     if causal and seq_q != seq_k:
         raise ValueError("causal flash_attention requires seq_q == seq_k")
+    # head_dim rides the lane axis whole; an unaligned width is padded
+    # with zero columns (k's zero columns contribute nothing to the
+    # logits, v's produce zero output columns sliced off below) rather
+    # than rejected — pick_block's divisor rule never applies to d.
+    dp = pad_lane_dim(d)
+    if dp != d:
+        pad = [(0, 0), (0, 0), (0, dp - d)]
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
     out = _flash(q, k, v, causal, float(scale), bq, bk)
+    if dp != d:
+        out = out[..., :d]
     if squeeze:
         out = out.reshape(b, h, seq_q, d)
     return out

@@ -13,12 +13,49 @@ def interpret_mode() -> bool:
     return jax.default_backend() == "cpu"
 
 
+#: TPU vector-register geometry: the last (lane) axis tiles in units of
+#: LANE, the second-to-last (sublane) axis in units of SUBLANE (f32; bf16
+#: and int8 need 16/32 sublanes, which LANE-padding also satisfies since
+#: the kernels keep head_dim on the lane axis).
+LANE = 128
+SUBLANE = 8
+
+
 def pick_block(n: int, preferred: int, minimum: int = 8) -> int:
     """Largest power-of-two divisor of ``n`` in [minimum, preferred]
-    (Mosaic sublane alignment); 0 when none exists."""
+    (Mosaic sublane alignment); 0 when none exists.
+
+    This selects *sequence*-axis tiles only. The head_dim (lane) axis is
+    never tiled by the kernels — it rides whole — so it must NOT be fed
+    through ``pick_block``: a head_dim like 20 has no power-of-two
+    divisor >= 8 and would return 0 (an untileable-shape ValueError in
+    the callers) even though the kernel can run it fine by padding.
+    Use :func:`pad_lane_dim` for that axis instead.
+    """
     b = preferred
     while b >= minimum:
         if n % b == 0:
             return b
         b //= 2
     return 0
+
+
+def pad_lane_dim(d: int) -> int:
+    """Aligned width for a head_dim riding the lane (last) axis of a
+    kernel block: the kernels zero-pad ``d`` up to this and slice the
+    output back, instead of failing on awkward widths.
+
+    Mosaic accepts a full-extent last block dim, but relayouts and MXU
+    feeds want alignment: below one full LANE register we round up to
+    the SUBLANE granule (d=20 -> 24, cheap); at or above a full lane we
+    round to whole LANE multiples (d=150 -> 256) so the block tiles
+    registers exactly. Common head dims (32/64/128) are already aligned
+    and pass through unchanged — padding costs nothing in the standard
+    configs.
+    """
+    d = int(d)
+    if d <= 0:
+        raise ValueError(f"head_dim must be positive, got {d}")
+    if d < LANE:
+        return -(-d // SUBLANE) * SUBLANE
+    return -(-d // LANE) * LANE

@@ -258,3 +258,34 @@ def _dequantize_log(ctx, ins, attrs):
     idx = jnp.where(neg, x + 128, x)
     val = table[jnp.clip(idx, 0, table.shape[0] - 1)]
     return {"Out": [jnp.where(neg, -val, val)]}
+
+
+# ---------------------------------------------------------------------------
+# Real int8 storage helpers (serving KV cache)
+# ---------------------------------------------------------------------------
+# The registered ops above are *fake* quantization: float in, float out,
+# for QAT/PTQ simulation. The paged KV cache (serving/kv_cache.py +
+# ops/attention_ops.block_scatter_write_quant) stores actual int8 codes
+# with per-block-per-head absmax scales; these helpers are the single
+# source of the quantize/dequantize math so the write path, the XLA
+# reference attention, and the Pallas paged kernel cannot drift apart.
+
+#: int8 symmetric grid: codes in [-127, 127] (the -128 slot is unused,
+#: matching the reference's 2^(bits-1)-1 convention in _q/_qdq)
+KV_QMAX = 127.0
+
+
+def quantize_int8(x, scale):
+    """float -> int8 codes on the symmetric absmax grid.
+
+    ``scale`` broadcasts against ``x`` (per-block-per-head scales ride
+    with keepdims). Exactly idempotent through a dequantize/requantize
+    round trip at an unchanged scale — the property the incremental KV
+    block rewrite relies on (old rows re-encode to their own codes).
+    """
+    return _q(x, scale, KV_QMAX).astype(jnp.int8)
+
+
+def dequantize_int8(codes, scale):
+    """int8 codes -> float: codes * scale / KV_QMAX (broadcasting)."""
+    return codes.astype(jnp.float32) * (scale / KV_QMAX)

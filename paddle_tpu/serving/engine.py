@@ -210,7 +210,8 @@ class ServingEngine:
                  paged: Optional[bool] = None,
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -220,7 +221,9 @@ class ServingEngine:
                               "serving_spec_ngram",
                               "serving_paged", "serving_block_size",
                               "serving_num_blocks",
-                              "serving_prefix_cache"])
+                              "serving_prefix_cache",
+                              "serving_kv_dtype",
+                              "serving_attn_impl"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -253,6 +256,12 @@ class ServingEngine:
                                        self.max_len))
         self.paged = bool(paged if paged is not None
                           else g["serving_paged"])
+        self.kv_dtype = str(kv_dtype if kv_dtype is not None
+                            else g["serving_kv_dtype"])
+        # which attention lowering the compiled paged steps traced with;
+        # gpt.py re-reads the flag at trace time, so this attribute is
+        # observability (the gauge label + stats()), not the switch
+        self.attn_impl = str(g["serving_attn_impl"])
         if self.paged:
             self.cache = BlockKVCache(
                 cfg.num_layers, cfg.num_heads, cfg.head_dim,
@@ -262,8 +271,14 @@ class ServingEngine:
                 num_blocks=int(num_blocks if num_blocks is not None
                                else g["serving_num_blocks"]),
                 prefix_cache=bool(prefix_cache if prefix_cache is not None
-                                  else g["serving_prefix_cache"]))
+                                  else g["serving_prefix_cache"]),
+                kv_dtype=self.kv_dtype)
         else:
+            if self.kv_dtype != "f32":
+                raise ValueError(
+                    f"serving_kv_dtype={self.kv_dtype!r} requires the "
+                    "paged KV cache (FLAGS_serving_paged); the dense "
+                    "SlotKVCache is f32-only")
             self.cache = SlotKVCache(cfg.num_layers, cfg.num_heads,
                                      cfg.head_dim, self.max_slots,
                                      self.max_len)
@@ -306,6 +321,23 @@ class ServingEngine:
                 ).labels(engine=eid)
             self._blocks_used_g.set(self.cache.blocks_used)
             self._blocks_free_g.set(self.cache.blocks_free)
+        # which paged-attention lowering this engine runs (1 on the
+        # active impl/dtype series — the Prometheus idiom for enums)
+        _obs.gauge(
+            "serving_attn_impl",
+            "active serving attention implementation (1 on the "
+            "impl/kv_dtype series this engine traced with)"
+            ).labels(engine=eid, impl=self.attn_impl,
+                     kv_dtype=self.kv_dtype).set(1)
+        self._qerr_max = 0.0
+        self._qerr_gauge = None
+        if self.kv_dtype == "int8":
+            self._qerr_gauge = _obs.gauge(
+                "serving_kv_dequant_max_abs_err",
+                "max abs int8 KV dequantization error observed over "
+                "rows written by this engine's compiled steps"
+                ).labels(engine=eid)
+            self._qerr_gauge.set(0.0)
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int],
@@ -446,7 +478,8 @@ class ServingEngine:
         only computes its unshared suffix. Cached on the MODEL keyed
         by the full pool geometry."""
         key = ("paged", bucket, self.max_slots, self.max_len,
-               self.cache.block_size, self.cache.num_blocks)
+               self.cache.block_size, self.cache.num_blocks,
+               self.kv_dtype)
         cache = getattr(self.model, "_prefill_step_cache", None)
         if cache is None:
             cache = self.model._prefill_step_cache = {}
@@ -457,16 +490,16 @@ class ServingEngine:
         model = self.model
 
         def _prefill(ids, last, pos, tables, pools):
+            from ..models.generation import _unwrap_pools, _wrap_pools
             with no_grad():
-                tpools = [(Tensor(k, stop_gradient=True),
-                           Tensor(v, stop_gradient=True))
-                          for k, v in pools]
                 logits, newp = model(
-                    Tensor(ids, stop_gradient=True), cache=tpools,
+                    Tensor(ids, stop_gradient=True),
+                    cache=_wrap_pools(pools),
                     cache_pos=pos, block_tables=tables)
             lg = jnp.take_along_axis(logits.value,
                                      last[:, None, None], axis=1)[:, 0]
-            return lg, [(c[0].value, c[1].value) for c in newp]
+            pools_out, qerr = _unwrap_pools(newp)
+            return lg, pools_out, qerr
 
         fn = _ct.tracked_jit("serving_prefill_paged", _prefill,
                              labels={"bucket": str(bucket)})
@@ -583,8 +616,10 @@ class ServingEngine:
                 self._shed(req, err)
             if not live:
                 continue
-            lg, pools = out
+            lg, pools, qerr = out
             self.cache.set_arrays(pools)
+            self._note_qerr(qerr, sum(len(req.prompt) - shared
+                                      for req, _, shared in live))
             first = np.asarray(jnp.argmax(lg, axis=-1))
             for i, (req, row, shared) in enumerate(live):
                 self.cache.commit_prefill(row, len(req.prompt))
@@ -688,6 +723,24 @@ class ServingEngine:
                   jnp.asarray(self.cache.lengths),
                   self.cache.arrays())
 
+    def _note_qerr(self, qerr, rows: int):
+        """Surface an int8 step's max-abs dequantization error: bump
+        the quant write counters and ratchet the drift gauge (+ one
+        run-log event per new high-water mark). No-op — and no device
+        sync — for float pools (the steps return an exact 0.0)."""
+        if self.kv_dtype != "int8" or qerr is None:
+            return
+        _monitor.stat_add("STAT_serving_kv_quant_writes")
+        _monitor.stat_add("STAT_serving_kv_quant_rows", int(rows))
+        e = float(qerr)
+        if e > self._qerr_max:
+            self._qerr_max = e
+            if self._qerr_gauge is not None:
+                self._qerr_gauge.set(e)
+            if _runlog.enabled():
+                _runlog.log_event("serving_kv_quant",
+                                  max_abs_err=round(e, 6), rows=int(rows))
+
     def _decode(self) -> int:
         """One batched decode over every occupied slot. Returns how
         many tokens were produced (0 when idle/skipped)."""
@@ -699,7 +752,7 @@ class ServingEngine:
         try:
             with _monitor.stat_time("STAT_serving_decode"), \
                     _profiler.RecordEvent("serving.decode"):
-                nxt, _, arrays = RetryPolicy.from_flags(
+                out = RetryPolicy.from_flags(
                     "serving.step").call(self._decode_attempt, tokens)
         except _SkipStep:
             return 0
@@ -711,6 +764,11 @@ class ServingEngine:
                 self.cache.release(slot)
                 self._shed(req, e)
             return 0
+        if self.paged:
+            nxt, _, arrays, qerr = out
+            self._note_qerr(qerr, len(self._active))
+        else:
+            nxt, _, arrays = out
         self.cache.set_arrays(arrays)
         nxt = np.asarray(nxt)
         produced = 0
@@ -756,7 +814,7 @@ class ServingEngine:
         try:
             with _monitor.stat_time("STAT_serving_verify"), \
                     _profiler.RecordEvent("serving.verify"):
-                nxt, _, arrays = RetryPolicy.from_flags(
+                out = RetryPolicy.from_flags(
                     "serving.step").call(self._verify_attempt, tokens)
         except _SkipStep:
             return 0
@@ -766,6 +824,11 @@ class ServingEngine:
                 self.cache.release(slot)
                 self._shed(req, e)
             return 0
+        if self.paged:
+            nxt, _, arrays, qerr = out
+            self._note_qerr(qerr, (K + 1) * len(self._active))
+        else:
+            nxt, _, arrays = out
         self.cache.set_arrays(arrays)
         nxt = np.asarray(nxt)
         produced = 0
@@ -884,6 +947,10 @@ class ServingEngine:
                 round(self._spec_accepted / self._spec_proposed, 4)
                 if self._spec_proposed else None)
         out["paged"] = self.paged
+        out["attn_impl"] = self.attn_impl
+        out["kv_dtype"] = self.kv_dtype
+        if self.kv_dtype == "int8":
+            out["kv_quant_max_abs_err"] = round(self._qerr_max, 6)
         if self.paged:
             c = self.cache
             hit_t, miss_t = c.prefix_hits, c.prefix_misses

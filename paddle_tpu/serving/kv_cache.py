@@ -258,9 +258,15 @@ class BlockKVCache:
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  max_slots: int, max_len: int, block_size: int = 16,
                  num_blocks: int = 0, prefix_cache: bool = True,
-                 dtype=None):
+                 dtype=None, kv_dtype: str = "f32"):
         import jax.numpy as jnp
-        dtype = dtype or jnp.float32
+        if kv_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'f32', 'bf16' or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        if dtype is None:
+            dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                     "int8": jnp.int8}[kv_dtype]
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.max_slots = int(max_slots)
@@ -276,9 +282,21 @@ class BlockKVCache:
                 f"reserving the trash block")
         self.num_blocks = int(num_blocks)
         shape = (self.num_blocks, num_heads, self.block_size, head_dim)
-        self.layers: List[Tuple[jax.Array, jax.Array]] = [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-            for _ in range(num_layers)]
+        if kv_dtype == "int8":
+            # 4-tuple layers: int8 code pools + per-block-per-head
+            # absmax scales (ops.attention_ops.block_scatter_write_quant
+            # is the only writer; the structural 2-vs-4 tuple width is
+            # what the model forward dispatches on)
+            sshape = (self.num_blocks, num_heads)
+            self.layers: List[Tuple[jax.Array, ...]] = [
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(num_layers)]
+        else:
+            self.layers = [
+                (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(num_layers)]
         self.allocator = BlockAllocator(self.num_blocks)
         trash = self.allocator.alloc()
         assert trash == self.TRASH
@@ -405,15 +423,27 @@ class BlockKVCache:
                 return None
             taken.append(blk)
             blocks.append(blk)
+        if taken and self.kv_dtype == "int8":
+            # a reclaimed block's stale absmax scale would distort every
+            # fresh row quantized into it (scales only grow); zeroing it
+            # restarts the block's grid AND makes its leftover codes
+            # dequantize to exact 0 — and runs before the COW copy so a
+            # boundary block still inherits its source's scale below
+            idx = np.asarray(taken, np.int32)
+            self.layers = [
+                (k, v, ks.at[idx].set(0.0), vs.at[idx].set(0.0))
+                for k, v, ks, vs in self.layers]
         if cow:
             # boundary block is partially shared: copy the cached
             # block's rows into the freshly allocated private block so
             # the suffix prefill can write the remainder in place
+            # (generic over the layer tuple: int8 layers also carry the
+            # scale arrays, which copy the same way)
             src = matched[nshared].block
             dst = blocks[nshared]
             self.layers = [
-                (k.at[dst].set(k[src]), v.at[dst].set(v[src]))
-                for k, v in self.layers]
+                tuple(a.at[dst].set(a[src]) for a in layer)
+                for layer in self.layers]
         row = self._free_rows.pop(0)
         # counted here, not in _alloc_block: a failed acquire unwinds
         # its allocs, and those must not inflate the bytes/request bench
@@ -514,9 +544,11 @@ class BlockKVCache:
         self.lengths[row] = int(self.lengths[row]) - int(n)
 
     def arrays(self):
-        """The per-layer (k, v) block pools, as fed to the steps."""
+        """The per-layer block pools, as fed to the steps: (k, v)
+        tuples, or (k, v, k_scale, v_scale) for int8 pools."""
         return list(self.layers)
 
     def set_arrays(self, layers):
-        """Adopt a compiled step's returned pools."""
-        self.layers = [(k, v) for k, v in layers]
+        """Adopt a compiled step's returned pools (generic over the
+        2- or 4-wide layer tuples)."""
+        self.layers = [tuple(layer) for layer in layers]

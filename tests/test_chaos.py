@@ -499,3 +499,37 @@ def test_serving_alloc_persistent_fault_no_block_leak(_serving_model):
     assert req.state == "done" and len(req.tokens) == 3
     eng.cache.flush_prefix_cache()
     assert eng.cache.allocator.leaked() == 1
+
+
+def test_serving_alloc_shed_no_block_leak_int8(_serving_model):
+    """The all-or-nothing acquire unwind must hold for int8 pools too:
+    the 4-wide (codes + scales) layers ride the same allocator, and a
+    shed admission — injected allocator failure mid-workload — must
+    leak zero blocks. After drain + prefix flush only the trash block
+    holds a ref, and the surviving requests' outputs are exact."""
+    from paddle_tpu.models.generation import greedy_search
+    pt.set_flags({"serving_kv_dtype": "int8"})
+    try:
+        with fault_scope("serving.alloc:skip@1"):
+            eng = _serving_engine(_serving_model)
+            assert eng.paged and eng.cache.kv_dtype == "int8"
+            assert len(eng.cache.layers[0]) == 4
+            reqs = [eng.submit([1, 2, 3], max_new_tokens=3),
+                    eng.submit([4, 5], max_new_tokens=3),
+                    eng.submit([6, 7, 8], max_new_tokens=3)]
+            eng.run_until_idle()
+            states = [r.state for r in reqs]
+            assert states.count("shed") == 1, states
+            assert states.count("done") == 2, states
+            for r in reqs:
+                if r.state != "done":
+                    continue
+                ref = greedy_search(
+                    _serving_model, np.asarray([r.prompt]),
+                    max_new_tokens=3,
+                    cache_len=eng.max_len)[0].tolist()
+                assert r.output_ids == ref
+        eng.cache.flush_prefix_cache()
+        assert eng.cache.allocator.leaked() == 1  # the trash block only
+    finally:
+        pt.set_flags({"serving_kv_dtype": "f32"})

@@ -204,6 +204,7 @@ def test_roi_align_batch_routing():
     np.testing.assert_allclose(out.ravel(), [1.0, 2.0, 2.0], rtol=1e-6)
 
 
+@pytest.mark.slow
 class TestRoiAlignGrad(OpTest):
     op_type = "roi_align"
 

@@ -126,6 +126,7 @@ def test_model_fit_evaluate_predict_save_load(tmp_path):
     np.testing.assert_allclose(again["acc"], final["acc"], rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_mobilenet_v1_v2_forward_and_train():
     """MobileNetV1/V2 (vision/models/mobilenetv{1,2}.py parity): forward
     shapes + one to_static train step moves the loss."""

@@ -97,6 +97,7 @@ def test_ctr_models_learn_auc(cls):
     assert auc.accumulate() > 0.9, auc.accumulate()
 
 
+@pytest.mark.slow
 def test_ernie_tp_loss_parity_vs_unsharded():
     """ERNIE shards with the transformer-generic TP rules: per-step
     loss parity vs the unsharded step (the configs[3] axis)."""

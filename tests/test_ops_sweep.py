@@ -18,6 +18,7 @@ from op_test import OpTest
 RNG = np.random.RandomState(7)
 
 
+@pytest.mark.slow
 class TestConv2DTranspose(OpTest):
     op_type = "conv2d_transpose"
 

@@ -23,6 +23,11 @@ class TestInterp1D3D(OpTest):
         self.attrs = {"out_w": 16}
         self.check_output(atol=2e-2, rtol=2e-2)
 
+    # the grad checks below finite-difference 5-D/im2col/pooling ops
+    # under x64+highest precision — tens of seconds each on one CPU;
+    # `slow` keeps the capped tier-1 run inside its budget while ci.sh
+    # step 4 (full suite, no marker filter) still runs them
+    @pytest.mark.slow
     def test_trilinear_interp(self):
         self.op_type = "trilinear_interp_v2"
         # exactness check: resizing a constant field is identity
@@ -56,6 +61,7 @@ class TestGridSampler(OpTest):
     def test_bilinear_border_noalign(self):
         self._run(False, "bilinear", "border", "border")
 
+    @pytest.mark.slow
     def test_grad(self):
         x = RNG.randn(1, 2, 4, 4).astype(np.float64)
         grid = RNG.uniform(-0.9, 0.9, (1, 3, 3, 2)).astype(np.float64)
@@ -86,6 +92,7 @@ class TestAffineGrid(OpTest):
         self.check_grad(["Theta_0"], "Output_0")
 
 
+@pytest.mark.slow
 class TestAffineChannel(OpTest):
     op_type = "affine_channel"
 
@@ -100,6 +107,7 @@ class TestAffineChannel(OpTest):
         self.check_grad(["X_0"], "Out_0")
 
 
+@pytest.mark.slow
 class TestPixelShuffle(OpTest):
     op_type = "pixel_shuffle"
 
@@ -128,6 +136,7 @@ class TestSpaceToDepthShuffle(OpTest):
         self.attrs = {"blocksize": 2}
         self.check_output()
 
+    @pytest.mark.slow
     def test_shuffle_channel(self):
         self.op_type = "shuffle_channel"
         x = RNG.randn(2, 6, 3, 3)
@@ -140,6 +149,7 @@ class TestSpaceToDepthShuffle(OpTest):
         self.check_grad(["X_0"], "Out_0")
 
 
+@pytest.mark.slow
 class TestTemporalShift(OpTest):
     op_type = "temporal_shift"
 
@@ -160,6 +170,7 @@ class TestTemporalShift(OpTest):
         self.check_grad(["X_0"], "Out_0")
 
 
+@pytest.mark.slow
 class TestLrn(OpTest):
     op_type = "lrn"
 
@@ -212,6 +223,7 @@ class TestCropPad(OpTest):
         self.check_grad(["Y_0"], "Out_0")
 
 
+@pytest.mark.slow
 class TestUnfold(OpTest):
     op_type = "unfold"
 
@@ -230,6 +242,7 @@ class TestUnfold(OpTest):
 
 
 class TestMaxPoolWithIndexUnpool(OpTest):
+    @pytest.mark.slow
     def test_pool2d_with_index(self):
         import torch
         self.op_type = "max_pool2d_with_index"

@@ -15,7 +15,11 @@
 #      over the paged KV cache — block tables, prefix reuse and COW
 #      token-identical with AND without the prefix cache, compile-count
 #      budget re-asserted on the paged step names, queue backpressure,
-#      block-pool exhaustion head-of-line; reduced in quick mode)
+#      block-pool exhaustion head-of-line; reduced in quick mode) plus
+#      the fused-attention oracle: the Pallas paged decode kernel with
+#      the int8 KV pool (FLAGS_serving_attn_impl=pallas +
+#      FLAGS_serving_kv_dtype=int8, interpret mode on CPU) must stay
+#      token-identical to the XLA/f32 engine and sequential greedy
 #   7. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
@@ -81,11 +85,15 @@ if [[ "${1:-}" != "quick" ]]; then
   # to sequential greedy with the prefix cache on AND off, plus the
   # dense paged=False baseline and the paged compile-count pins
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+  echo "   fused paged kernel + int8 KV oracle (Pallas interpret mode)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_paged_attention.py -q
 else
   echo "== 6/12 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv or block_allocator \
 or paged_engine_matches or dense_engine_still or prefix_reuse"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_paged_attention.py -q \
+    -k "engine_pallas_matches or kernel_matches_reference_int8"
 fi
 
 echo "== 7/12 speculative decoding gate"

@@ -10,8 +10,14 @@ Asserts, end to end through the observability plane:
     (the PR 3/4 invariants, regression-locked via the new plane);
   - a repeated prompt scores a prefix-cache hit (STAT_serving_prefix_hits)
     without adding a single compile;
+  - rerunning the same workload with FLAGS_serving_attn_impl=pallas +
+    FLAGS_serving_kv_dtype=int8 (fused paged kernel in interpret mode,
+    quantized KV pool) stays token-identical, retraces each site exactly
+    once (flags-version keying), and the merged two-phase recompile
+    prediction still equals the live tracker;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
-    carries serving, fault, compile, and KV block-pool metrics;
+    carries serving, fault, compile, KV block-pool, attention-impl and
+    int8-quantization metrics;
   - tools/trace_summary.py consumes the emitted JSONL run log.
 
 Run from the repo root:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
@@ -120,16 +126,57 @@ def main() -> int:
     # three prompts together, round 2 re-submits prompts[2] (whose
     # full-block prefix is published by then). Predicted tracked_jit
     # counts must equal the observed ones, both directions.
-    from paddle_tpu.analysis import predict_serving_compiles
+    from paddle_tpu.analysis import (merge_compile_counts,
+                                     predict_serving_compiles)
+    workload = [[(p, 4) for p in prompts], [(prompts[2], 4)]]
     predicted = predict_serving_compiles(
-        [[(p, 4) for p in prompts], [(prompts[2], 4)]],
-        buckets=[8, 16], max_len=32, block_size=4)
+        workload, buckets=[8, 16], max_len=32, block_size=4)
     observed = {site: c["count"] for site, c in comp2.items()
                 if site.startswith(("serving_", "decode_", "verify_"))}
     assert predicted == observed, (
         f"recompile prediction drifted from the live tracker:\n"
         f"  predicted {predicted}\n  observed  {observed}")
     print(f"   recompile predictor: {predicted} == observed")
+
+    # -- pallas + int8 phase: same workload, fused kernel + quantized
+    # KV pool. set_flags bumps the flags version, so each site retraces
+    # exactly once; outputs must stay token-identical and the merged
+    # two-phase prediction must equal the tracker.
+    pt.set_flags({"serving_attn_impl": "pallas",
+                  "serving_kv_dtype": "int8"})
+    try:
+        eng2 = ServingEngine(model, max_slots=3, max_len=32,
+                             buckets=[8, 16], max_queue=16, block_size=4)
+        reqs2 = [eng2.submit(p, max_new_tokens=4) for p in prompts]
+        eng2.run_until_idle()
+        rep2 = eng2.submit(prompts[2], max_new_tokens=4)
+        eng2.run_until_idle()
+        for a, b in zip(reqs + [rep], reqs2 + [rep2]):
+            assert a.output_ids == b.output_ids, (
+                f"pallas+int8 diverged on request {b.id}: "
+                f"{a.output_ids} vs {b.output_ids}")
+        st2 = eng2.stats()
+        assert st2["attn_impl"] == "pallas" and st2["kv_dtype"] == "int8"
+        assert st2["kv_quant_max_abs_err"] > 0.0, st2
+        writes = monitor.stat_get("STAT_serving_kv_quant_writes")
+        assert writes >= 1, writes
+        predicted2 = predict_serving_compiles(
+            workload, buckets=[8, 16], max_len=32, block_size=4,
+            attn_impl="pallas", kv_dtype="int8")
+        merged = merge_compile_counts(predicted, predicted2)
+        comp3 = observability.compiles()
+        observed3 = {site: c["count"] for site, c in comp3.items()
+                     if site.startswith(("serving_", "decode_",
+                                         "verify_"))}
+        assert merged == observed3, (
+            f"two-phase recompile prediction drifted:\n"
+            f"  predicted {merged}\n  observed  {observed3}")
+        print(f"   pallas+int8: token-identical, max_abs_err="
+              f"{st2['kv_quant_max_abs_err']}, merged prediction == "
+              f"observed")
+    finally:
+        pt.set_flags({"serving_attn_impl": "xla",
+                      "serving_kv_dtype": "f32"})
 
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
@@ -146,7 +193,9 @@ def main() -> int:
     for needle in ("STAT_serving_tokens", "STAT_fault_exec_step",
                    "STAT_guardian_skipped", "xla_compiles",
                    "serving_ttft_seconds", "serving_kv_blocks_used",
-                   "serving_kv_blocks_free", "STAT_serving_prefix_hits"):
+                   "serving_kv_blocks_free", "STAT_serving_prefix_hits",
+                   "serving_attn_impl", "serving_kv_dequant_max_abs_err",
+                   "STAT_serving_kv_quant_writes"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 

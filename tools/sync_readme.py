@@ -304,6 +304,27 @@ def render_serving_block():
         "plus token-granular `prefix_hit_rate` from",
         "`STAT_serving_prefix_hits` / `_misses`.",
         "",
+        "The paged decode/verify hot path has two lowerings, selected",
+        "by `FLAGS_serving_attn_impl`: `xla` composes gather ->",
+        "masked-softmax attention from the block pool, while `pallas`",
+        "runs the fused `ops.pallas.paged_attention` kernel — the block",
+        "table is scalar-prefetched and each grid step streams ONE",
+        "physical KV block from the pool into VMEM through the table",
+        "lookup (flash-style online softmax; the `[b, h, capacity, d]`",
+        "gathered view is never materialized). Both lowerings are",
+        "token-identical by construction and CI oracle. Independently,",
+        "`FLAGS_serving_kv_dtype=int8` quantizes the KV pool to int8",
+        "codes with per-block-per-head absmax scales (~4x more KV",
+        "positions in the same pool bytes): writes go through a",
+        "quantizing scatter whose scales only grow — committed codes",
+        "never drift when quieter rows land later — and both lowerings",
+        "apply the identical `codes * scale / 127` dequantization.",
+        "The engine reports the high-water dequantization error as",
+        "`kv_quant_max_abs_err` in `stats()` and as the",
+        "`serving_kv_dequant_max_abs_err` gauge on `GET /metrics`.",
+        "`BENCH_MODEL=serving` measures pallas-vs-xla tokens/s and the",
+        "int8-vs-f32 max-concurrency gain at equal pool bytes.",
+        "",
         "Flags:",
         "",
     ]
