@@ -77,7 +77,13 @@ class ExecutorCompilePredictor(RecompilePredictor):
     def would_compile(self, program, feeds: Dict[str, Any],
                       fetch_list: Sequence[str] = (),
                       scope=None, *,
-                      flags_version: Optional[int] = None) -> bool:
+                      flags_version: Optional[int] = None,
+                      mesh_shape: Optional[Tuple[int, ...]] = None
+                      ) -> bool:
+        """``mesh_shape``: the device-mesh geometry a run compiles
+        under (None = single device) — a different mesh is a different
+        executable even when program/feeds/scope all match, so it is a
+        cache-key component like the flags version."""
         if flags_version is None:
             from .. import flags as _flags
             flags_version = _flags.version()
@@ -86,7 +92,9 @@ class ExecutorCompilePredictor(RecompilePredictor):
         key = (id(program), getattr(program, "_version", 0),
                feed_signature(feeds),
                tuple(str(f) for f in fetch_list),
-               id(scope), scope_names, flags_version)
+               id(scope), scope_names, flags_version,
+               None if mesh_shape is None else
+               tuple(int(d) for d in mesh_shape))
         return self.observe(self.SITE, key)
 
 
@@ -116,7 +124,9 @@ def predict_serving_compiles(
         buckets: Sequence[int], max_len: int, paged: bool = True,
         block_size: int = 16, prefix_cache: bool = True,
         spec_tokens: int = 0, attn_impl: str = "xla",
-        kv_dtype: str = "f32") -> Dict[str, int]:
+        kv_dtype: str = "f32",
+        mesh_shape: Optional[Tuple[int, int]] = None,
+        n_replicas: int = 1) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -150,6 +160,18 @@ def predict_serving_compiles(
     two phases; predict each phase separately and sum the site counts
     with :func:`merge_compile_counts` (that is exactly how
     ``tracked_jit`` accumulates counts across retraces at one site).
+
+    ``mesh_shape`` (``FLAGS_serving_mesh``: the (data, model) serving
+    mesh an engine's steps compile under) and ``n_replicas``
+    (``FLAGS_serving_replicas``: data-parallel engines behind a
+    ReplicaRouter) are the two scale-out cache-key components. Like
+    ``attn_impl``/``kv_dtype``, neither changes per-site counts within
+    a phase: a mesh engine's entries live under a *new* unified-cache
+    key (one extra compile per site — a separate phase to merge), while
+    replicas share one model and therefore one step cache, so N
+    replicas compile each step once, total — ``n_replicas`` never
+    multiplies counts, which is precisely the invariant worth asserting
+    statically.
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -161,6 +183,18 @@ def predict_serving_compiles(
         raise ValueError(
             f"kv_dtype={kv_dtype!r} requires paged=True (the engine "
             "rejects non-f32 dense caches)")
+    if mesh_shape is not None:
+        dims = tuple(int(d) for d in mesh_shape)
+        if len(dims) != 2 or any(d < 1 for d in dims):
+            raise ValueError(
+                f"mesh_shape must be a (data, model) pair of positive "
+                f"ints, got {mesh_shape!r}")
+        if not paged:
+            raise ValueError(
+                "mesh_shape requires paged=True (mesh-sharded serving "
+                "runs on the paged KV cache)")
+    if int(n_replicas) < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

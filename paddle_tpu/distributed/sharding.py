@@ -110,24 +110,114 @@ GPT_TENSOR_PARALLEL_RULES = ShardingRules([
     (r"out_proj\.weight$", P("mp", None)),
     (r"fc2\.weight$", P("mp", None)),
     (r"wte\.weight$", P("mp", None)),
+])
+
+# Encoder families (ERNIE/BERT, nn.MultiHeadAttention /
+# TransformerEncoderLayer names). Kept as a separate table: fusing it
+# into the GPT rules left 4 dead rules (encoder names absent from GPT)
+# and 2 shadowed ones (unanchored `v_proj.weight$` also matches
+# `qkv_proj.weight` but always lost to the GPT rule above).
+ENCODER_TENSOR_PARALLEL_RULES = ShardingRules([
     (r"q_proj\.weight$|k_proj\.weight$|v_proj\.weight$", P(None, "mp")),
     (r"q_proj\.bias$|k_proj\.bias$|v_proj\.bias$", P("mp")),
     (r"linear1\.weight$", P(None, "mp")),
     (r"linear1\.bias$", P("mp")),
     (r"linear2\.weight$", P("mp", None)),
-    # encoder families (ERNIE/BERT): vocab-parallel word embedding
+    # vocab-parallel word embedding
     (r"word_embeddings\.weight$", P("mp", None)),
 ])
 
-# the rule table is transformer-generic (nn.MultiHeadAttention /
-# TransformerEncoderLayer names) — the ERNIE family shards with it too
-ERNIE_TENSOR_PARALLEL_RULES = GPT_TENSOR_PARALLEL_RULES
+ERNIE_TENSOR_PARALLEL_RULES = ENCODER_TENSOR_PARALLEL_RULES
+
+# Serving-engine tensor parallelism: the GPT table re-expressed on the
+# ("data", "model") serving mesh axis names — attention heads / MLP
+# hidden column-parallel on "model", out_proj/fc2 row-parallel (GSPMD
+# inserts the psum), vocab-parallel embedding. Used by ServingEngine to
+# place params and the paged KV pool when FLAGS_serving_mesh is set.
+SERVING_TP_RULES = ShardingRules([
+    (r"qkv_proj\.weight$", P(None, "model")),
+    (r"qkv_proj\.bias$", P("model")),
+    (r"fc1\.weight$", P(None, "model")),
+    (r"fc1\.bias$", P("model")),
+    (r"out_proj\.weight$", P("model", None)),
+    (r"fc2\.weight$", P("model", None)),
+    (r"wte\.weight$", P("model", None)),
+])
 
 # ZeRO-style optimizer/param sharding over the data axis (sharding
 # stage-3 analog): shard the largest dim of every tensor over "dp".
 FULLY_SHARDED_RULES = ShardingRules([
     (r"\.weight$", P("dp")),
 ], default=P())
+
+
+def parse_serving_mesh(spec: str) -> Optional[Tuple[int, int]]:
+    """``FLAGS_serving_mesh`` syntax: ``'DATAxMODEL'`` -> ``(data,
+    model)``; empty/whitespace -> ``None`` (single-device engine)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    parts = spec.lower().split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"serving_mesh must look like '1x2' (data x model), "
+            f"got {spec!r}")
+    data, model = (int(p) for p in parts)
+    if data < 1 or model < 1:
+        raise ValueError(f"serving_mesh axes must be >= 1, got {spec!r}")
+    return data, model
+
+
+def serving_mesh(data: int = 1, model: int = 1) -> Mesh:
+    """The ``("data", "model")`` serving mesh over the first
+    ``data * model`` local devices (SNIPPETS [2] layout: replicas on
+    ``data``, tensor parallelism on ``model``)."""
+    import jax
+    import numpy as np
+    n = int(data) * int(model)
+    devs = jax.devices()
+    if len(devs) < n:
+        raise ValueError(
+            f"serving mesh {data}x{model} needs {n} devices, "
+            f"only {len(devs)} available")
+    return Mesh(np.asarray(devs[:n]).reshape(int(data), int(model)),
+                ("data", "model"))
+
+
+def mesh_cache_key(mesh: Optional[Mesh]):
+    """Hashable compile-cache key component for a mesh: ``None`` for the
+    single-device path, else (axis names, mesh shape, device ids) — so a
+    *recreated* Mesh over the same devices reuses the cache entry while
+    a different geometry gets its own compile."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def kv_pool_pspec(shape: Sequence[int]) -> PartitionSpec:
+    """PartitionSpec for one paged-KV pool array: block pools
+    ``(num_blocks, heads, block, head_dim)`` and int8 scale planes
+    ``(num_blocks, heads)`` both shard the heads axis on ``"model"``
+    (block tables index only the leading, unsharded blocks dim, so host
+    remapping never moves bytes across devices)."""
+    if len(shape) == 4:
+        return P(None, "model", None, None)
+    return P(None, "model")
+
+
+def kv_pool_shardings(mesh: Mesh, layers) -> List[tuple]:
+    """NamedSharding per array of each pool layer tuple (2-tuple f32/bf16
+    pools or 4-tuple int8 pools + scales), divisibility-fitted so a
+    heads count the mesh can't divide falls back to replicated instead
+    of failing placement."""
+    out = []
+    for layer in layers:
+        out.append(tuple(
+            NamedSharding(mesh, _fit_spec(kv_pool_pspec(a.shape), a.shape,
+                                          mesh, name="kv_pool"))
+            for a in layer))
+    return out
 
 
 def state_shardings(spec, mesh: Mesh, rules: ShardingRules):

@@ -276,6 +276,24 @@ define_flag("serving_prefix_cache", True,
             "once and later requests reference its blocks "
             "(copy-on-write at a partially shared boundary block). "
             "Idle entries are evicted LRU under pool pressure.")
+define_flag("serving_mesh", "",
+            "Tensor-parallel serving mesh as 'DATAxMODEL' (e.g. '1x2': "
+            "1-way data x 2-way model parallel within one engine "
+            "replica). Model params and the paged KV pool are placed "
+            "with NamedSharding on a ('data', 'model') mesh — attention "
+            "heads / MLP hidden sharded on 'model' per "
+            "SERVING_TP_RULES — and prefill/decode/verify run under "
+            "pjit with explicit in/out shardings. Host-side block "
+            "tables stay replicated plain inputs so block remapping "
+            "and prefix sharing never retrace. Empty (default) keeps "
+            "the engine single-device.")
+define_flag("serving_replicas", 1,
+            "Data-parallel serving replicas fronted by ReplicaRouter: "
+            "submit() routes each request to the least-loaded replica "
+            "(predicted TTFT from queue depth + free KV blocks), with "
+            "shed/drain semantics riding the resilience plane's "
+            "RetryPolicy at the serving.route fault site. 1 (default) "
+            "means a single engine with no router in front.")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

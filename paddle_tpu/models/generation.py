@@ -40,6 +40,53 @@ def _t(x, dtype=jnp.int32):
                                                   stop_gradient=True)
 
 
+def step_entry(model, key, build):
+    """The one compile cache for every per-model step executable.
+
+    Serving and decoding used to keep three ad-hoc caches
+    (``_prefill_entry*`` dicts on the engine, ``decode_step*`` /
+    ``verify_step*`` attributes here); they are unified behind this
+    single ``model._step_compile_cache`` dict so a cache entry's
+    identity is its full key — (step kind, geometry, bucket/K,
+    attn_impl, kv_dtype, mesh) — and "exactly one compile per key" is
+    one invariant instead of three. ``build()`` makes the entry (a dict
+    with at least ``fn``/``traces``); entries are validated against the
+    flag-plane version, so ``set_flags`` invalidates every step at once
+    (same contract the recompile predictor models).
+    """
+    from .. import flags as _flags
+    cache = getattr(model, "_step_compile_cache", None)
+    if cache is None:
+        cache = model._step_compile_cache = {}
+    ent = cache.get(key)
+    if ent is not None and ent["flags_version"] == _flags.version():
+        return ent
+    ent = build()
+    ent.setdefault("flags_version", _flags.version())
+    cache[key] = ent
+    return ent
+
+
+def _mesh_step_shardings(model, mesh, kv_dtype: str):
+    """(replicated, per-layer pool shardings) for a paged step under
+    ``mesh``. Pools shard the heads axis on ``"model"`` (replicated
+    fallback when the head count doesn't divide, mirroring
+    ``distributed.sharding.kv_pool_shardings`` so jit shardings always
+    agree with the engine's ``device_put`` placement); everything else
+    — tokens, positions, block tables, logits, qerr — is replicated
+    host-visible state."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    heads_ok = model.gpt.cfg.num_heads % mesh.shape["model"] == 0
+    ax = "model" if heads_ok else None
+    repl = NamedSharding(mesh, P())
+    pool = NamedSharding(mesh, P(None, ax, None, None))
+    scale = NamedSharding(mesh, P(None, ax))
+    layer = ((pool, pool, scale, scale) if kv_dtype == "int8"
+             else (pool, pool))
+    n_layers = model.gpt.cfg.num_layers
+    return repl, [layer for _ in range(n_layers)]
+
+
 def decode_step(model):
     """The per-model compiled decode step for fixed-capacity caches.
 
@@ -51,31 +98,29 @@ def decode_step(model):
     (for sampling/beam callers). ``traces["count"]`` increments once per
     XLA trace — the compile-count==1 contract is asserted in tests.
 
-    Cached on the model instance, keyed by the flag-plane version so a
-    ``set_flags`` retraces (same contract as jit.to_static). Parameters
-    are closed over as constants: decoding assumes frozen weights.
+    Cached in the unified :func:`step_entry` cache, keyed by the
+    flag-plane version so a ``set_flags`` retraces (same contract as
+    jit.to_static). Parameters are closed over as constants: decoding
+    assumes frozen weights.
     """
-    from .. import flags as _flags
     from ..observability import compile_tracker as _ct
-    ent = getattr(model, "_decode_step_cache", None)
-    if ent is not None and ent["flags_version"] == _flags.version():
-        return ent
 
-    def _step(tokens, pos, caches):
-        with no_grad():
-            tcaches = [(Tensor(k, stop_gradient=True),
-                        Tensor(v, stop_gradient=True)) for k, v in caches]
-            logits, newc = model(_t(tokens[:, None]), cache=tcaches,
-                                 cache_pos=pos)
-        lg = logits.value[:, -1]
-        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return nxt, lg, [(c[0].value, c[1].value) for c in newc]
+    def _build():
+        def _step(tokens, pos, caches):
+            with no_grad():
+                tcaches = [(Tensor(k, stop_gradient=True),
+                            Tensor(v, stop_gradient=True))
+                           for k, v in caches]
+                logits, newc = model(_t(tokens[:, None]), cache=tcaches,
+                                     cache_pos=pos)
+            lg = logits.value[:, -1]
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return nxt, lg, [(c[0].value, c[1].value) for c in newc]
 
-    fn = _ct.tracked_jit("decode_step", _step)
-    ent = {"fn": fn, "traces": fn.traces,
-           "flags_version": _flags.version()}
-    model._decode_step_cache = ent
-    return ent
+        fn = _ct.tracked_jit("decode_step", _step)
+        return {"fn": fn, "traces": fn.traces}
+
+    return step_entry(model, ("decode",), _build)
 
 
 def verify_step(model, spec_tokens: int):
@@ -96,37 +141,30 @@ def verify_step(model, spec_tokens: int):
     write offset back and the position mask hides them.
 
     Compiled once per (model, K) — the fixed K+1 query width is what
-    keeps speculative serving on a single XLA executable. Cached on
-    the model keyed by the flag-plane version, like ``decode_step``.
+    keeps speculative serving on a single XLA executable. Cached in the
+    unified :func:`step_entry` cache, like ``decode_step``.
     """
-    from .. import flags as _flags
     k = int(spec_tokens)
     if k < 1:
         raise ValueError(f"verify_step needs spec_tokens >= 1, got {k}")
-    cache = getattr(model, "_verify_step_cache", None)
-    if cache is None:
-        cache = model._verify_step_cache = {}
-    ent = cache.get(k)
-    if ent is not None and ent["flags_version"] == _flags.version():
-        return ent
 
-    def _step(tokens, pos, caches):
-        with no_grad():
-            tcaches = [(Tensor(kk, stop_gradient=True),
-                        Tensor(vv, stop_gradient=True))
-                       for kk, vv in caches]
-            logits, newc = model(_t(tokens), cache=tcaches,
-                                 cache_pos=pos)
-        lg = logits.value                                # [b, K+1, V]
-        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return nxt, lg, [(c[0].value, c[1].value) for c in newc]
+    def _build():
+        def _step(tokens, pos, caches):
+            with no_grad():
+                tcaches = [(Tensor(kk, stop_gradient=True),
+                            Tensor(vv, stop_gradient=True))
+                           for kk, vv in caches]
+                logits, newc = model(_t(tokens), cache=tcaches,
+                                     cache_pos=pos)
+            lg = logits.value                            # [b, K+1, V]
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return nxt, lg, [(c[0].value, c[1].value) for c in newc]
 
-    from ..observability import compile_tracker as _ct
-    fn = _ct.tracked_jit("verify_step", _step, labels={"k": str(k)})
-    ent = {"fn": fn, "traces": fn.traces,
-           "flags_version": _flags.version()}
-    cache[k] = ent
-    return ent
+        from ..observability import compile_tracker as _ct
+        fn = _ct.tracked_jit("verify_step", _step, labels={"k": str(k)})
+        return {"fn": fn, "traces": fn.traces}
+
+    return step_entry(model, ("verify", k), _build)
 
 
 def _wrap_pools(pools):
@@ -154,7 +192,7 @@ def _unwrap_pools(newp):
     return pools, qerr
 
 
-def decode_step_paged(model):
+def decode_step_paged(model, mesh=None, kv_dtype: str = "f32"):
     """The block-paged sibling of :func:`decode_step`.
 
     Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn``
@@ -169,31 +207,45 @@ def decode_step_paged(model):
     are (k, v) pairs or int8 (k, v, k_scale, v_scale) 4-tuples;
     ``max_qerr`` is the int8 path's max-abs dequantization error over
     the rows written this step (0.0 for float pools).
+
+    With ``mesh`` (a ``("data", "model")`` serving mesh) the step runs
+    under pjit with explicit in/out shardings: pools keep their heads
+    axis on ``"model"``, tokens/positions/tables stay replicated plain
+    inputs. ``kv_dtype`` only matters under a mesh (it picks the pool
+    tuple width for the sharding pytree); the mesh geometry is part of
+    the cache key so each mesh compiles exactly once.
     """
-    from .. import flags as _flags
+    from ..distributed.sharding import mesh_cache_key
     from ..observability import compile_tracker as _ct
-    ent = getattr(model, "_decode_step_paged_cache", None)
-    if ent is not None and ent["flags_version"] == _flags.version():
-        return ent
+    mkey = mesh_cache_key(mesh)
 
-    def _step(tokens, pos, tables, pools):
-        with no_grad():
-            logits, newp = model(_t(tokens[:, None]),
-                                 cache=_wrap_pools(pools),
-                                 cache_pos=pos, block_tables=tables)
-        lg = logits.value[:, -1]
-        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        pools_out, qerr = _unwrap_pools(newp)
-        return nxt, lg, pools_out, qerr
+    def _build():
+        def _step(tokens, pos, tables, pools):
+            with no_grad():
+                logits, newp = model(_t(tokens[:, None]),
+                                     cache=_wrap_pools(pools),
+                                     cache_pos=pos, block_tables=tables)
+            lg = logits.value[:, -1]
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            pools_out, qerr = _unwrap_pools(newp)
+            return nxt, lg, pools_out, qerr
 
-    fn = _ct.tracked_jit("decode_step_paged", _step)
-    ent = {"fn": fn, "traces": fn.traces,
-           "flags_version": _flags.version()}
-    model._decode_step_paged_cache = ent
-    return ent
+        jit_kwargs = {}
+        if mesh is not None:
+            repl, pools_sh = _mesh_step_shardings(model, mesh, kv_dtype)
+            jit_kwargs = dict(
+                in_shardings=(repl, repl, repl, pools_sh),
+                out_shardings=(repl, repl, pools_sh, repl))
+        fn = _ct.tracked_jit("decode_step_paged", _step, **jit_kwargs)
+        return {"fn": fn, "traces": fn.traces}
+
+    key = (("decode_paged",) if mkey is None
+           else ("decode_paged", mkey, kv_dtype))
+    return step_entry(model, key, _build)
 
 
-def verify_step_paged(model, spec_tokens: int):
+def verify_step_paged(model, spec_tokens: int, mesh=None,
+                      kv_dtype: str = "f32"):
     """The block-paged sibling of :func:`verify_step`: one fixed-shape
     forward scores the last committed token plus K drafts
     (``tokens [b, K+1]``) through per-row block tables. Same row
@@ -201,38 +253,42 @@ def verify_step_paged(model, spec_tokens: int):
     verify step — rejected rows are stale pool contents past the
     row's valid length, hidden by the position mask (blocks stay
     reserved, so rollback across a block boundary is pure host-side
-    length arithmetic). Compiled once per (model, K). Returns shaped
-    like :func:`decode_step_paged`: ``(next [b, K+1] i32, logits
-    [b, K+1, V], new_pools, max_qerr)``.
+    length arithmetic). Compiled once per (model, K, mesh). Returns
+    shaped like :func:`decode_step_paged`: ``(next [b, K+1] i32,
+    logits [b, K+1, V], new_pools, max_qerr)``. ``mesh`` / ``kv_dtype``
+    behave exactly as in :func:`decode_step_paged`.
     """
-    from .. import flags as _flags
+    from ..distributed.sharding import mesh_cache_key
     k = int(spec_tokens)
     if k < 1:
         raise ValueError(
             f"verify_step_paged needs spec_tokens >= 1, got {k}")
-    cache = getattr(model, "_verify_step_paged_cache", None)
-    if cache is None:
-        cache = model._verify_step_paged_cache = {}
-    ent = cache.get(k)
-    if ent is not None and ent["flags_version"] == _flags.version():
-        return ent
+    mkey = mesh_cache_key(mesh)
 
-    def _step(tokens, pos, tables, pools):
-        with no_grad():
-            logits, newp = model(_t(tokens), cache=_wrap_pools(pools),
-                                 cache_pos=pos, block_tables=tables)
-        lg = logits.value                                # [b, K+1, V]
-        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        pools_out, qerr = _unwrap_pools(newp)
-        return nxt, lg, pools_out, qerr
+    def _build():
+        def _step(tokens, pos, tables, pools):
+            with no_grad():
+                logits, newp = model(_t(tokens), cache=_wrap_pools(pools),
+                                     cache_pos=pos, block_tables=tables)
+            lg = logits.value                            # [b, K+1, V]
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            pools_out, qerr = _unwrap_pools(newp)
+            return nxt, lg, pools_out, qerr
 
-    from ..observability import compile_tracker as _ct
-    fn = _ct.tracked_jit("verify_step_paged", _step,
-                         labels={"k": str(k)})
-    ent = {"fn": fn, "traces": fn.traces,
-           "flags_version": _flags.version()}
-    cache[k] = ent
-    return ent
+        from ..observability import compile_tracker as _ct
+        jit_kwargs = {}
+        if mesh is not None:
+            repl, pools_sh = _mesh_step_shardings(model, mesh, kv_dtype)
+            jit_kwargs = dict(
+                in_shardings=(repl, repl, repl, pools_sh),
+                out_shardings=(repl, repl, pools_sh, repl))
+        fn = _ct.tracked_jit("verify_step_paged", _step,
+                             labels={"k": str(k)}, **jit_kwargs)
+        return {"fn": fn, "traces": fn.traces}
+
+    key = (("verify_paged", k) if mkey is None
+           else ("verify_paged", k, mkey, kv_dtype))
+    return step_entry(model, key, _build)
 
 
 def draft_ngram(context, k: int, max_ngram: int = 3):

@@ -50,6 +50,14 @@ INSTRUMENT_DOCS = {
     "serving_kv_dequant_max_abs_err{engine=...}":
         "gauge — high-water max-abs int8 KV dequantization error over "
         "rows written by the compiled steps (quantization drift watch)",
+    "serving_mesh_devices{engine=...}":
+        "gauge — devices an engine's compiled steps span (data x model "
+        "serving-mesh size; 1 for a single-device engine)",
+    "serving_replicas{router=...}":
+        "gauge — data-parallel engine replicas behind a ReplicaRouter",
+    "serving_queue_depth{router=..., replica=...}":
+        "gauge — requests queued + active per routed engine replica "
+        "(the router's least-loaded routing signal)",
     "STAT_serving_kv_quant_writes / _rows":
         "counters — int8-quantizing step dispatches and KV rows "
         "quantized through them",
@@ -83,6 +91,10 @@ EVENT_DOCS = {
     "serving_spec": "speculative decoding round (proposed, accepted)",
     "serving_kv_quant": "int8 KV dequantization error reached a new "
                         "high-water mark (max_abs_err, rows)",
+    "serving_route": "ReplicaRouter placed a request (request, "
+                     "replica, depth, kv_blocks_free)",
+    "serving_drain": "ReplicaRouter stopped admissions and began "
+                     "draining (replicas, queued)",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

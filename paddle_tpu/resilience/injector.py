@@ -85,6 +85,12 @@ FAULT_SITE_DOCS: Dict[str, str] = {
                      "request; blocks already taken are unwound, never "
                      "leaked), `skip` sheds the request as a simulated "
                      "allocator failure",
+    "serving.route": "ReplicaRouter.submit, once per routing attempt — "
+                     "drop/error are retried via RetryPolicy "
+                     "(exhaustion sheds that submission as "
+                     "QueueFullError backpressure), `skip` sheds it "
+                     "immediately; requests already placed on a "
+                     "replica are untouched",
 }
 FAULT_SITES: Tuple[str, ...] = tuple(FAULT_SITE_DOCS)
 

@@ -13,15 +13,23 @@ dense ``SlotKVCache`` remains as the ``paged=False`` baseline. With
 ``FLAGS_serving_spec_tokens`` = K > 0 the engine runs draft–verify
 speculative decoding: an n-gram self-drafter proposes K tokens per
 slot and one fixed-shape verify forward commits up to K+1 tokens per
-step, token-identical to the plain greedy path. See engine.py for the
-scheduler, kv_cache.py for the memory managers, http.py for the JSON
-front end.
+step, token-identical to the plain greedy path.
+
+Scaling is two orthogonal axes: ``FLAGS_serving_mesh`` runs one engine
+tensor-parallel on a ``("data", "model")`` mesh (params and the paged
+KV pool head-sharded via NamedSharding, every step under pjit), and
+``FLAGS_serving_replicas`` puts a :class:`ReplicaRouter` in front of N
+data-parallel engine replicas (least-loaded routing by queue depth +
+free KV blocks, shed/drain semantics). See engine.py for the
+scheduler, kv_cache.py for the memory managers, router.py for the
+replica front end, http.py for the JSON front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine
 from .http import ServingHTTPServer
 from .kv_cache import BlockAllocator, BlockKVCache, SlotKVCache
+from .router import ReplicaRouter
 
 __all__ = ["ServingEngine", "Request", "QueueFullError",
            "SlotKVCache", "BlockKVCache", "BlockAllocator",
-           "ServingHTTPServer"]
+           "ServingHTTPServer", "ReplicaRouter"]

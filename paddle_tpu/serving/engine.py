@@ -53,6 +53,17 @@ KV memory comes from one of two managers (``FLAGS_serving_paged``):
 - **dense**: the original :class:`SlotKVCache` (one max_len row per
   request) — the bench baseline and fallback.
 
+Mesh sharding (``FLAGS_serving_mesh`` / the ``mesh=`` argument): the
+engine runs tensor-parallel within one replica on a ``("data",
+"model")`` mesh — params placed per
+``distributed.sharding.SERVING_TP_RULES`` (attention heads / MLP
+hidden on ``"model"``), pool layers head-sharded on ``"model"``, and
+every compiled step running under pjit with explicit in/out shardings.
+Tokens, positions and block tables stay replicated plain inputs, so
+admission, prefix sharing and COW remain pure host work that never
+retraces. Data parallelism *across* engines is
+:class:`~paddle_tpu.serving.router.ReplicaRouter`'s job.
+
 Resilience: ``serving.submit`` faults reject a submission at admission
 (backpressure path); ``serving.step`` faults fire once per prefill
 attempt and per decode attempt — drop/error retry through RetryPolicy
@@ -84,8 +95,11 @@ from ..observability import compile_tracker as _ct
 from ..observability import runlog as _runlog
 from ..dygraph.tape import no_grad
 from ..dygraph.tensor import Tensor
+from ..distributed.sharding import (SERVING_TP_RULES, kv_pool_shardings,
+                                    mesh_cache_key, parse_serving_mesh,
+                                    serving_mesh)
 from ..models.generation import (decode_step, decode_step_paged,
-                                 draft_ngram, verify_step,
+                                 draft_ngram, step_entry, verify_step,
                                  verify_step_paged)
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
@@ -211,7 +225,8 @@ class ServingEngine:
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 mesh=None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -223,7 +238,8 @@ class ServingEngine:
                               "serving_num_blocks",
                               "serving_prefix_cache",
                               "serving_kv_dtype",
-                              "serving_attn_impl"])
+                              "serving_attn_impl",
+                              "serving_mesh"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -262,6 +278,23 @@ class ServingEngine:
         # gpt.py re-reads the flag at trace time, so this attribute is
         # observability (the gauge label + stats()), not the switch
         self.attn_impl = str(g["serving_attn_impl"])
+        if mesh is None:
+            dims = parse_serving_mesh(g["serving_mesh"])
+            if dims is not None:
+                mesh = serving_mesh(*dims)
+        if mesh is not None:
+            if tuple(mesh.axis_names) != ("data", "model"):
+                raise ValueError(
+                    f"serving mesh axes must be ('data', 'model'), got "
+                    f"{tuple(mesh.axis_names)}")
+            if not self.paged:
+                raise ValueError(
+                    "mesh-sharded serving requires the paged KV cache "
+                    "(FLAGS_serving_paged); the dense SlotKVCache has "
+                    "no head-sharded placement")
+        self.mesh = mesh
+        self.mesh_shape = (None if mesh is None else
+                           tuple(int(s) for s in mesh.devices.shape))
         if self.paged:
             self.cache = BlockKVCache(
                 cfg.num_layers, cfg.num_heads, cfg.head_dim,
@@ -282,6 +315,8 @@ class ServingEngine:
             self.cache = SlotKVCache(cfg.num_layers, cfg.num_heads,
                                      cfg.head_dim, self.max_slots,
                                      self.max_len)
+        if self.mesh is not None:
+            self._place_on_mesh()
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}
         self._all: List[Request] = []
@@ -329,6 +364,12 @@ class ServingEngine:
             "impl/kv_dtype series this engine traced with)"
             ).labels(engine=eid, impl=self.attn_impl,
                      kv_dtype=self.kv_dtype).set(1)
+        _obs.gauge(
+            "serving_mesh_devices",
+            "devices this engine's compiled steps span (data x model "
+            "mesh size; 1 for a single-device engine)"
+            ).labels(engine=eid).set(
+                1 if self.mesh is None else self.mesh.devices.size)
         self._qerr_max = 0.0
         self._qerr_gauge = None
         if self.kv_dtype == "int8":
@@ -338,6 +379,28 @@ class ServingEngine:
                 "rows written by this engine's compiled steps"
                 ).labels(engine=eid)
             self._qerr_gauge.set(0.0)
+
+    # -------------------------------------------------------------- mesh
+    def _place_on_mesh(self):
+        """Pin params and the block pools to the serving mesh: params
+        per ``SERVING_TP_RULES`` (heads / MLP hidden column-parallel on
+        ``"model"``), pool layers with their heads axis on ``"model"``.
+        Param placement runs once per (model, mesh) — data-parallel
+        replicas sharing one model reuse the placed params and the
+        compiled steps instead of re-placing per engine."""
+        from jax.sharding import NamedSharding
+        mesh, mkey = self.mesh, mesh_cache_key(self.mesh)
+        if getattr(self.model, "_serving_mesh_placed", None) != mkey:
+            for name, p in self.model.named_parameters():
+                spec = SERVING_TP_RULES.spec_for(name, p.value.shape,
+                                                 mesh)
+                p.value = jax.device_put(p.value,
+                                         NamedSharding(mesh, spec))
+            self.model._serving_mesh_placed = mkey
+        pools = self.cache.arrays()
+        self.cache.set_arrays([
+            tuple(jax.device_put(a, sh) for a, sh in zip(layer, shs))
+            for layer, shs in zip(pools, kv_pool_shardings(mesh, pools))])
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int],
@@ -409,35 +472,31 @@ class ServingEngine:
         prompt position plus full-capacity cache rows; rows past the
         admitted count are padding the caller discards.
 
-        Cached on the MODEL keyed by (bucket, max_slots, max_len) —
-        like ``decode_step``/``verify_step`` — so engine restarts with
-        the same geometry (benchmark reruns, rolling deploys) reuse the
-        executable instead of paying the prefill compile again."""
-        key = (bucket, self.max_slots, self.max_len)
-        cache = getattr(self.model, "_prefill_step_cache", None)
-        if cache is None:
-            cache = self.model._prefill_step_cache = {}
-        ent = cache.get(key)
-        if ent is not None and ent["flags_version"] == _flags.version():
-            self._prefill_fns[bucket] = ent
-            return ent
+        Cached in the model's unified ``step_entry`` cache keyed by
+        (bucket, max_slots, max_len) — like ``decode_step``/
+        ``verify_step`` — so engine restarts with the same geometry
+        (benchmark reruns, rolling deploys) reuse the executable
+        instead of paying the prefill compile again."""
         model, max_len, slots = self.model, self.max_len, self.max_slots
 
-        def _prefill(ids, last):
-            with no_grad():
-                cache = model.gpt.gen_fixed_cache(slots, max_len)
-                logits, newc = model(
-                    Tensor(ids, stop_gradient=True), cache=cache,
-                    cache_pos=0)
-            lg = jnp.take_along_axis(logits.value,
-                                     last[:, None, None], axis=1)[:, 0]
-            return lg, [(c[0].value, c[1].value) for c in newc]
+        def _build():
+            def _prefill(ids, last):
+                with no_grad():
+                    cache = model.gpt.gen_fixed_cache(slots, max_len)
+                    logits, newc = model(
+                        Tensor(ids, stop_gradient=True), cache=cache,
+                        cache_pos=0)
+                lg = jnp.take_along_axis(logits.value,
+                                         last[:, None, None],
+                                         axis=1)[:, 0]
+                return lg, [(c[0].value, c[1].value) for c in newc]
 
-        fn = _ct.tracked_jit("serving_prefill", _prefill,
-                             labels={"bucket": str(bucket)})
-        ent = {"fn": fn, "traces": fn.traces,
-               "flags_version": _flags.version()}
-        cache[key] = ent
+            fn = _ct.tracked_jit("serving_prefill", _prefill,
+                                 labels={"bucket": str(bucket)})
+            return {"fn": fn, "traces": fn.traces}
+
+        ent = step_entry(model, ("prefill", bucket, slots, max_len),
+                         _build)
         self._prefill_fns[bucket] = ent
         return ent
 
@@ -475,37 +534,47 @@ class ServingEngine:
         to each row's logits at its true last token plus the updated
         pools; ``pos`` is each row's write offset (its shared-prefix
         length — 0 without a prefix hit), so a prefix-cached prompt
-        only computes its unshared suffix. Cached on the MODEL keyed
-        by the full pool geometry."""
-        key = ("paged", bucket, self.max_slots, self.max_len,
+        only computes its unshared suffix. Cached in the model's
+        unified ``step_entry`` cache keyed by the full pool geometry,
+        attn impl, KV dtype, and mesh — one compile per key. Under a
+        mesh the pass runs with explicit in/out shardings: pools keep
+        their heads axis on ``"model"``; ids/last/pos/tables stay
+        replicated plain inputs so block remapping never retraces."""
+        key = ("prefill_paged", bucket, self.max_slots, self.max_len,
                self.cache.block_size, self.cache.num_blocks,
-               self.kv_dtype)
-        cache = getattr(self.model, "_prefill_step_cache", None)
-        if cache is None:
-            cache = self.model._prefill_step_cache = {}
-        ent = cache.get(key)
-        if ent is not None and ent["flags_version"] == _flags.version():
-            self._prefill_fns[bucket] = ent
-            return ent
-        model = self.model
+               self.kv_dtype, self.attn_impl,
+               mesh_cache_key(self.mesh))
+        model, mesh, kv_dtype = self.model, self.mesh, self.kv_dtype
 
-        def _prefill(ids, last, pos, tables, pools):
-            from ..models.generation import _unwrap_pools, _wrap_pools
-            with no_grad():
-                logits, newp = model(
-                    Tensor(ids, stop_gradient=True),
-                    cache=_wrap_pools(pools),
-                    cache_pos=pos, block_tables=tables)
-            lg = jnp.take_along_axis(logits.value,
-                                     last[:, None, None], axis=1)[:, 0]
-            pools_out, qerr = _unwrap_pools(newp)
-            return lg, pools_out, qerr
+        def _build():
+            def _prefill(ids, last, pos, tables, pools):
+                from ..models.generation import (_unwrap_pools,
+                                                 _wrap_pools)
+                with no_grad():
+                    logits, newp = model(
+                        Tensor(ids, stop_gradient=True),
+                        cache=_wrap_pools(pools),
+                        cache_pos=pos, block_tables=tables)
+                lg = jnp.take_along_axis(logits.value,
+                                         last[:, None, None],
+                                         axis=1)[:, 0]
+                pools_out, qerr = _unwrap_pools(newp)
+                return lg, pools_out, qerr
 
-        fn = _ct.tracked_jit("serving_prefill_paged", _prefill,
-                             labels={"bucket": str(bucket)})
-        ent = {"fn": fn, "traces": fn.traces,
-               "flags_version": _flags.version()}
-        cache[key] = ent
+            jit_kwargs = {}
+            if mesh is not None:
+                from ..models.generation import _mesh_step_shardings
+                repl, pools_sh = _mesh_step_shardings(model, mesh,
+                                                      kv_dtype)
+                jit_kwargs = dict(
+                    in_shardings=(repl, repl, repl, repl, pools_sh),
+                    out_shardings=(repl, pools_sh, repl))
+            fn = _ct.tracked_jit("serving_prefill_paged", _prefill,
+                                 labels={"bucket": str(bucket)},
+                                 **jit_kwargs)
+            return {"fn": fn, "traces": fn.traces}
+
+        ent = step_entry(model, key, _build)
         self._prefill_fns[bucket] = ent
         return ent
 
@@ -713,7 +782,8 @@ class ServingEngine:
         if kind == "skip":
             raise _SkipStep("injected skip of one decode iteration")
         if self.paged:
-            fn = decode_step_paged(self.model)["fn"]
+            fn = decode_step_paged(self.model, self.mesh,
+                                   self.kv_dtype)["fn"]
             return fn(jnp.asarray(tokens),
                       jnp.asarray(self.cache.lengths),
                       jnp.asarray(self.cache.tables),
@@ -784,7 +854,8 @@ class ServingEngine:
         if kind == "skip":
             raise _SkipStep("injected skip of one verify iteration")
         if self.paged:
-            fn = verify_step_paged(self.model, self.spec_tokens)["fn"]
+            fn = verify_step_paged(self.model, self.spec_tokens,
+                                   self.mesh, self.kv_dtype)["fn"]
             return fn(jnp.asarray(tokens),
                       jnp.asarray(self.cache.lengths),
                       jnp.asarray(self.cache.tables),
@@ -949,6 +1020,8 @@ class ServingEngine:
         out["paged"] = self.paged
         out["attn_impl"] = self.attn_impl
         out["kv_dtype"] = self.kv_dtype
+        out["mesh_shape"] = (None if self.mesh_shape is None
+                             else list(self.mesh_shape))
         if self.kv_dtype == "int8":
             out["kv_quant_max_abs_err"] = round(self._qerr_max, 6)
         if self.paged:

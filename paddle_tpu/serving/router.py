@@ -1,0 +1,236 @@
+"""ReplicaRouter — data parallelism across serving-engine replicas.
+
+Tensor parallelism *within* an engine is :class:`ServingEngine`'s mesh
+path (``FLAGS_serving_mesh``); this module is the axis orthogonal to
+it: N engine replicas behind one ``submit()`` front door
+(``FLAGS_serving_replicas``), each replica an independent scheduler
+with its own KV pool and queue. The replicas share one model object,
+so the unified per-model step cache (``models.generation.step_entry``)
+means N replicas still compile each step exactly once — scaling out
+replicas multiplies throughput, not XLA compiles (the
+``analysis.recompile`` predictor encodes exactly this: ``n_replicas``
+is a cache-key component that does NOT change per-phase counts).
+
+Routing is least-loaded by predicted time-to-first-token: a request
+lands on the replica minimizing (queued + active requests) and, on a
+tie, maximizing free KV blocks — queue depth is the dominant TTFT term
+(every queued request costs a prefill dispatch ahead of yours) and a
+dry block pool blocks admission head-of-line. A replica whose queue is
+full is skipped; when every replica is full the router sheds the
+submission with :class:`QueueFullError` (the HTTP 429 path).
+
+Resilience: every routing attempt passes the ``serving.route`` fault
+site through ``RetryPolicy.from_flags("serving.route")`` — drop/error
+retry, exhaustion and injected ``skip`` shed the submission through
+the same backpressure exit as a full queue. Requests already placed on
+a replica are never touched by router faults. ``drain()`` stops
+admissions (subsequent submits shed) and runs every replica to idle —
+the rolling-deploy exit.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import List, Optional, Sequence
+
+from .. import monitor as _monitor
+from .. import observability as _obs
+from ..observability import runlog as _runlog
+from ..resilience.injector import fault_point
+from ..resilience.retry import RetryError, RetryPolicy
+from .engine import QueueFullError, Request, ServingEngine
+
+
+class ReplicaRouter:
+    """Spread ``submit()`` over N data-parallel :class:`ServingEngine`
+    replicas.
+
+    Either pass prebuilt ``engines`` (advanced: heterogeneous
+    geometries), or a ``model`` plus ``n_replicas`` (default
+    ``FLAGS_serving_replicas``) and any :class:`ServingEngine`
+    constructor keywords, which every replica shares. The replicas
+    share the model — and therefore the compiled steps.
+    """
+
+    _router_ids = itertools.count()
+
+    def __init__(self, model=None, n_replicas: Optional[int] = None,
+                 engines: Optional[Sequence[ServingEngine]] = None,
+                 **engine_kwargs):
+        from .. import flags as _flags
+        if engines is not None:
+            if model is not None or engine_kwargs:
+                raise ValueError(
+                    "pass either prebuilt engines= or model= + engine "
+                    "kwargs, not both")
+            self.engines: List[ServingEngine] = list(engines)
+            if not self.engines:
+                raise ValueError("engines must be non-empty")
+        else:
+            if model is None:
+                raise ValueError("ReplicaRouter needs model= or engines=")
+            n = int(n_replicas if n_replicas is not None
+                    else _flags.get_flags(["serving_replicas"])
+                    ["serving_replicas"])
+            if n < 1:
+                raise ValueError(f"n_replicas must be >= 1, got {n}")
+            self.engines = [ServingEngine(model, **engine_kwargs)
+                            for _ in range(n)]
+        self._draining = False
+        self._lock = threading.Lock()
+        rid = str(next(ReplicaRouter._router_ids))
+        _obs.gauge(
+            "serving_replicas",
+            "data-parallel engine replicas behind this ReplicaRouter"
+            ).labels(router=rid).set(len(self.engines))
+        self._depth_gauges = [
+            _obs.gauge(
+                "serving_queue_depth",
+                "requests queued + active on one routed engine replica"
+                ).labels(router=rid, replica=str(i))
+            for i in range(len(self.engines))]
+        self._update_depth_gauges()
+
+    # ----------------------------------------------------------- routing
+    def _depth(self, eng: ServingEngine) -> int:
+        with eng._lock:
+            return len(eng._queue) + len(eng._active)
+
+    def _blocks_free(self, eng: ServingEngine) -> int:
+        return (eng.cache.blocks_free if eng.paged
+                else eng.cache.num_free)
+
+    def _update_depth_gauges(self):
+        for g, eng in zip(self._depth_gauges, self.engines):
+            g.set(self._depth(eng))
+
+    def _route_attempt(self, prompt, max_new_tokens, eos_token_id
+                       ) -> Request:
+        kind = fault_point("serving.route")
+        if kind == "skip":
+            _monitor.stat_add("STAT_serving_route_shed")
+            raise QueueFullError(
+                "submission shed by injected fault at serving.route")
+        # least-loaded: queue depth first (each queued request is a
+        # prefill ahead of yours -> the dominant TTFT term), free KV
+        # blocks as the tiebreak, lowest index last for determinism
+        order = sorted(
+            range(len(self.engines)),
+            key=lambda i: (self._depth(self.engines[i]),
+                           -self._blocks_free(self.engines[i]), i))
+        last_err: Optional[QueueFullError] = None
+        for i in order:
+            eng = self.engines[i]
+            try:
+                req = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                                 eos_token_id=eos_token_id)
+            except QueueFullError as e:
+                last_err = e
+                continue
+            _monitor.stat_add("STAT_serving_routed")
+            _runlog.log_event("serving_route", request=req.id,
+                              replica=i, depth=self._depth(eng),
+                              kv_blocks_free=self._blocks_free(eng))
+            self._depth_gauges[i].set(self._depth(eng))
+            return req
+        _monitor.stat_add("STAT_serving_route_shed")
+        raise last_err if last_err is not None else QueueFullError(
+            "every replica queue is full")
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None) -> Request:
+        """Route one request to the least-loaded replica; returns its
+        :class:`Request` handle. Raises :class:`QueueFullError` when
+        every replica sheds (or the router is draining) and ValueError
+        for geometry no replica can hold."""
+        with self._lock:
+            if self._draining:
+                raise QueueFullError("router is draining: submissions "
+                                     "are shed for rolling shutdown")
+        try:
+            return RetryPolicy.from_flags("serving.route").call(
+                self._route_attempt, prompt, max_new_tokens,
+                eos_token_id)
+        except RetryError as e:
+            _monitor.stat_add("STAT_serving_route_shed")
+            raise QueueFullError(
+                f"routing retries exhausted: {e}") from e
+
+    # ---------------------------------------------------------- stepping
+    def step(self) -> bool:
+        """One scheduler iteration on every replica (deterministic
+        test/benchmark path). Returns whether any replica worked."""
+        worked = False
+        for eng in self.engines:
+            worked = eng.step() or worked
+        self._update_depth_gauges()
+        return worked
+
+    @property
+    def idle(self) -> bool:
+        return all(eng.idle for eng in self.engines)
+
+    def run_until_idle(self, max_steps: int = 10_000) -> int:
+        steps = 0
+        while not self.idle:
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"router not idle after {max_steps} steps")
+        self._update_depth_gauges()
+        return steps
+
+    def drain(self, max_steps: int = 10_000) -> int:
+        """Stop admissions and run every replica to idle (rolling
+        deploy / shutdown). Later ``submit()`` calls shed with
+        :class:`QueueFullError`; already-queued requests finish."""
+        with self._lock:
+            self._draining = True
+        _runlog.log_event("serving_drain",
+                          replicas=len(self.engines),
+                          queued=[self._depth(e) for e in self.engines])
+        steps = self.run_until_idle(max_steps)
+        _monitor.stat_add("STAT_serving_drained")
+        return steps
+
+    def results(self, reqs=None, timeout: Optional[float] = None
+                ) -> List[Request]:
+        """Wait for requests across all replicas, submission order."""
+        if reqs is not None:
+            out = list(reqs)
+        else:
+            out = sorted((r for eng in self.engines
+                          for r in eng.results()), key=lambda r: r.id)
+            return out
+        for r in out:
+            if not r.wait(timeout):
+                raise TimeoutError(
+                    f"request {r.id} not finished within {timeout}s")
+        return out
+
+    def start(self):
+        for eng in self.engines:
+            eng.start()
+
+    def stop(self):
+        for eng in self.engines:
+            eng.stop()
+
+    def stats(self) -> dict:
+        """Router-level view: replica count, per-replica queue depths
+        and free KV blocks, the (shared) mesh shape, and each
+        replica's full ``stats()`` dict under ``per_replica``."""
+        depths = [self._depth(e) for e in self.engines]
+        return {
+            "replicas": len(self.engines),
+            "draining": self._draining,
+            "mesh_shape": (None if self.engines[0].mesh_shape is None
+                           else list(self.engines[0].mesh_shape)),
+            "queue_depths": depths,
+            "kv_blocks_free": [self._blocks_free(e)
+                               for e in self.engines],
+            "per_replica": [e.stats() for e in self.engines],
+        }

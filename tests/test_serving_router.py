@@ -1,0 +1,251 @@
+"""ReplicaRouter — data-parallel serving replicas (serving/router.py).
+
+Contracts: least-loaded routing actually spreads load and never
+changes tokens (each replica is a full ServingEngine, so routed
+requests must equal sequential greedy); N replicas share one model and
+therefore compile each step exactly once total; full replicas shed
+through the QueueFullError backpressure exit; ``drain()`` finishes
+queued work while shedding new admissions; and a chaos run over the
+``serving.route`` fault site finishes every non-shed request with zero
+leaked KV blocks.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.models.generation import decode_step_paged, greedy_search
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.resilience import RetryError, fault_scope
+from paddle_tpu.serving import QueueFullError, ReplicaRouter, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=97, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 97, size=n).tolist() for n in sizes]
+
+
+def _router(model, n=2, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("buckets", [8, 16])
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("block_size", 4)
+    return ReplicaRouter(model, n_replicas=n, **kw)
+
+
+def test_router_routes_and_matches_sequential_greedy(model):
+    """6 requests over 2 replicas: both replicas get work and every
+    output is token-identical to an independent greedy run."""
+    prompts = _prompts((3, 7, 5, 11, 4, 9), seed=1)
+    rt = _router(model)
+    reqs = [rt.submit(p, max_new_tokens=5) for p in prompts]
+    rt.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    per_replica = [len(eng._all) for eng in rt.engines]
+    assert all(n > 0 for n in per_replica), per_replica
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=5,
+                            cache_len=32)[0].tolist()
+        assert r.output_ids == ref, f"request {r.id} diverged"
+
+
+def test_router_least_loaded_prefers_emptier_replica(model):
+    """With replica 0 pre-loaded, the next submission must land on
+    replica 1 (depth dominates the routing key)."""
+    rt = _router(model)
+    for p in _prompts((3, 5), seed=2):
+        rt.engines[0].submit(p, max_new_tokens=2)
+    r = rt.submit(_prompts((4,), seed=3)[0], max_new_tokens=2)
+    assert r in rt.engines[1]._all
+    rt.run_until_idle()
+
+
+def test_router_replicas_share_compiled_steps(model):
+    """The unified per-model step cache: N replicas compile decode
+    exactly once total, and each prefill bucket once total."""
+    before = decode_step_paged(model)["traces"]["count"]
+    rt = _router(model, n=3)
+    for p in _prompts((2, 6, 3, 9, 5, 12), seed=4):
+        rt.submit(p, max_new_tokens=3)
+    rt.run_until_idle()
+    assert decode_step_paged(model)["traces"]["count"] - before <= 1
+    counts = {}
+    for eng in rt.engines:
+        for b, e in eng._prefill_fns.items():
+            counts[b] = e["traces"]["count"]   # shared entries: equal
+    assert all(n == 1 for n in counts.values()), counts
+
+
+def test_router_sheds_when_every_replica_is_full(model):
+    monitor.reset()
+    rt = _router(model, n=2, max_slots=1, max_queue=1)
+    for p in _prompts((3, 4), seed=5):        # one per replica queue
+        rt.submit(p, max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        rt.submit([1, 2, 3], max_new_tokens=2)
+    assert monitor.stat_get("STAT_serving_route_shed") == 1
+    rt.run_until_idle()
+    assert monitor.stat_get("STAT_serving_routed") == 2
+
+
+def test_router_drain_finishes_queued_sheds_new(model):
+    monitor.reset()
+    rt = _router(model)
+    reqs = [rt.submit(p, max_new_tokens=3)
+            for p in _prompts((3, 6, 4), seed=6)]
+    rt.drain()
+    assert all(r.state == "done" for r in reqs)
+    with pytest.raises(QueueFullError):
+        rt.submit([1, 2], max_new_tokens=2)
+    assert monitor.stat_get("STAT_serving_drained") == 1
+    assert rt.stats()["draining"] is True
+
+
+def test_router_background_threads_and_results(model):
+    rt = _router(model)
+    rt.start()
+    try:
+        reqs = [rt.submit(p, max_new_tokens=3)
+                for p in _prompts((3, 5, 4, 6), seed=7)]
+        done = rt.results(reqs, timeout=60)
+    finally:
+        rt.stop()
+    assert [r.state for r in done] == ["done"] * 4
+    assert all(len(r.tokens) == 3 for r in done)
+
+
+def test_router_stats_surface(model):
+    rt = _router(model, n=2)
+    rt.submit(_prompts((5,), seed=8)[0], max_new_tokens=2)
+    st = rt.stats()
+    assert st["replicas"] == 2 and st["draining"] is False
+    assert st["mesh_shape"] is None
+    assert len(st["queue_depths"]) == 2 and sum(st["queue_depths"]) == 1
+    assert len(st["kv_blocks_free"]) == 2
+    assert len(st["per_replica"]) == 2
+    assert all("kv_dtype" in s for s in st["per_replica"])
+    rt.run_until_idle()
+    assert sum(rt.stats()["queue_depths"]) == 0
+
+
+def test_router_validates_construction(model):
+    with pytest.raises(ValueError):
+        ReplicaRouter()                        # neither model nor engines
+    with pytest.raises(ValueError):
+        ReplicaRouter(model, n_replicas=0)
+    with pytest.raises(ValueError):
+        ReplicaRouter(engines=[])
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8])
+    with pytest.raises(ValueError):            # engines XOR model+kwargs
+        ReplicaRouter(model, engines=[eng])
+    rt = ReplicaRouter(engines=[eng])
+    assert rt.engines == [eng]
+
+
+def test_router_prebuilt_engines_roundtrip(model):
+    engines = [ServingEngine(model, max_slots=1, max_len=32,
+                             buckets=[8], block_size=4)
+               for _ in range(2)]
+    rt = ReplicaRouter(engines=engines)
+    reqs = [rt.submit(p, max_new_tokens=3)
+            for p in _prompts((3, 5), seed=9)]
+    rt.run_until_idle()
+    for p, r in zip(_prompts((3, 5), seed=9), reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=3,
+                            cache_len=32)[0].tolist()
+        assert r.output_ids == ref
+
+
+# ---------------------------------------------------------------------------
+# chaos: the serving.route fault site
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_router_chaos_skip_sheds_cleanly_zero_leaked_blocks(model):
+    """Injected `skip` at serving.route sheds some submissions as
+    QueueFullError; every accepted request still completes
+    token-identically and no replica leaks a single KV block."""
+    monitor.reset()
+    prompts = _prompts((3, 7, 5, 11, 4, 9, 6, 8), seed=10)
+    rt = _router(model, prefix_cache=False)
+    accepted, shed = [], 0
+    with fault_scope("serving.route:skip@0.4", seed=11):
+        for p in prompts:
+            try:
+                accepted.append((p, rt.submit(p, max_new_tokens=4)))
+            except QueueFullError:
+                shed += 1
+    rt.run_until_idle()
+    assert 0 < shed < len(prompts)             # the spec actually fired
+    assert shed == monitor.stat_get("STAT_serving_route_shed")
+    assert monitor.stat_get("STAT_fault_serving.route") == shed
+    for p, r in accepted:
+        assert r.state == "done"
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=4,
+                            cache_len=32)[0].tolist()
+        assert r.output_ids == ref
+    for eng in rt.engines:                     # only the trash block
+        assert eng.cache.allocator.leaked() == 1
+
+
+@pytest.mark.chaos
+def test_router_chaos_drop_is_retried_transparently(model):
+    """Injected `drop` (a ConnectionResetError) at serving.route rides
+    RetryPolicy: with attempts left, every submission still lands and
+    the retry counter proves the recovery ran."""
+    monitor.reset()
+    saved = pt.get_flags(["retry_max_attempts", "retry_base_delay",
+                          "retry_max_delay"])
+    pt.set_flags({"retry_max_attempts": 4, "retry_base_delay": 0.001,
+                  "retry_max_delay": 0.01})
+    try:
+        rt = _router(model, prefix_cache=False)
+        with fault_scope("serving.route:drop@0.5", seed=12):
+            reqs = [rt.submit(p, max_new_tokens=3)
+                    for p in _prompts((3, 6, 4, 7), seed=13)]
+        rt.run_until_idle()
+    finally:
+        pt.set_flags(saved)
+    assert all(r.state == "done" for r in reqs)
+    assert monitor.stat_get("STAT_fault_serving.route") > 0
+    assert monitor.stat_get("STAT_retry_serving.route") > 0
+    assert monitor.stat_get("STAT_serving_route_shed") == 0
+    for eng in rt.engines:
+        assert eng.cache.allocator.leaked() == 1
+
+
+@pytest.mark.chaos
+def test_router_chaos_retry_exhaustion_sheds_as_backpressure(model):
+    """Every attempt dropping -> RetryError -> shed as QueueFullError:
+    chaos at the router never raises transport errors at callers."""
+    monitor.reset()
+    saved = pt.get_flags(["retry_max_attempts", "retry_base_delay",
+                          "retry_max_delay"])
+    pt.set_flags({"retry_max_attempts": 2, "retry_base_delay": 0.001,
+                  "retry_max_delay": 0.01})
+    try:
+        rt = _router(model, prefix_cache=False)
+        with fault_scope("serving.route:drop"):   # fires every time
+            with pytest.raises(QueueFullError):
+                rt.submit([1, 2, 3], max_new_tokens=2)
+    finally:
+        pt.set_flags(saved)
+    assert monitor.stat_get("STAT_serving_route_shed") == 1
+    rt.run_until_idle()                        # nothing was admitted
+    for eng in rt.engines:
+        assert len(eng._all) == 0
+        assert eng.cache.allocator.leaked() == 1

@@ -168,18 +168,52 @@ def test_gpt_tp_preset_findings_on_2x2_mesh():
     from tools import lint_sharding as tool
     rules = tool.resolve_rules("gpt_tp")
     r = sh.lint_sharding_rules(rules, tool.build_model(), MESH)
-    # the tiny GPT decoder has no q/k/v/linear1/linear2/word_embeddings
-    # targets: those rules are dead or shadowed by the fused-qkv rules,
-    # and vocab 97 defeats wte's vocab-parallel split — all WARNINGs,
-    # so the CI gate stays green
+    # since the encoder rules (q/k/v/linear1/linear2/word_embeddings)
+    # moved to ENCODER_TENSOR_PARALLEL_RULES, every gpt_tp rule has a
+    # live GPT target: zero dead, zero shadowed.  The one remaining
+    # warning is structural — vocab 97 defeats wte's vocab-parallel
+    # split — so the CI gate stays green
     assert r.ok()
-    assert len(_by_check(r, "sharding.dead-rule")) == 4
-    assert len(_by_check(r, "sharding.shadowed-rule")) == 2
+    assert not _by_check(r, "sharding.dead-rule")
+    assert not _by_check(r, "sharding.shadowed-rule")
+    assert all(rr.matches == rr.wins > 0 for rr in r.rules
+               if rr.pattern is not None)
     fb = _by_check(r, "sharding.replicated-fallback")
     assert len(fb) == 1 and "wte.weight" in fb[0].message
     assert 0 < r.per_device_bytes < r.total_bytes
     # sharding must actually save memory: >=25% off the replicated cost
     assert r.per_device_bytes <= 0.75 * r.total_bytes
+
+
+def test_serving_tp_preset_lints_clean_on_serving_mesh():
+    from tools import lint_sharding as tool
+    rules = tool.resolve_rules("serving_tp")
+    r = sh.lint_sharding_rules(rules, tool.build_model(),
+                               {"data": 1, "model": 2})
+    # the serving preset is the gpt_tp table re-axed onto the
+    # ("data", "model") serving mesh: same liveness guarantees
+    assert r.ok()
+    assert not _by_check(r, "sharding.dead-rule")
+    assert not _by_check(r, "sharding.shadowed-rule")
+    fb = _by_check(r, "sharding.replicated-fallback")
+    assert len(fb) == 1 and "wte.weight" in fb[0].message
+    assert r.per_device_bytes <= 0.75 * r.total_bytes
+
+
+def test_encoder_tp_preset_is_dead_on_gpt():
+    # the split's flip side: the encoder MLP/embedding rules are dead
+    # on the GPT model (no linear1/linear2/word_embeddings targets) —
+    # exactly the drift the dead-rule check exists to catch.  The q/k/v
+    # alternations still fire: the unanchored 'v_proj\.weight$' branch
+    # substring-matches 'qkv_proj.weight'.
+    from tools import lint_sharding as tool
+    rules = tool.resolve_rules("encoder_tp")
+    r = sh.lint_sharding_rules(rules, tool.build_model(), MESH)
+    dead = _by_check(r, "sharding.dead-rule")
+    assert len(dead) == 4
+    assert all("linear" in d.message or "word_embeddings" in d.message
+               for d in dead)
+    assert not _by_check(r, "sharding.shadowed-rule")
 
 
 def test_lint_sharding_cli_exit_codes(capsys):
@@ -197,7 +231,13 @@ def test_lint_sharding_cli_exit_codes(capsys):
     rep = json.loads(capsys.readouterr().out)
     assert rep["ok"] and rep["mesh"] == {"dp": 2, "mp": 2}
     assert rep["per_device_bytes"] < rep["total_bytes"]
-    assert any(d["check"] == "sharding.shadowed-rule"
-               for d in rep["diagnostics"])
+    # the catch-all \.weight$ from fully_sharded loses every head-on
+    # collision to gpt_tp's specific rules yet still wins ln/wpe
+    # weights: live, so the merge reports no shadowed rules
+    assert not any(d["check"] == "sharding.shadowed-rule"
+                   for d in rep["diagnostics"])
+    catchall = [r for r in rep["rules"]
+                if r["pattern"] == r"\.weight$"][0]
+    assert 0 < catchall["wins"] < catchall["matches"]
     assert tool.main(["--preset", "gpt_tp", "--mesh", "dp=2"]) == 1
     capsys.readouterr()                       # unknown 'mp' axis: ERROR

@@ -19,7 +19,11 @@
 #      the fused-attention oracle: the Pallas paged decode kernel with
 #      the int8 KV pool (FLAGS_serving_attn_impl=pallas +
 #      FLAGS_serving_kv_dtype=int8, interpret mode on CPU) must stay
-#      token-identical to the XLA/f32 engine and sequential greedy
+#      token-identical to the XLA/f32 engine and sequential greedy;
+#      plus the mesh-serving gate: tensor-parallel pjit steps
+#      (FLAGS_serving_mesh) and the data-parallel ReplicaRouter
+#      (FLAGS_serving_replicas) token-identical to greedy with the
+#      step-compile budget shared across replicas
 #   7. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
@@ -53,11 +57,14 @@ JAX_PLATFORMS=cpu python tools/lint_program.py --books --shapes
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
 echo "== 3/12 sharding-rule lint (GSPMD pre-flight)"
-# the GPT TP table and the ZeRO-style fully-sharded table against the
-# GPT benchmark model on a 2x2 dp/mp mesh: no unknown axes (ERROR);
-# expected findings (dead encoder rules on a GPT model, shadowed
-# v_proj regex, vocab-97 divisibility fallback) stay WARNINGs
+# the GPT TP table, the ZeRO-style fully-sharded merge, and the serving
+# TP table (the mesh-sharded engine's placement rules on its
+# ("data","model") mesh) against the GPT benchmark model: no unknown
+# axes (ERROR), zero dead/shadowed rules since the encoder rules split
+# into their own table; the one expected finding (vocab-97 divisibility
+# fallback on wte) stays a WARNING
 JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp --mesh dp=2,mp=2
+JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset serving_tp --mesh data=1,model=2
 JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp+fully_sharded --mesh dp=2,mp=2 --json > /dev/null
 
 if [[ "${1:-}" != "quick" ]]; then
@@ -87,6 +94,11 @@ if [[ "${1:-}" != "quick" ]]; then
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
   echo "   fused paged kernel + int8 KV oracle (Pallas interpret mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_paged_attention.py -q
+  echo "   mesh-sharded serving gate (pjit steps + replica router)"
+  # tensor-parallel engine token-identical to greedy on the 1x1 mesh
+  # AND on a real (1,2) head-split over the virtual devices; N router
+  # replicas share one model and compile each step exactly once
+  python -m pytest tests/test_serving_mesh.py tests/test_serving_router.py -q
 else
   echo "== 6/12 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
@@ -94,6 +106,11 @@ else
 or paged_engine_matches or dense_engine_still or prefix_reuse"
   JAX_PLATFORMS=cpu python -m pytest tests/test_paged_attention.py -q \
     -k "engine_pallas_matches or kernel_matches_reference_int8"
+  echo "   mesh-sharded serving gate: reduced subset (quick mode)"
+  python -m pytest tests/test_serving_mesh.py tests/test_serving_router.py \
+    -q -m "not slow" \
+    -k "matches_sequential_greedy or unified_cache or share_compiled \
+or head_sharded or drain or chaos_skip"
 fi
 
 echo "== 7/12 speculative decoding gate"

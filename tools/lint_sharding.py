@@ -50,6 +50,8 @@ def resolve_rules(preset: str):
     from paddle_tpu.distributed import sharding as sh
     presets = {
         "gpt_tp": sh.GPT_TENSOR_PARALLEL_RULES,
+        "encoder_tp": sh.ENCODER_TENSOR_PARALLEL_RULES,
+        "serving_tp": sh.SERVING_TP_RULES,
         "fully_sharded": sh.FULLY_SHARDED_RULES,
     }
     parts = [p.strip() for p in preset.split("+") if p.strip()]
@@ -86,8 +88,9 @@ def main(argv=None):
         "lint_sharding",
         description="Static checks over sharding-rule tables.")
     ap.add_argument("--preset", default="gpt_tp",
-                    help="rule table: gpt_tp | fully_sharded, or "
-                         "'a+b' to merge (a wins) [gpt_tp]")
+                    help="rule table: gpt_tp | encoder_tp | serving_tp "
+                         "| fully_sharded, or 'a+b' to merge (a wins) "
+                         "[gpt_tp]")
     ap.add_argument("--mesh", default="dp=2,mp=2",
                     help="mesh axis sizes, axis=size,... [dp=2,mp=2]")
     ap.add_argument("--dtype-bytes", type=int, default=4,

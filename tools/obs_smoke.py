@@ -15,6 +15,11 @@ Asserts, end to end through the observability plane:
     quantized KV pool) stays token-identical, retraces each site exactly
     once (flags-version keying), and the merged two-phase recompile
     prediction still equals the live tracker;
+  - the same workload through two ReplicaRouter replicas (shared model
+    => shared step cache: two replicas compile like one engine) and
+    through a 1x1 ("data", "model") serving mesh (new mesh cache key:
+    exactly one more compile per site) stays token-identical, with the
+    merged four-phase prediction still equal to the tracker;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl and
     int8-quantization metrics;
@@ -178,6 +183,63 @@ def main() -> int:
         pt.set_flags({"serving_attn_impl": "xla",
                       "serving_kv_dtype": "f32"})
 
+    # -- mesh + replica phase: the same workload on (a) two data-
+    # parallel replicas behind the ReplicaRouter and (b) a 1x1
+    # ("data", "model") serving mesh. The finally above bumped the
+    # flags version, so the router's engines retrace each site once
+    # (one phase) — but BOTH replicas share the model and therefore
+    # the unified step cache, so two replicas add the counts of ONE
+    # engine (the n_replicas invariant). The mesh engine's steps live
+    # under a new mesh cache key: one more compile per site (a fourth
+    # phase). Outputs must stay token-identical throughout, and the
+    # four-phase merged prediction must equal the live tracker.
+    from paddle_tpu.distributed.sharding import serving_mesh
+    from paddle_tpu.serving import ReplicaRouter
+    router = ReplicaRouter(model, n_replicas=2, max_slots=3,
+                           max_len=32, buckets=[8, 16], max_queue=16,
+                           block_size=4)
+    reqs3 = [router.submit(p, max_new_tokens=4) for p in prompts]
+    router.run_until_idle()
+    rep3 = router.submit(prompts[2], max_new_tokens=4)
+    router.run_until_idle()
+    for a, b in zip(reqs + [rep], reqs3 + [rep3]):
+        assert a.output_ids == b.output_ids, (
+            f"routed replica diverged on request {b.id}: "
+            f"{a.output_ids} vs {b.output_ids}")
+    st3 = router.stats()
+    assert st3["replicas"] == 2 and len(st3["queue_depths"]) == 2, st3
+    predicted3 = predict_serving_compiles(
+        workload, buckets=[8, 16], max_len=32, block_size=4,
+        n_replicas=2)
+
+    mesh = serving_mesh(1, 1)
+    eng4 = ServingEngine(model, max_slots=3, max_len=32,
+                         buckets=[8, 16], max_queue=16, block_size=4,
+                         mesh=mesh)
+    reqs4 = [eng4.submit(p, max_new_tokens=4) for p in prompts]
+    eng4.run_until_idle()
+    rep4 = eng4.submit(prompts[2], max_new_tokens=4)
+    eng4.run_until_idle()
+    for a, b in zip(reqs + [rep], reqs4 + [rep4]):
+        assert a.output_ids == b.output_ids, (
+            f"mesh engine diverged on request {b.id}: "
+            f"{a.output_ids} vs {b.output_ids}")
+    st4 = eng4.stats()
+    assert st4["mesh_shape"] == [1, 1], st4
+    predicted4 = predict_serving_compiles(
+        workload, buckets=[8, 16], max_len=32, block_size=4,
+        mesh_shape=(1, 1))
+    merged4 = merge_compile_counts(predicted, predicted2, predicted3,
+                                   predicted4)
+    comp4 = observability.compiles()
+    observed4 = {site: c["count"] for site, c in comp4.items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+    assert merged4 == observed4, (
+        f"mesh-phase recompile prediction drifted:\n"
+        f"  predicted {merged4}\n  observed  {observed4}")
+    print(f"   mesh phase: 2 replicas + 1x1 mesh token-identical, "
+          f"merged prediction == observed ({observed4})")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -195,7 +257,8 @@ def main() -> int:
                    "serving_ttft_seconds", "serving_kv_blocks_used",
                    "serving_kv_blocks_free", "STAT_serving_prefix_hits",
                    "serving_attn_impl", "serving_kv_dequant_max_abs_err",
-                   "STAT_serving_kv_quant_writes"):
+                   "STAT_serving_kv_quant_writes", "serving_mesh_devices",
+                   "serving_replicas", "serving_queue_depth"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 

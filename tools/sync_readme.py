@@ -325,6 +325,34 @@ def render_serving_block():
         "`BENCH_MODEL=serving` measures pallas-vs-xla tokens/s and the",
         "int8-vs-f32 max-concurrency gain at equal pool bytes.",
         "",
+        "Scaling is two orthogonal axes. `FLAGS_serving_mesh=DxM` (or",
+        "`ServingEngine(mesh=...)`) runs ONE engine tensor-parallel on a",
+        "`(\"data\", \"model\")` device mesh: params and the paged KV",
+        "pool are placed with `NamedSharding` under the `serving_tp`",
+        "rule table (attention heads / MLP hidden split on `model`;",
+        "the pool's heads axis likewise), and every compiled step runs",
+        "under pjit with explicit in/out shardings while the host-side",
+        "block tables stay replicated plain inputs — block remapping",
+        "still never retraces. Tokens are bit-identical to the",
+        "single-device engine (the 1x1 mesh is a CI oracle; a real",
+        "head-split is exercised on the virtual-device mesh).",
+        "`FLAGS_serving_replicas=N` (or `serving.ReplicaRouter`) is the",
+        "data-parallel axis: N engine replicas behind one `submit()`,",
+        "routed least-loaded by queue depth with free KV blocks as the",
+        "tiebreak; full replicas shed through the same `QueueFullError`",
+        "429 path, and `drain()` stops admissions and runs every",
+        "replica to idle for rolling deploys. Replicas share the model",
+        "and therefore the per-model unified step-compile cache — N",
+        "replicas compile each step once, total, and a mesh engine pays",
+        "exactly one extra compile per step kind (its entries are keyed",
+        "on the mesh), an invariant `analysis.recompile` predicts and",
+        "`tools/obs_smoke.py` asserts against observed counts.",
+        "`engine.stats()` reports `mesh_shape`; `router.stats()` adds",
+        "per-replica queue depths and free blocks; `GET /metrics` grows",
+        "`serving_mesh_devices`, `serving_replicas` and per-replica",
+        "`serving_queue_depth` gauges, and the run log records",
+        "`serving_route` / `serving_drain` events.",
+        "",
         "Flags:",
         "",
     ]
