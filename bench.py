@@ -740,6 +740,176 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
     return 0
 
 
+def child_main_loadgen(batch: int, seq: int, steps: int) -> int:
+    """BENCH_MODEL=loadgen: goodput under SLO on open-loop traffic.
+
+    ``batch`` = engine slots, ``seq`` = per-slot KV capacity, ``steps``
+    scales the arrival window (seconds). Three phases over the SAME
+    seeded bursty arrival trace, all on gpt2-tiny (override with
+    BENCH_SERVING_GPT):
+
+    - calibrate: measure engine capacity (saturated batch drain) and
+      calm TTFT; the SLO is 3x calm p50 TTFT, the offered rate is
+      BENCH_LOADGEN_OVERLOAD x capacity (default 3 — real overload);
+    - phase A (baseline): depth-only admission with a deep queue,
+      goodput scored post-hoc against the SLO — the PR 9 behaviour;
+    - phase B (SLO-aware): predictive admission with costs pinned to
+      the calibrated values, same trace. Gate: goodput_B >= 1.2x
+      goodput_A (shedding doomed work early must buy real goodput),
+      and ZERO new serving compiles vs phase A — admission is
+      host-side. BENCH_LOADGEN_GATE=0 reports without asserting;
+    - phase C (chaos crossover): the same SLO engine under
+      FLAGS_fault_spec submit/alloc faults — goodput degrades but
+      stays > 0, zero leaked KV blocks, zero unhandled exceptions,
+      every lost request accounted as a shed.
+
+    ``vs_baseline`` is goodput_B / goodput_A.
+    """
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import observability
+    from paddle_tpu.models import GPT_CONFIGS, GPTForCausalLM
+    from paddle_tpu.resilience import fault_scope
+    from paddle_tpu.serving import ServingEngine
+    from tools.loadgen import LoadGen, warmup
+
+    dev = jax.devices()[0]
+    gpt = os.environ.get("BENCH_SERVING_GPT", "gpt2-tiny")
+    seed = int(os.environ.get("BENCH_LOADGEN_SEED", "0"))
+    overload = float(os.environ.get("BENCH_LOADGEN_OVERLOAD", "3"))
+    duration = float(os.environ.get("BENCH_LOADGEN_DURATION",
+                                    str(max(1, steps))))
+    gate = os.environ.get("BENCH_LOADGEN_GATE", "1") == "1"
+    fault_spec = os.environ.get(
+        "BENCH_LOADGEN_FAULT_SPEC",
+        "serving.submit:skip@0.1;serving.alloc:skip@0.05")
+    buckets = [max(4, seq // 4), max(8, seq // 2)]
+    pt.seed(0)
+    cfg = GPT_CONFIGS[gpt]
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng_kw = dict(max_slots=batch, max_len=seq, buckets=buckets,
+                  max_queue=64)
+    lo = 4
+    hi = max(lo, buckets[0] - 1)   # fresh prompts stay in bucket 0
+    lg_kw = dict(mode="bursty", rate=1.0, duration=duration, seed=seed,
+                 vocab_size=cfg.vocab_size, prompt_tokens=(lo, hi),
+                 new_tokens=(2, 8), priority_mix={0: 0.2, 1: 0.6,
+                                                  2: 0.2})
+
+    def serving_compiles():
+        return {site: c["count"]
+                for site, c in observability.compiles().items()
+                if site.startswith(("serving_", "decode_", "verify_"))}
+
+    try:
+        # -- calibrate: capacity + calm latency + step costs ----------
+        cal = ServingEngine(model, **eng_kw)
+        warmup(cal)
+        rng = np.random.RandomState(seed)
+        calm = []
+        for _ in range(4):        # calm TTFT: one request at a time
+            r = cal.submit(rng.randint(1, cfg.vocab_size,
+                                       size=6).tolist(),
+                           max_new_tokens=4)
+            cal.run_until_idle()
+            calm.append(r.ttft * 1e3)
+        sat = [cal.submit(rng.randint(1, cfg.vocab_size,
+                                      size=rng.randint(lo, hi + 1)
+                                      ).tolist(),
+                          max_new_tokens=4) for _ in range(8 * batch)]
+        t0 = time.perf_counter()
+        cal.run_until_idle()
+        capacity = len(sat) / (time.perf_counter() - t0)
+        slo_ms = max(25.0, 3.0 * float(np.median(calm)))
+        prefill_pin = cal._prefill_cost_ms(buckets[0]) or 1.0
+        tpot_pin = cal._tpot_cost_ms() or 0.5
+        lg_kw["rate"] = max(2.0, overload * capacity)
+
+        # -- phase A: depth-only, scored post-hoc against the SLO -----
+        eng_a = ServingEngine(model, **eng_kw)
+        warmup(eng_a)
+        rep_a = LoadGen(**lg_kw).run(eng_a, slo_ttft_ms=slo_ms)
+        compiles_a = serving_compiles()
+
+        # -- phase B: SLO-aware admission, same trace -----------------
+        eng_b = ServingEngine(model, slo_ttft_ms=slo_ms,
+                              slo_prefill_ms=prefill_pin,
+                              slo_tpot_ms=tpot_pin, **eng_kw)
+        warmup(eng_b)
+        rep_b = LoadGen(**lg_kw).run(eng_b)
+        compiles_b = serving_compiles()
+        assert compiles_b == compiles_a, (
+            f"SLO-aware admission must add ZERO compiles:\n"
+            f"  phase A {compiles_a}\n  phase B {compiles_b}")
+        goodput_a = rep_a["goodput_per_s"] or 0.0
+        goodput_b = rep_b["goodput_per_s"] or 0.0
+        ratio = round(goodput_b / goodput_a, 2) if goodput_a else None
+        if gate:
+            assert goodput_a > 0, rep_a
+            assert goodput_b >= 1.2 * goodput_a, (
+                f"SLO-aware goodput {goodput_b:.2f}/s < 1.2x depth-only "
+                f"{goodput_a:.2f}/s at offered {lg_kw['rate']:.1f}/s")
+
+        # -- phase C: chaos crossover ---------------------------------
+        with fault_scope(fault_spec, seed=seed):
+            eng_c = ServingEngine(model, slo_ttft_ms=slo_ms,
+                                  slo_prefill_ms=prefill_pin,
+                                  slo_tpot_ms=tpot_pin, **eng_kw)
+            warmup(eng_c)
+            rep_c = LoadGen(**lg_kw).run(eng_c)
+        goodput_c = rep_c["goodput_per_s"] or 0.0
+        if gate:
+            assert rep_c["exceptions"] == 0, rep_c
+            assert rep_c["leaked_kv_blocks"] == 0, rep_c
+            assert rep_c["shed"].get("fault", 0) >= 1, rep_c
+            assert goodput_c > 0, rep_c
+            accounted = (rep_c["completed"] + rep_c["shed_total"] +
+                         sum(1 for d in rep_c["decisions"]
+                             if d[0] == "invalid"))
+            assert accounted == rep_c["offered"], rep_c
+    except Exception as e:
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            sys.stderr.write("OOM: " + msg[:300] + "\n")
+            return OOM_RC
+        raise
+
+    def phase(rep):
+        return {k: rep[k] for k in
+                ("offered", "offered_rate", "completed", "shed",
+                 "shed_total", "exceptions", "slo_attainment",
+                 "goodput_per_s", "throughput_per_s", "ttft_ms_p50",
+                 "ttft_ms_p95", "leaked_kv_blocks", "makespan_s")}
+
+    out = {
+        "metric": "loadgen_goodput_per_sec",
+        "value": round(goodput_b, 2),
+        "unit": "SLO-met requests/s",
+        "vs_baseline": ratio,     # SLO-aware / depth-only goodput
+        "mode": lg_kw["mode"], "seed": seed,
+        "offered_rate": round(lg_kw["rate"], 2),
+        "capacity_per_s": round(capacity, 2),
+        "slo_ttft_ms": round(slo_ms, 2),
+        "slo_prefill_ms": round(prefill_pin, 3),
+        "slo_tpot_ms": round(tpot_pin, 3),
+        "slots": batch, "max_len": seq, "model": gpt,
+        "gate_asserted": gate,
+        "depth_only": phase(rep_a),
+        "slo_aware": phase(rep_b),
+        "chaos": dict(phase(rep_c), fault_spec=fault_spec,
+                      goodput_ratio_vs_clean=(
+                          round(goodput_c / goodput_b, 2)
+                          if goodput_b else None)),
+        "serving_compiles": compiles_b,
+        "device": getattr(dev, "device_kind", str(dev)),
+    }
+    out["observability"] = observability.snapshot()
+    print(json.dumps(out))
+    return 0
+
+
 def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
     """Measure one (model, batch, seq, steps) config; print the JSON line.
 
@@ -830,6 +1000,11 @@ def main() -> int:
         # seq = slot KV capacity; steps = requests per slot
         seq = int(os.environ.get("BENCH_SEQ", "256"))
         steps = int(os.environ.get("BENCH_STEPS", "4"))
+    if model_name == "loadgen":
+        # seq = slot KV capacity; steps = arrival window seconds
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = int(os.environ.get("BENCH_SEQ", "64"))
+        steps = int(os.environ.get("BENCH_STEPS", "2"))
 
     here = os.path.abspath(__file__)
     last_err = ""
@@ -873,6 +1048,10 @@ if __name__ == "__main__":
                                       int(sys.argv[i + 4])))
         if name == "serving":
             sys.exit(child_main_serving(int(sys.argv[i + 2]),
+                                        int(sys.argv[i + 3]),
+                                        int(sys.argv[i + 4])))
+        if name == "loadgen":
+            sys.exit(child_main_loadgen(int(sys.argv[i + 2]),
                                         int(sys.argv[i + 3]),
                                         int(sys.argv[i + 4])))
         sys.exit(child_main(name, int(sys.argv[i + 2]),

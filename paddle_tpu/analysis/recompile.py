@@ -126,7 +126,10 @@ def predict_serving_compiles(
         spec_tokens: int = 0, attn_impl: str = "xla",
         kv_dtype: str = "f32",
         mesh_shape: Optional[Tuple[int, int]] = None,
-        n_replicas: int = 1) -> Dict[str, int]:
+        n_replicas: int = 1,
+        slo_ttft_ms: float = 0.0,
+        priority_classes: Optional[Sequence[int]] = None,
+        autoscale: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -172,6 +175,17 @@ def predict_serving_compiles(
     replicas compile each step once, total — ``n_replicas`` never
     multiplies counts, which is precisely the invariant worth asserting
     statically.
+
+    ``slo_ttft_ms`` (``FLAGS_serving_slo_ttft_ms``: predicted-TTFT
+    admission), ``priority_classes`` (the distinct ``Request.priority``
+    values a workload carries) and ``autoscale`` (``(min, max)``
+    router replica bounds, ``FLAGS_serving_autoscale``) are validated
+    no-ops by design: admission, preemptive shedding, deadline sheds
+    and replica scaling are all host-side queue surgery — they decide
+    *which* requests reach the compiled steps, never what those steps
+    trace. The parameters exist so the predictor's signature mirrors
+    the engine's and so the zero-new-compiles contract is itself
+    regression-tested (predict with them == predict without).
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -195,6 +209,21 @@ def predict_serving_compiles(
                 "runs on the paged KV cache)")
     if int(n_replicas) < 1:
         raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    if float(slo_ttft_ms) < 0:
+        raise ValueError(
+            f"slo_ttft_ms must be >= 0, got {slo_ttft_ms}")
+    if priority_classes is not None:
+        pris = [int(p) for p in priority_classes]
+        if not pris or any(p < 0 for p in pris):
+            raise ValueError(
+                f"priority_classes must be a non-empty sequence of "
+                f"ints >= 0, got {priority_classes!r}")
+    if autoscale is not None:
+        lo, hi = (int(b) for b in autoscale)
+        if not (1 <= lo <= hi):
+            raise ValueError(
+                f"autoscale bounds must satisfy 1 <= min <= max, got "
+                f"{autoscale!r}")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

@@ -294,6 +294,41 @@ define_flag("serving_replicas", 1,
             "shed/drain semantics riding the resilience plane's "
             "RetryPolicy at the serving.route fault site. 1 (default) "
             "means a single engine with no router in front.")
+define_flag("serving_slo_ttft_ms", 0.0,
+            "SLO-aware admission: target time-to-first-token in ms. "
+            "When > 0, submit() predicts the newcomer's TTFT from live "
+            "queue depth, measured per-bucket prefill cost, and the "
+            "decode batch's TPOT (EWMA), and sheds the submission when "
+            "the prediction exceeds this budget (QueueFullError with "
+            "reason='slo' and a predicted-TTFT-derived retry_after_s); "
+            "queued requests whose deadline already passed are shed "
+            "before prefill instead of wasting a dispatch. 0 (default) "
+            "keeps the blunt depth-only backpressure. Admission is "
+            "pure host arithmetic: no new compiled surface either way.")
+define_flag("serving_slo_prefill_ms", 0.0,
+            "TTFT predictor: pinned per-bucket prefill cost in ms. 0 "
+            "(default) learns an EWMA from this engine's measured "
+            "prefill dispatches; pin it for deterministic admission "
+            "decisions (loadgen replay, tests).")
+define_flag("serving_slo_tpot_ms", 0.0,
+            "TTFT predictor: pinned per-output-token decode cost in "
+            "ms. 0 (default) learns an EWMA from measured decode/"
+            "verify steps; pin it for deterministic admission.")
+define_flag("serving_priority_preempt", True,
+            "Priority classes (submit(priority=), lower = more "
+            "urgent): allow an urgent submission that would otherwise "
+            "be shed (queue full / predicted SLO miss) to preempt-shed "
+            "queued strictly-lower-priority work instead. Requests "
+            "within a class keep FIFO order either way.")
+define_flag("serving_autoscale", "",
+            "ReplicaRouter autoscaling bounds as 'MIN:MAX' replicas "
+            "(e.g. '1:4'). When set, the router consults an "
+            "AutoscalePolicy each step — scale up on queue-depth / "
+            "free-KV-block / SLO-attainment pressure, scale down by "
+            "draining the emptiest replica when load subsides. "
+            "Replicas share one placed model, so scaling reuses the "
+            "compiled steps instead of retracing. Empty (default) "
+            "disables autoscaling.")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

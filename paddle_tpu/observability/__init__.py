@@ -58,6 +58,14 @@ INSTRUMENT_DOCS = {
     "serving_queue_depth{router=..., replica=...}":
         "gauge — requests queued + active per routed engine replica "
         "(the router's least-loaded routing signal)",
+    "serving_slo_attainment{engine=...}":
+        "gauge — fraction of completed requests whose first token met "
+        "the TTFT deadline (engines running with "
+        "FLAGS_serving_slo_ttft_ms; the goodput numerator)",
+    "serving_shed_total{engine=..., reason=..., priority=...}":
+        "counter — requests shed, by reason (queue_full | slo | "
+        "deadline | preempted | fault | drain) and priority class; "
+        "submit-time rejections included",
     "STAT_serving_kv_quant_writes / _rows":
         "counters — int8-quantizing step dispatches and KV rows "
         "quantized through them",
@@ -86,8 +94,10 @@ EVENT_DOCS = {
     "serving_admit": "request admitted into a KV slot (bucket, "
                      "prompt_tokens; + shared_tokens reused from the "
                      "prefix cache when paged)",
-    "serving_finish": "request retired (tokens, ttft_ms, tpot_ms)",
-    "serving_shed": "request shed by backpressure/deadline",
+    "serving_finish": "request retired (tokens, ttft_ms, tpot_ms; + "
+                      "deadline_met under a TTFT SLO)",
+    "serving_shed": "request shed (reason: queue_full | slo | deadline "
+                    "| preempted | fault | drain; priority class)",
     "serving_spec": "speculative decoding round (proposed, accepted)",
     "serving_kv_quant": "int8 KV dequantization error reached a new "
                         "high-water mark (max_abs_err, rows)",
@@ -95,6 +105,10 @@ EVENT_DOCS = {
                      "replica, depth, kv_blocks_free)",
     "serving_drain": "ReplicaRouter stopped admissions and began "
                      "draining (replicas, queued)",
+    "serving_drain_done": "ReplicaRouter drain finished (shed: "
+                          "requests given up on while draining)",
+    "serving_autoscale": "AutoscalePolicy changed the replica count "
+                         "(replicas_from, replicas_to, retiring)",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

@@ -20,16 +20,21 @@ tensor-parallel on a ``("data", "model")`` mesh (params and the paged
 KV pool head-sharded via NamedSharding, every step under pjit), and
 ``FLAGS_serving_replicas`` puts a :class:`ReplicaRouter` in front of N
 data-parallel engine replicas (least-loaded routing by queue depth +
-free KV blocks, shed/drain semantics). See engine.py for the
-scheduler, kv_cache.py for the memory managers, router.py for the
+free KV blocks, shed/drain semantics, :class:`AutoscalePolicy`-driven
+replica scaling). With ``FLAGS_serving_slo_ttft_ms`` set the engine
+admits against a predicted TTFT instead of raw queue depth — priority
+classes, preemptive shedding of queued low-priority work, and
+deadline-expired sheds before prefill; ``tools/loadgen.py`` is the
+open-loop traffic source that exercises all of it. See engine.py for
+the scheduler, kv_cache.py for the memory managers, router.py for the
 replica front end, http.py for the JSON front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine
 from .http import ServingHTTPServer
 from .kv_cache import BlockAllocator, BlockKVCache, SlotKVCache
-from .router import ReplicaRouter
+from .router import AutoscalePolicy, ReplicaRouter
 
 __all__ = ["ServingEngine", "Request", "QueueFullError",
            "SlotKVCache", "BlockKVCache", "BlockAllocator",
-           "ServingHTTPServer", "ReplicaRouter"]
+           "ServingHTTPServer", "ReplicaRouter", "AutoscalePolicy"]

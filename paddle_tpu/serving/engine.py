@@ -72,6 +72,23 @@ attempt and per decode attempt — drop/error retry through RetryPolicy
 iteration; ``serving.alloc`` faults fire per block-table acquisition
 attempt (paged), shedding that request with every taken block
 unwound. Counters land in monitor.stats() as ``STAT_serving_*``.
+
+Admission (``FLAGS_serving_slo_ttft_ms`` > 0): instead of the blunt
+queue-depth gate alone, ``submit()`` predicts the newcomer's TTFT
+from live host state — queue depth ahead of it, free decode slots,
+the per-bucket prefill dispatch cost, and the decode batch's
+per-token pace (costs pinned via ``FLAGS_serving_slo_prefill_ms`` /
+``_tpot_ms`` or learned as EWMAs over measured dispatches) — and
+sheds the submission when the prediction exceeds the SLO, with the
+prediction echoed back as the 429 Retry-After hint. Requests carry an
+integer priority class (lower = more urgent, FIFO within a class);
+an urgent submission that would otherwise be shed may preempt-shed
+queued strictly-lower-priority work, and queued requests whose TTFT
+deadline already passed are shed before prefill rather than wasting a
+dispatch. All of it is host arithmetic over host state: no new
+compiled surface, zero retraces — but the knobs are constructor/flag
+state read once at engine construction, NOT runtime ``set_flags``
+targets (that would bump the flags version and retrace every step).
 """
 
 from __future__ import annotations
@@ -107,9 +124,21 @@ from .kv_cache import BlockKVCache, SlotKVCache
 
 
 class QueueFullError(RuntimeError):
-    """Admission control: the wait queue is at FLAGS_serving_max_queue.
-    Callers shed load (HTTP maps this to 429) instead of queueing
-    unboundedly."""
+    """Admission control shed this submission. Callers back off (HTTP
+    maps it to 429) instead of queueing unboundedly.
+
+    ``reason`` says which gate fired — "queue_full" (depth
+    backpressure), "slo" (predicted TTFT beyond
+    FLAGS_serving_slo_ttft_ms), or "fault" (injected serving.submit
+    fault) — and ``retry_after_s`` is the engine's predicted-TTFT-
+    derived backoff hint (whole seconds, >= 1), which the HTTP front
+    end surfaces verbatim as the Retry-After header."""
+
+    def __init__(self, msg: str, reason: str = "queue_full",
+                 retry_after_s: Optional[int] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class _Shed(Exception):
@@ -130,21 +159,33 @@ class Request:
     (queued/running -> shed). ``output_ids`` is prompt + generated
     tokens (EOS included when hit), matching ``greedy_search`` row
     semantics token for token.
+
+    ``priority`` is an integer class, lower = more urgent (default 1);
+    requests within one class keep FIFO order. ``now`` lets the engine
+    stamp timestamps from its own clock (virtual time in loadgen
+    replays); default is the wall clock. When the engine runs with a
+    TTFT SLO, ``deadline`` is the absolute clock time the first token
+    must land by, and a shed request records why in ``shed_reason``.
     """
 
     _ids = itertools.count()
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
-                 eos_token_id: Optional[int]):
+                 eos_token_id: Optional[int], priority: int = 1,
+                 now: Optional[float] = None):
         self.id = next(Request._ids)
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.priority = int(priority)
         self.tokens: List[int] = []
         self.state = "queued"
         self.slot: Optional[int] = None
         self.error: Optional[BaseException] = None
-        self.submitted_at = time.perf_counter()
+        self.shed_reason: Optional[str] = None
+        self.submitted_at = (time.perf_counter() if now is None
+                             else float(now))
+        self.deadline: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
@@ -181,6 +222,17 @@ class Request:
             return None
         return (self.finished_at - self.first_token_at) / \
             (len(self.tokens) - 1)
+
+    @property
+    def deadline_met(self) -> Optional[bool]:
+        """Whether the first token landed inside the TTFT deadline:
+        None when no SLO was active or the verdict is still open,
+        False for a shed request (its first token never arrives)."""
+        if self.deadline is None:
+            return None
+        if self.first_token_at is None:
+            return False if self.state == "shed" else None
+        return self.first_token_at <= self.deadline
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
@@ -226,7 +278,12 @@ class ServingEngine:
                  num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  kv_dtype: Optional[str] = None,
-                 mesh=None):
+                 mesh=None,
+                 slo_ttft_ms: Optional[float] = None,
+                 slo_prefill_ms: Optional[float] = None,
+                 slo_tpot_ms: Optional[float] = None,
+                 priority_preempt: Optional[bool] = None,
+                 clock=None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -239,7 +296,11 @@ class ServingEngine:
                               "serving_prefix_cache",
                               "serving_kv_dtype",
                               "serving_attn_impl",
-                              "serving_mesh"])
+                              "serving_mesh",
+                              "serving_slo_ttft_ms",
+                              "serving_slo_prefill_ms",
+                              "serving_slo_tpot_ms",
+                              "serving_priority_preempt"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -255,6 +316,35 @@ class ServingEngine:
         self.default_max_new_tokens = int(g["serving_max_new_tokens"])
         self.default_eos_token_id = eos_token_id
         self.idle_wait = float(g["serving_idle_wait"])
+        # SLO-aware admission: 0 disables (depth-only backpressure).
+        # These are constructor/flag state read ONCE — never set_flags
+        # mid-run to change them, that would bump the flags version and
+        # retrace every compiled step (the admission logic itself is
+        # host-only and compiles nothing).
+        self.slo_ttft_ms = float(slo_ttft_ms if slo_ttft_ms is not None
+                                 else g["serving_slo_ttft_ms"])
+        if self.slo_ttft_ms < 0:
+            raise ValueError(
+                f"slo_ttft_ms must be >= 0, got {self.slo_ttft_ms}")
+        self._prefill_ms_pin = float(
+            slo_prefill_ms if slo_prefill_ms is not None
+            else g["serving_slo_prefill_ms"])
+        self._tpot_ms_pin = float(slo_tpot_ms if slo_tpot_ms is not None
+                                  else g["serving_slo_tpot_ms"])
+        if self._prefill_ms_pin < 0 or self._tpot_ms_pin < 0:
+            raise ValueError("pinned predictor costs must be >= 0")
+        self.priority_preempt = bool(
+            priority_preempt if priority_preempt is not None
+            else g["serving_priority_preempt"])
+        self._clock = clock if clock is not None else time.perf_counter
+        # measured cost estimates feeding predict_ttft_ms when no pin
+        # is set: per-bucket prefill dispatch ms + a global fallback,
+        # and per-output-token decode ms (EWMA over steps)
+        self._prefill_ewma: Dict[int, float] = {}
+        self._prefill_ewma_all: Optional[float] = None
+        self._tpot_ewma: Optional[float] = None
+        self._shed_by_reason: Dict[str, int] = {}
+        self._slo_met = 0
         self.spec_tokens = int(spec_tokens if spec_tokens is not None
                                else g["serving_spec_tokens"])
         self.spec_ngram = int(g["serving_spec_ngram"])
@@ -331,6 +421,7 @@ class ServingEngine:
         # per engine instance): constant memory however many requests
         # retire, and the same numbers surface on GET /metrics
         eid = str(next(ServingEngine._engine_ids))
+        self._eid = eid
         self._ttft_hist = _obs.histogram(
             "serving_ttft_seconds",
             "time to first token of completed requests (s)"
@@ -340,6 +431,20 @@ class ServingEngine:
             "mean time per output token of completed requests (s)"
             ).labels(engine=eid)
         self._completed = 0
+        # shed accounting: one counter family, labelled by why and by
+        # the victim's priority class — the /metrics view of stats()'s
+        # per-reason dict (submit-time rejections included)
+        self._shed_ctr = _obs.counter(
+            "serving_shed_total",
+            "requests shed, by reason (queue_full|slo|deadline|"
+            "preempted|fault|drain) and priority class")
+        self._slo_gauge = None
+        if self.slo_ttft_ms:
+            self._slo_gauge = _obs.gauge(
+                "serving_slo_attainment",
+                "fraction of completed requests whose first token met "
+                "the TTFT SLO (FLAGS_serving_slo_ttft_ms)"
+                ).labels(engine=eid)
         self._spec_proposed = 0   # draft tokens offered to the verify
         self._spec_accepted = 0   # draft tokens the model agreed with
         self._prefix_hit_reqs = 0   # admissions that reused >=1 block
@@ -402,13 +507,120 @@ class ServingEngine:
             tuple(jax.device_put(a, sh) for a, sh in zip(layer, shs))
             for layer, shs in zip(pools, kv_pool_shardings(mesh, pools))])
 
+    # --------------------------------------------------- TTFT prediction
+    _EWMA_ALPHA = 0.3
+
+    def _ewma(self, old: Optional[float], new: float) -> float:
+        if old is None:
+            return new
+        return (1.0 - self._EWMA_ALPHA) * old + self._EWMA_ALPHA * new
+
+    def _note_prefill_ms(self, bucket: int, ms: float):
+        self._prefill_ewma[bucket] = self._ewma(
+            self._prefill_ewma.get(bucket), ms)
+        self._prefill_ewma_all = self._ewma(self._prefill_ewma_all, ms)
+
+    def _note_tpot_ms(self, ms: float):
+        self._tpot_ewma = self._ewma(self._tpot_ewma, ms)
+
+    def _prefill_cost_ms(self, bucket: int) -> float:
+        """Estimated cost of one prefill dispatch for this bucket:
+        the pinned value when set, else the measured EWMA (global
+        fallback before this bucket's first dispatch; 0 before any)."""
+        if self._prefill_ms_pin:
+            return self._prefill_ms_pin
+        v = self._prefill_ewma.get(bucket, self._prefill_ewma_all)
+        return 0.0 if v is None else v
+
+    def _tpot_cost_ms(self) -> float:
+        if self._tpot_ms_pin:
+            return self._tpot_ms_pin
+        return self._tpot_ewma if self._tpot_ewma is not None else 0.0
+
+    def reset_cost_estimates(self):
+        """Drop the learned EWMA costs (pins stay). Call after a
+        warmup pass that paid XLA compiles, so admission predictions
+        reflect steady-state dispatch costs instead of trace time."""
+        self._prefill_ewma.clear()
+        self._prefill_ewma_all = None
+        self._tpot_ewma = None
+
+    def predict_ttft_ms(self, prompt_len: int = 1,
+                        queue_ahead: Optional[int] = None) -> float:
+        """First-order TTFT prediction for a would-be submission, in
+        ms, from live host state only: queue depth ahead of it, free
+        decode slots, the per-bucket prefill cost, and the decode
+        batch's per-token pace. Monotone non-decreasing in queue depth
+        — the property the SLO gate and Retry-After rely on.
+
+        Model: requests ahead prefill in waves of ``max_slots``
+        (``ceil(q / max_slots)`` dispatches before ours), and the
+        newcomer waits ``ceil(max(0, q + 1 - free) / max_slots)``
+        generation rounds for a slot, each lasting one mean new-token
+        budget at the current TPOT. Costs come from pins
+        (``slo_prefill_ms`` / ``slo_tpot_ms``) or measured EWMAs; with
+        neither (a cold engine) the prediction is optimistically 0 and
+        the first dispatches teach it."""
+        if queue_ahead is None:
+            with self._lock:
+                queue_ahead = len(self._queue)
+        return self._predict_ttft_ms(int(queue_ahead), int(prompt_len))
+
+    def _predict_ttft_ms(self, q: int, prompt_len: int) -> float:
+        bucket = self._bucket_for(max(1, min(prompt_len, self.max_len)))
+        prefill = self._prefill_cost_ms(bucket)
+        tpot = self._tpot_cost_ms()
+        live = list(self._active.values())
+        budgets = [r.max_new_tokens for r in live]
+        budgets += [r.max_new_tokens for r in list(self._queue)[:q]]
+        mean_budget = (sum(budgets) / len(budgets) if budgets
+                       else self.default_max_new_tokens)
+        free = max(0, self.max_slots - len(live))
+        waves_ahead = -(-q // self.max_slots)
+        rounds = -(-max(0, q + 1 - free) // self.max_slots)
+        return (waves_ahead + 1) * prefill + rounds * mean_budget * tpot
+
+    def _retry_after_s(self, pred_ms: float) -> int:
+        """Whole-second backoff hint for a shed submission: the
+        predicted TTFT when the model has estimates, else the idle
+        wait; always >= 1 (Retry-After semantics)."""
+        if pred_ms > 0:
+            return max(1, int(-(-pred_ms // 1e3)))
+        return max(1, int(-(-self.idle_wait // 1)))
+
+    def _count_shed(self, reason: str, priority: int):
+        with self._lock:
+            self._shed_by_reason[reason] = \
+                self._shed_by_reason.get(reason, 0) + 1
+        self._shed_ctr.labels(engine=self._eid, reason=reason,
+                              priority=str(priority)).inc()
+
+    def _pick_victims(self, priority: int, n: int,
+                      exclude: Sequence[Request] = ()) -> List[Request]:
+        """(holding self._lock) Queued requests a priority-``priority``
+        submission may preempt: strictly lower-priority (numerically
+        greater) classes only — worst class first, newest first within
+        a class — never peers or betters."""
+        pool = [r for r in self._queue
+                if r.priority > priority and r not in exclude]
+        pool.sort(key=lambda r: (-r.priority, -r.id))
+        return pool[:max(0, n)]
+
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               priority: Optional[int] = None) -> Request:
         """Queue a generation request; returns its handle immediately.
-        Raises ValueError for geometry the cache cannot hold and
-        QueueFullError when admission control sheds the submission."""
+
+        ``priority`` is an integer class, lower = more urgent (default
+        1); FIFO within a class. Raises ValueError for geometry the
+        cache cannot hold and QueueFullError when admission sheds the
+        submission — depth backpressure or, with a TTFT SLO configured,
+        a predicted TTFT beyond budget (the error carries ``reason``
+        and a ``retry_after_s`` hint). With preemption enabled, a
+        submission that would otherwise be shed may instead shed queued
+        strictly-lower-priority work to make room."""
         mnt = int(max_new_tokens if max_new_tokens is not None
                   else self.default_max_new_tokens)
         eos = (eos_token_id if eos_token_id is not None
@@ -436,22 +648,65 @@ class ServingEngine:
                     f"request needs {need} KV blocks but the pool only "
                     f"has {self.cache.num_blocks - 1} usable; raise "
                     "FLAGS_serving_num_blocks or shorten the request")
+        pr = int(priority if priority is not None else 1)
         # raising kinds reject this submission pre-queue; `skip` sheds
         # it through the same backpressure exit as a full queue
         kind = fault_point("serving.submit")
         if kind == "skip":
             _monitor.stat_add("STAT_serving_rejected")
+            self._count_shed("fault", pr)
             raise QueueFullError("submission shed by injected fault at "
-                                 "serving.submit")
-        req = Request(prompt, mnt, eos)
+                                 "serving.submit", reason="fault",
+                                 retry_after_s=self._retry_after_s(0.0))
+        now = self._clock()
+        req = Request(prompt, mnt, eos, priority=pr, now=now)
+        if self.slo_ttft_ms:
+            req.deadline = now + self.slo_ttft_ms / 1e3
+        reject = None          # (reason, predicted_ms) when shedding
+        victims: List[Request] = []
         with self._lock:
-            if len(self._queue) >= self.max_queue:
-                _monitor.stat_add("STAT_serving_rejected")
-                raise QueueFullError(
-                    f"serving queue full ({self.max_queue} waiting); "
-                    "retry later or raise FLAGS_serving_max_queue")
-            self._queue.append(req)
-            self._all.append(req)
+            q = len(self._queue)
+            if q >= self.max_queue:
+                if self.priority_preempt:
+                    victims = self._pick_victims(
+                        pr, q - self.max_queue + 1)
+                if q - len(victims) >= self.max_queue:
+                    reject = ("queue_full",
+                              self._predict_ttft_ms(q, len(prompt)))
+            if reject is None and self.slo_ttft_ms:
+                pred = self._predict_ttft_ms(q - len(victims),
+                                             len(prompt))
+                while pred > self.slo_ttft_ms and self.priority_preempt:
+                    more = self._pick_victims(pr, 1, exclude=victims)
+                    if not more:
+                        break
+                    victims.extend(more)
+                    pred = self._predict_ttft_ms(q - len(victims),
+                                                 len(prompt))
+                if pred > self.slo_ttft_ms:
+                    reject = ("slo", pred)
+            if reject is None:
+                for v in victims:
+                    self._queue.remove(v)
+                self._queue.append(req)
+                self._all.append(req)
+            else:
+                victims = []   # rejected anyway: preempt nothing
+        for v in victims:
+            self._shed(v, _Shed(f"preempted by priority-{pr} request "
+                                f"{req.id}"), reason="preempted")
+        if reject is not None:
+            reason, pred = reject
+            _monitor.stat_add("STAT_serving_rejected")
+            self._count_shed(reason, pr)
+            if reason == "queue_full":
+                msg = (f"serving queue full ({self.max_queue} waiting); "
+                       "retry later or raise FLAGS_serving_max_queue")
+            else:
+                msg = (f"predicted TTFT {pred:.0f}ms exceeds SLO "
+                       f"{self.slo_ttft_ms:.0f}ms; retry later or shed")
+            raise QueueFullError(msg, reason=reason,
+                                 retry_after_s=self._retry_after_s(pred))
         _monitor.stat_add("STAT_serving_submitted")
         self._wake.set()
         return req
@@ -620,19 +875,46 @@ class ServingEngine:
                               jnp.asarray(pos), jnp.asarray(tables),
                               self.cache.arrays())
 
-    def _admit_round_paged(self):
-        """One paged admission pass: pop queued requests FIFO, acquire
-        a block table for each (prefix-cache reuse first), group by
-        the unshared *suffix*'s bucket, one batched prefill per group.
-        Pool exhaustion requeues the head-of-line request (and all
-        behind it — FIFO order is part of the equivalence oracle) until
-        retirements free blocks. Returns (consumed, admitted)."""
-        candidates: List[Request] = []
+    def _pop_candidates(self, limit: int):
+        """Pop up to ``limit`` queued requests in admission order —
+        (priority class, submission id), which is strict FIFO when
+        every request uses the default class (the token-identity
+        oracle's ordering) — shedding any whose TTFT deadline already
+        passed (reason="deadline") instead of spending a prefill
+        dispatch on work that can no longer meet its SLO. Returns
+        ``(candidates, n_expired)``."""
+        out: List[Request] = []
+        expired: List[Request] = []
+        now = self._clock()
         with self._lock:
-            while len(candidates) < self.cache.num_free and self._queue:
-                candidates.append(self._queue.popleft())
+            if len(self._queue) > 1 and \
+                    any(r.priority != self._queue[0].priority
+                        for r in self._queue):
+                self._queue = deque(sorted(
+                    self._queue, key=lambda r: (r.priority, r.id)))
+            while len(out) < limit and self._queue:
+                req = self._queue.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    expired.append(req)
+                else:
+                    out.append(req)
+        for req in expired:
+            self._shed(req, _Shed("TTFT deadline expired in queue for "
+                                  f"request {req.id}"),
+                       reason="deadline")
+        return out, len(expired)
+
+    def _admit_round_paged(self):
+        """One paged admission pass: pop queued requests in admission
+        order (FIFO within a priority class), acquire a block table
+        for each (prefix-cache reuse first), group by the unshared
+        *suffix*'s bucket, one batched prefill per group. Pool
+        exhaustion requeues the head-of-line request (and all behind
+        it — intra-class FIFO order is part of the equivalence oracle)
+        until retirements free blocks. Returns (consumed, admitted)."""
+        candidates, expired = self._pop_candidates(self.cache.num_free)
         if not candidates:
-            return 0, 0
+            return expired, 0
         acquired = []   # (req, row, shared)
         back: List[Request] = []
         for req in candidates:
@@ -658,7 +940,7 @@ class ServingEngine:
             with self._lock:
                 self._queue.extendleft(reversed(back))
         if not acquired:
-            return len(candidates) - len(back), 0
+            return expired + len(candidates) - len(back), 0
         groups: Dict[int, List] = {}
         for rec in acquired:
             req, row, shared = rec
@@ -668,6 +950,7 @@ class ServingEngine:
         admitted = 0
         for bucket in sorted(groups):
             group = groups[bucket]
+            t0 = time.perf_counter()
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
                         _profiler.RecordEvent("serving.prefill"):
@@ -680,6 +963,9 @@ class ServingEngine:
                     self.cache.release_row(row)
                     self._shed(req, e)
                 continue
+            if out is not None:
+                self._note_prefill_ms(
+                    bucket, (time.perf_counter() - t0) * 1e3)
             for (req, row, _), err in shed:
                 self.cache.release_row(row)
                 self._shed(req, err)
@@ -709,7 +995,7 @@ class ServingEngine:
                                   prompt_tokens=len(req.prompt),
                                   shared_tokens=shared)
                 self._append_token(req, int(first[i]))
-        return len(candidates) - len(back), admitted
+        return expired + len(candidates) - len(back), admitted
 
     def _admit_round(self):
         """One admission pass: pop up to num_free queued requests,
@@ -717,12 +1003,9 @@ class ServingEngine:
         group. Returns (popped, admitted)."""
         if self.paged:
             return self._admit_round_paged()
-        candidates: List[Request] = []
-        with self._lock:
-            while len(candidates) < self.cache.num_free and self._queue:
-                candidates.append(self._queue.popleft())
+        candidates, expired = self._pop_candidates(self.cache.num_free)
         if not candidates:
-            return 0, 0
+            return expired, 0
         groups: Dict[int, List[Request]] = {}
         for req in candidates:
             groups.setdefault(self._bucket_for(len(req.prompt)),
@@ -730,6 +1013,7 @@ class ServingEngine:
         admitted = 0
         for bucket in sorted(groups):
             group = groups[bucket]
+            t0 = time.perf_counter()
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
                         _profiler.RecordEvent("serving.prefill"):
@@ -740,6 +1024,9 @@ class ServingEngine:
                 for req in group:
                     self._shed(req, e)
                 continue
+            if out is not None:
+                self._note_prefill_ms(
+                    bucket, (time.perf_counter() - t0) * 1e3)
             for req, err in shed:
                 self._shed(req, err)
             if not live:
@@ -762,7 +1049,7 @@ class ServingEngine:
                 # logits (same argmax greedy_search takes after ITS
                 # prefill)
                 self._append_token(req, int(first[i]))
-        return len(candidates), admitted
+        return expired + len(candidates), admitted
 
     def _admit(self) -> int:
         """Fill free slots from the queue (batched, one prefill
@@ -819,6 +1106,7 @@ class ServingEngine:
         tokens = np.zeros(self.max_slots, np.int32)
         for slot, req in self._active.items():
             tokens[slot] = req.tokens[-1]
+        t0 = time.perf_counter()
         try:
             with _monitor.stat_time("STAT_serving_decode"), \
                     _profiler.RecordEvent("serving.decode"):
@@ -834,6 +1122,7 @@ class ServingEngine:
                 self.cache.release(slot)
                 self._shed(req, e)
             return 0
+        self._note_tpot_ms((time.perf_counter() - t0) * 1e3)
         if self.paged:
             nxt, _, arrays, qerr = out
             self._note_qerr(qerr, len(self._active))
@@ -882,6 +1171,8 @@ class ServingEngine:
             tokens[slot, 0] = req.tokens[-1]
             tokens[slot, 1:] = d
             drafts[slot] = d
+        n_active = len(self._active)
+        t0 = time.perf_counter()
         try:
             with _monitor.stat_time("STAT_serving_verify"), \
                     _profiler.RecordEvent("serving.verify"):
@@ -929,13 +1220,18 @@ class ServingEngine:
                 # reject the unaccepted tail: roll the write offset
                 # back so the next step overwrites those rows
                 self.cache.rollback(slot, K + 1 - committed)
+        if produced:
+            # per-output-token pace: step wall time spread over the
+            # tokens each slot actually committed this step
+            self._note_tpot_ms((time.perf_counter() - t0) * 1e3 *
+                               n_active / produced)
         return produced
 
     # -------------------------------------------------------- lifecycle
     def _append_token(self, req: Request, token: int):
         req.tokens.append(token)
         if req.first_token_at is None:
-            req.first_token_at = time.perf_counter()
+            req.first_token_at = self._clock()
         _monitor.stat_add("STAT_serving_tokens")
         if (req.eos_token_id is not None and
                 token == req.eos_token_id) or \
@@ -948,28 +1244,39 @@ class ServingEngine:
             self.cache.release(req.slot)
             req.slot = None
         req.state = "done"
-        req.finished_at = time.perf_counter()
+        req.finished_at = self._clock()
         ttft, tpot = req.ttft, req.tpot
         if ttft is not None:
             self._ttft_hist.observe(ttft)
         if tpot is not None:
             self._tpot_hist.observe(tpot)
+        met = req.deadline_met
         with self._lock:
             self._completed += 1
+            if met:
+                self._slo_met += 1
+            completed, slo_met = self._completed, self._slo_met
+        if self._slo_gauge is not None and completed:
+            self._slo_gauge.set(slo_met / completed)
         _monitor.stat_add("STAT_serving_completed")
         _runlog.log_event(
             "serving_finish", request=req.id, tokens=len(req.tokens),
             ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
-            tpot_ms=None if tpot is None else round(tpot * 1e3, 3))
+            tpot_ms=None if tpot is None else round(tpot * 1e3, 3),
+            deadline_met=met)
         req._done.set()
 
-    def _shed(self, req: Request, err: BaseException):
+    def _shed(self, req: Request, err: BaseException,
+              reason: str = "fault"):
         req.slot = None
         req.state = "shed"
         req.error = err
-        req.finished_at = time.perf_counter()
+        req.shed_reason = reason
+        req.finished_at = self._clock()
         _monitor.stat_add("STAT_serving_shed")
+        self._count_shed(reason, req.priority)
         _runlog.log_event("serving_shed", request=req.id,
+                          reason=reason, priority=req.priority,
                           error=str(err))
         req._done.set()
 
@@ -1003,6 +1310,9 @@ class ServingEngine:
 
         with self._lock:
             completed = self._completed
+            slo_met = self._slo_met
+            shed = dict(self._shed_by_reason)
+            queued = len(self._queue)
         out = {
             "ttft_p50_ms": pct(self._ttft_hist, 0.50),
             "ttft_p99_ms": pct(self._ttft_hist, 0.99),
@@ -1010,7 +1320,21 @@ class ServingEngine:
             "tpot_p99_ms": pct(self._tpot_hist, 0.99),
             "latency_samples": completed,
             "spec_tokens": self.spec_tokens,
+            "completed": completed,
+            "queue_depth": queued,
+            "active": len(self._active),
+            # per-reason sheds incl. submit-time rejections — the
+            # stats() view of serving_shed_total{reason=,priority=}
+            "shed": shed,
+            "shed_total": sum(shed.values()),
         }
+        if self.slo_ttft_ms:
+            out["slo_ttft_ms"] = self.slo_ttft_ms
+            out["slo_met"] = slo_met
+            out["slo_attainment"] = (round(slo_met / completed, 4)
+                                     if completed else None)
+            out["predicted_ttft_ms"] = round(
+                self.predict_ttft_ms(), 3)
         if self.spec_tokens:
             out["spec_proposed"] = self._spec_proposed
             out["spec_accepted"] = self._spec_accepted

@@ -4,17 +4,24 @@ Same server shape as distributed/fleet/utils/http_server.py (a
 ThreadingHTTPServer on a daemon thread with start/stop), speaking a
 minimal JSON generation protocol:
 
-  POST /v1/generate   {"ids": [...], "max_new_tokens"?, "eos_token_id"?}
+  POST /v1/generate   {"ids": [...], "max_new_tokens"?, "eos_token_id"?,
+                       "priority"?}
                       -> 200 {"id", "output_ids", "generated", "state"}
                       -> 400 bad request geometry / malformed JSON
-                      -> 429 admission control (queue full / shed at
-                             submit — the backpressure signal; carries
-                             a Retry-After header so well-behaved
-                             clients back off instead of hammering)
+                      -> 429 admission control (queue full / predicted
+                             SLO miss / shed at submit — the
+                             backpressure signal; Retry-After comes
+                             from the engine's predicted-TTFT model,
+                             not a fixed idle-wait, so well-behaved
+                             clients back off for as long as the
+                             backlog actually needs; "reason" in the
+                             body says which gate fired)
                       -> 503 request shed by fault policy mid-flight
   GET  /v1/stats      -> 200 the STAT_serving_* counters merged with
                              engine.stats() (TTFT / TPOT percentiles,
-                             speculative acceptance rate)
+                             speculative acceptance rate, per-reason
+                             shed counts, slo_attainment when an SLO
+                             is configured)
   GET  /metrics       -> 200 the whole observability registry in
                              Prometheus text exposition format
                              (serving counters/latency histograms,
@@ -96,13 +103,18 @@ class _ServingHandler(BaseHTTPRequestHandler):
         try:
             req = engine.submit(ids,
                                 max_new_tokens=body.get("max_new_tokens"),
-                                eos_token_id=body.get("eos_token_id"))
+                                eos_token_id=body.get("eos_token_id"),
+                                priority=body.get("priority"))
         except QueueFullError as e:
-            # Retry-After: one idle-wait is when the scheduler next
-            # looks at the queue — the earliest a retry could land
-            retry_s = max(1, int(math.ceil(engine.idle_wait)))
-            self._json(429, {"error": str(e)},
-                       headers={"Retry-After": str(retry_s)})
+            # Retry-After: the engine's predicted-TTFT backoff when it
+            # attached one (how long the backlog actually needs), else
+            # one idle-wait — when the scheduler next looks at the queue
+            retry_s = getattr(e, "retry_after_s", None)
+            if retry_s is None:
+                retry_s = max(1, int(math.ceil(engine.idle_wait)))
+            self._json(429, {"error": str(e),
+                             "reason": getattr(e, "reason", "queue_full")},
+                       headers={"Retry-After": str(int(retry_s))})
             return
         except ValueError as e:
             self._json(400, {"error": str(e)})

@@ -25,7 +25,19 @@ retry, exhaustion and injected ``skip`` shed the submission through
 the same backpressure exit as a full queue. Requests already placed on
 a replica are never touched by router faults. ``drain()`` stops
 admissions (subsequent submits shed) and runs every replica to idle —
-the rolling-deploy exit.
+the rolling-deploy exit — returning how many queued requests were shed
+on the way down.
+
+Autoscaling (``FLAGS_serving_autoscale`` = "MIN:MAX" or an
+:class:`AutoscalePolicy` instance): each ``step()`` the router
+consults the policy against the same signals the metrics registry
+exports — mean queue depth per replica, the tightest replica's free
+KV-block fraction, and aggregate SLO attainment — and scales the
+replica set inside [MIN, MAX]. Scale-up constructs a new engine on
+the shared model (the unified step cache means no new XLA compiles);
+scale-down retires the emptiest replica: it stops receiving routes
+but keeps stepping until its in-flight work drains, then drops.
+Decisions are cooldown-limited so one burst doesn't thrash the set.
 """
 
 from __future__ import annotations
@@ -40,6 +52,82 @@ from ..observability import runlog as _runlog
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
 from .engine import QueueFullError, Request, ServingEngine
+
+
+def _parse_autoscale(text: str):
+    """'MIN:MAX' -> (min, max) replica bounds, None when empty."""
+    text = str(text).strip()
+    if not text:
+        return None
+    try:
+        lo, hi = (int(p) for p in text.split(":"))
+    except Exception:
+        raise ValueError(
+            f"serving_autoscale must be 'MIN:MAX', got {text!r}")
+    if lo < 1 or hi < lo:
+        raise ValueError(
+            f"serving_autoscale bounds need 1 <= MIN <= MAX, got {text!r}")
+    return lo, hi
+
+
+class AutoscalePolicy:
+    """Replica-count policy over the router's live load signals.
+
+    ``decide(router)`` returns the target replica count, one step up
+    or down at a time inside [min_replicas, max_replicas]:
+
+    - scale UP when the mean (queued + active) per replica exceeds
+      ``queue_high``, when the tightest replica's free KV-block
+      fraction drops under ``kv_free_low``, or when aggregate SLO
+      attainment (engines running with a TTFT SLO) falls under
+      ``attainment_low`` while there is queued work;
+    - scale DOWN when the mean depth sits under ``queue_low`` and
+      attainment (if measured) is healthy.
+
+    The router applies decisions at most once per ``cooldown_steps``
+    scheduler iterations, and drains a retiring replica before
+    dropping it — scale-down never sheds in-flight work.
+    """
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 queue_high: float = 4.0, queue_low: float = 1.0,
+                 kv_free_low: float = 0.1,
+                 attainment_low: float = 0.95,
+                 cooldown_steps: int = 20):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                "AutoscalePolicy needs 1 <= min_replicas <= "
+                f"max_replicas, got {min_replicas}..{max_replicas}")
+        if queue_low > queue_high:
+            raise ValueError("queue_low must be <= queue_high")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.kv_free_low = float(kv_free_low)
+        self.attainment_low = float(attainment_low)
+        self.cooldown_steps = int(cooldown_steps)
+
+    def decide(self, router: "ReplicaRouter") -> int:
+        n = len(router.engines)
+        depths = [router._depth(e) for e in router.engines]
+        mean_depth = sum(depths) / n
+        free_frac = min(
+            (router._blocks_free(e) / max(1, e.cache.num_blocks)
+             if e.paged else
+             router._blocks_free(e) / max(1, e.max_slots))
+            for e in router.engines)
+        att = router._slo_attainment()
+        pressured = (mean_depth > self.queue_high or
+                     free_frac < self.kv_free_low or
+                     (att is not None and att < self.attainment_low
+                      and mean_depth > self.queue_low))
+        if pressured and n < self.max_replicas:
+            return n + 1
+        if (mean_depth < self.queue_low and n > self.min_replicas and
+                (att is None or att >= self.attainment_low)):
+            return n - 1
+        return n
 
 
 class ReplicaRouter:
@@ -57,13 +145,27 @@ class ReplicaRouter:
 
     def __init__(self, model=None, n_replicas: Optional[int] = None,
                  engines: Optional[Sequence[ServingEngine]] = None,
-                 **engine_kwargs):
+                 autoscale=None, **engine_kwargs):
         from .. import flags as _flags
+        g = _flags.get_flags(["serving_replicas", "serving_autoscale"])
+        if autoscale is None:
+            bounds = _parse_autoscale(g["serving_autoscale"])
+            if bounds is not None:
+                autoscale = AutoscalePolicy(min_replicas=bounds[0],
+                                            max_replicas=bounds[1])
+        self._autoscale: Optional[AutoscalePolicy] = autoscale
+        self._model = model
+        self._engine_kwargs = dict(engine_kwargs)
         if engines is not None:
             if model is not None or engine_kwargs:
                 raise ValueError(
                     "pass either prebuilt engines= or model= + engine "
                     "kwargs, not both")
+            if autoscale is not None:
+                raise ValueError(
+                    "autoscaling needs model= construction (the router "
+                    "builds scale-up replicas itself); prebuilt "
+                    "engines= cannot autoscale")
             self.engines: List[ServingEngine] = list(engines)
             if not self.engines:
                 raise ValueError("engines must be non-empty")
@@ -71,19 +173,27 @@ class ReplicaRouter:
             if model is None:
                 raise ValueError("ReplicaRouter needs model= or engines=")
             n = int(n_replicas if n_replicas is not None
-                    else _flags.get_flags(["serving_replicas"])
-                    ["serving_replicas"])
+                    else g["serving_replicas"])
             if n < 1:
                 raise ValueError(f"n_replicas must be >= 1, got {n}")
+            if autoscale is not None:
+                n = min(max(n, autoscale.min_replicas),
+                        autoscale.max_replicas)
             self.engines = [ServingEngine(model, **engine_kwargs)
                             for _ in range(n)]
         self._draining = False
         self._lock = threading.Lock()
+        self._retiring: List[ServingEngine] = []
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._steps_since_scale = 0
         rid = str(next(ReplicaRouter._router_ids))
-        _obs.gauge(
+        self._rid = rid
+        self._replicas_gauge = _obs.gauge(
             "serving_replicas",
             "data-parallel engine replicas behind this ReplicaRouter"
-            ).labels(router=rid).set(len(self.engines))
+            ).labels(router=rid)
+        self._replicas_gauge.set(len(self.engines))
         self._depth_gauges = [
             _obs.gauge(
                 "serving_queue_depth",
@@ -101,17 +211,43 @@ class ReplicaRouter:
         return (eng.cache.blocks_free if eng.paged
                 else eng.cache.num_free)
 
+    def _shed_total(self, eng: ServingEngine) -> int:
+        with eng._lock:
+            return sum(eng._shed_by_reason.values())
+
+    def _slo_attainment(self) -> Optional[float]:
+        """Aggregate goodput fraction over replicas running with a
+        TTFT SLO: sum(slo_met) / sum(completed). None when no replica
+        has an SLO or nothing completed yet."""
+        met = done = 0
+        for eng in self.engines + self._retiring:
+            if not eng.slo_ttft_ms:
+                continue
+            with eng._lock:
+                met += eng._slo_met
+                done += eng._completed
+        return (met / done) if done else None
+
     def _update_depth_gauges(self):
+        while len(self._depth_gauges) < len(self.engines):
+            self._depth_gauges.append(_obs.gauge(
+                "serving_queue_depth",
+                "requests queued + active on one routed engine replica"
+                ).labels(router=self._rid,
+                         replica=str(len(self._depth_gauges))))
         for g, eng in zip(self._depth_gauges, self.engines):
             g.set(self._depth(eng))
+        for g in self._depth_gauges[len(self.engines):]:
+            g.set(0)
 
-    def _route_attempt(self, prompt, max_new_tokens, eos_token_id
-                       ) -> Request:
+    def _route_attempt(self, prompt, max_new_tokens, eos_token_id,
+                       priority) -> Request:
         kind = fault_point("serving.route")
         if kind == "skip":
             _monitor.stat_add("STAT_serving_route_shed")
             raise QueueFullError(
-                "submission shed by injected fault at serving.route")
+                "submission shed by injected fault at serving.route",
+                reason="fault")
         # least-loaded: queue depth first (each queued request is a
         # prefill ahead of yours -> the dominant TTFT term), free KV
         # blocks as the tiebreak, lowest index last for determinism
@@ -124,7 +260,8 @@ class ReplicaRouter:
             eng = self.engines[i]
             try:
                 req = eng.submit(prompt, max_new_tokens=max_new_tokens,
-                                 eos_token_id=eos_token_id)
+                                 eos_token_id=eos_token_id,
+                                 priority=priority)
             except QueueFullError as e:
                 last_err = e
                 continue
@@ -140,37 +277,82 @@ class ReplicaRouter:
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               priority: Optional[int] = None) -> Request:
         """Route one request to the least-loaded replica; returns its
-        :class:`Request` handle. Raises :class:`QueueFullError` when
+        :class:`Request` handle. ``priority`` passes through to the
+        chosen engine's admission. Raises :class:`QueueFullError` when
         every replica sheds (or the router is draining) and ValueError
         for geometry no replica can hold."""
         with self._lock:
             if self._draining:
                 raise QueueFullError("router is draining: submissions "
-                                     "are shed for rolling shutdown")
+                                     "are shed for rolling shutdown",
+                                     reason="drain")
         try:
             return RetryPolicy.from_flags("serving.route").call(
                 self._route_attempt, prompt, max_new_tokens,
-                eos_token_id)
+                eos_token_id, priority)
         except RetryError as e:
             _monitor.stat_add("STAT_serving_route_shed")
             raise QueueFullError(
-                f"routing retries exhausted: {e}") from e
+                f"routing retries exhausted: {e}", reason="fault") from e
+
+    # -------------------------------------------------------- autoscale
+    def _add_replica(self):
+        eng = ServingEngine(self._model, **self._engine_kwargs)
+        self.engines.append(eng)
+
+    def _maybe_autoscale(self):
+        """Apply one cooldown-limited policy decision: grow the set on
+        pressure, or move the emptiest replica to the retiring list
+        (it keeps stepping, receives no routes, and drops once idle —
+        in-flight work is never shed by scale-down)."""
+        for eng in list(self._retiring):
+            if eng.idle:
+                self._retiring.remove(eng)
+        self._steps_since_scale += 1
+        if self._steps_since_scale < self._autoscale.cooldown_steps:
+            return
+        n = len(self.engines)
+        target = self._autoscale.decide(self)
+        if target == n:
+            return
+        if target > n:
+            for _ in range(target - n):
+                self._add_replica()
+            self._scale_ups += 1
+            _monitor.stat_add("STAT_serving_autoscale_up")
+        else:
+            idx = min(range(n),
+                      key=lambda i: (self._depth(self.engines[i]), i))
+            self._retiring.append(self.engines.pop(idx))
+            self._scale_downs += 1
+            _monitor.stat_add("STAT_serving_autoscale_down")
+        self._steps_since_scale = 0
+        self._replicas_gauge.set(len(self.engines))
+        _runlog.log_event("serving_autoscale", replicas_from=n,
+                          replicas_to=len(self.engines),
+                          retiring=len(self._retiring))
 
     # ---------------------------------------------------------- stepping
     def step(self) -> bool:
-        """One scheduler iteration on every replica (deterministic
-        test/benchmark path). Returns whether any replica worked."""
+        """One scheduler iteration on every replica — retiring ones
+        included, so scale-down drains rather than sheds — then one
+        autoscale decision (deterministic test/benchmark path).
+        Returns whether any replica worked."""
         worked = False
-        for eng in self.engines:
+        for eng in list(self.engines) + list(self._retiring):
             worked = eng.step() or worked
+        if self._autoscale is not None:
+            self._maybe_autoscale()
         self._update_depth_gauges()
         return worked
 
     @property
     def idle(self) -> bool:
-        return all(eng.idle for eng in self.engines)
+        return all(eng.idle
+                   for eng in list(self.engines) + list(self._retiring))
 
     def run_until_idle(self, max_steps: int = 10_000) -> int:
         steps = 0
@@ -186,15 +368,26 @@ class ReplicaRouter:
     def drain(self, max_steps: int = 10_000) -> int:
         """Stop admissions and run every replica to idle (rolling
         deploy / shutdown). Later ``submit()`` calls shed with
-        :class:`QueueFullError`; already-queued requests finish."""
+        :class:`QueueFullError`; already-queued requests finish unless
+        their own admission sheds them on the way down (expired TTFT
+        deadlines, injected faults). Returns how many requests were
+        shed while draining — previously they silently vanished from
+        the accounting; now they also land on
+        ``STAT_serving_drain_shed``."""
         with self._lock:
             self._draining = True
+        engines = list(self.engines) + list(self._retiring)
+        before = sum(self._shed_total(e) for e in engines)
         _runlog.log_event("serving_drain",
                           replicas=len(self.engines),
-                          queued=[self._depth(e) for e in self.engines])
-        steps = self.run_until_idle(max_steps)
+                          queued=[self._depth(e) for e in engines])
+        self.run_until_idle(max_steps)
         _monitor.stat_add("STAT_serving_drained")
-        return steps
+        shed = sum(self._shed_total(e) for e in engines) - before
+        if shed:
+            _monitor.stat_add("STAT_serving_drain_shed", shed)
+        _runlog.log_event("serving_drain_done", shed=shed)
+        return shed
 
     def results(self, reqs=None, timeout: Optional[float] = None
                 ) -> List[Request]:
@@ -202,7 +395,7 @@ class ReplicaRouter:
         if reqs is not None:
             out = list(reqs)
         else:
-            out = sorted((r for eng in self.engines
+            out = sorted((r for eng in self.engines + self._retiring
                           for r in eng.results()), key=lambda r: r.id)
             return out
         for r in out:
@@ -216,15 +409,27 @@ class ReplicaRouter:
             eng.start()
 
     def stop(self):
-        for eng in self.engines:
+        for eng in self.engines + self._retiring:
             eng.stop()
 
     def stats(self) -> dict:
         """Router-level view: replica count, per-replica queue depths
-        and free KV blocks, the (shared) mesh shape, and each
-        replica's full ``stats()`` dict under ``per_replica``."""
+        and free KV blocks, the (shared) mesh shape, aggregate
+        goodput/shed counters across replicas (completed, slo_met,
+        per-reason sheds, slo_attainment), the autoscale posture when
+        enabled, and each replica's full ``stats()`` dict under
+        ``per_replica``."""
+        engines = list(self.engines) + list(self._retiring)
         depths = [self._depth(e) for e in self.engines]
-        return {
+        shed: dict = {}
+        completed = slo_met = 0
+        for e in engines:
+            with e._lock:
+                completed += e._completed
+                slo_met += e._slo_met
+                for k, v in e._shed_by_reason.items():
+                    shed[k] = shed.get(k, 0) + v
+        out = {
             "replicas": len(self.engines),
             "draining": self._draining,
             "mesh_shape": (None if self.engines[0].mesh_shape is None
@@ -232,5 +437,19 @@ class ReplicaRouter:
             "queue_depths": depths,
             "kv_blocks_free": [self._blocks_free(e)
                                for e in self.engines],
+            "completed": completed,
+            "slo_met": slo_met,
+            "slo_attainment": self._slo_attainment(),
+            "shed": shed,
+            "shed_total": sum(shed.values()),
             "per_replica": [e.stats() for e in self.engines],
         }
+        if self._autoscale is not None:
+            out["autoscale"] = {
+                "min_replicas": self._autoscale.min_replicas,
+                "max_replicas": self._autoscale.max_replicas,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+                "retiring": len(self._retiring),
+            }
+        return out

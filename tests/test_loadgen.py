@@ -1,0 +1,234 @@
+"""Load generator + graceful degradation: the "real traffic" contract.
+
+Three guarantees under test:
+
+- **replayability**: the same seed produces a byte-identical arrival
+  trace (all three arrival processes) AND — on a virtual clock with
+  pinned predictor costs — identical admit/shed decisions across two
+  independent engine runs;
+- **elasticity**: the router's AutoscalePolicy grows replicas under
+  queue pressure and retires them (drained, never shedding in-flight
+  work) when the load passes;
+- **chaos crossover**: with fault injection live, goodput degrades
+  but the run stays graceful — zero unhandled exceptions, zero leaked
+  KV blocks, every lost request accounted for in a shed counter.
+
+Plus the static side: ``predict_serving_compiles`` treats every
+admission parameter as a validated no-op, which *is* the
+zero-new-compiles contract in regression-test form.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.analysis import predict_serving_compiles
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.resilience import fault_scope
+from paddle_tpu.serving import AutoscalePolicy, ReplicaRouter, ServingEngine
+from tools.loadgen import Arrival, LoadGen, VirtualClock, warmup
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(13)
+    cfg = GPTConfig(vocab_size=97, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+_LG_KW = dict(rate=30.0, duration=0.6, vocab_size=97,
+              prompt_tokens=(3, 9), new_tokens=(2, 5),
+              priority_mix={0: 0.2, 1: 0.6, 2: 0.2})
+
+
+def _engine(model, clock, **kw):
+    base = dict(max_slots=2, max_len=32, buckets=[8, 16], max_queue=4,
+                slo_ttft_ms=60.0, slo_prefill_ms=4.0, slo_tpot_ms=1.5,
+                clock=clock)
+    base.update(kw)
+    return ServingEngine(model, **base)
+
+
+# --------------------------------------------------------- replayability
+@pytest.mark.parametrize("mode", list(LoadGen.MODES))
+def test_same_seed_same_trace_bytes(mode):
+    a = LoadGen(mode=mode, seed=42, **_LG_KW)
+    b = LoadGen(mode=mode, seed=42, **_LG_KW)
+    assert a.trace_bytes() == b.trace_bytes()
+    assert len(a.schedule()) > 0
+    assert all(isinstance(x, Arrival) for x in a.schedule())
+    # and a different seed is a different workload
+    c = LoadGen(mode=mode, seed=43, **_LG_KW)
+    assert a.trace_bytes() != c.trace_bytes()
+
+
+def test_modes_are_distinct_processes():
+    """Same seed, different process: the traces must differ (the mode
+    parameter is not cosmetic) and bursty must out-arrive calm poisson
+    at equal mean rate parameters during its bursts."""
+    traces = {m: LoadGen(mode=m, seed=7, **_LG_KW).trace_bytes()
+              for m in LoadGen.MODES}
+    assert len(set(traces.values())) == 3
+
+
+@pytest.mark.parametrize("mode", list(LoadGen.MODES))
+def test_same_seed_same_decisions(model, mode):
+    """Two fresh engines, same seed, virtual clock, pinned costs: the
+    admit/shed decision sequence — including shed reasons — replays
+    exactly. This is the property that makes a loadgen regression
+    bisectable."""
+    reports = []
+    for _ in range(2):
+        vc = VirtualClock()
+        eng = _engine(model, vc.now)
+        lg = LoadGen(mode=mode, seed=5, **_LG_KW)
+        reports.append(lg.run(eng, clock=vc, step_cost_ms=4.0))
+    assert reports[0]["decisions"] == reports[1]["decisions"]
+    assert reports[0]["shed"] == reports[1]["shed"]
+    assert reports[0]["completed"] == reports[1]["completed"]
+    assert reports[0]["offered"] > 0
+    assert reports[0]["exceptions"] == 0
+    assert reports[0]["leaked_kv_blocks"] == 0
+
+
+def test_slo_admission_beats_depth_only_on_goodput(model):
+    """The point of predictive admission: at the same offered load,
+    the SLO-aware engine's goodput (completions inside the TTFT
+    budget) beats the depth-only engine scored post-hoc against the
+    same SLO — shedding doomed work early frees capacity for work
+    that can still win."""
+    lg_kw = dict(_LG_KW, rate=80.0, duration=0.6)   # well over capacity
+    slo_ms = 40.0
+
+    vc = VirtualClock()
+    depth_only = _engine(model, vc.now, slo_ttft_ms=0.0, max_queue=32)
+    base = LoadGen(mode="bursty", seed=9, **lg_kw).run(
+        depth_only, clock=vc, step_cost_ms=4.0, slo_ttft_ms=slo_ms)
+
+    vc2 = VirtualClock()
+    slo_aware = _engine(model, vc2.now, slo_ttft_ms=slo_ms,
+                        max_queue=32)
+    aware = LoadGen(mode="bursty", seed=9, **lg_kw).run(
+        slo_aware, clock=vc2, step_cost_ms=4.0)
+
+    assert base["goodput_per_s"] is not None
+    assert aware["goodput_per_s"] >= 1.2 * base["goodput_per_s"], \
+        (base["goodput_per_s"], aware["goodput_per_s"])
+
+
+# ------------------------------------------------------------ elasticity
+def test_autoscale_up_under_pressure_then_down(model):
+    """Queue pressure grows the fleet inside the policy bounds; calm
+    shrinks it — retiring replicas drain before dropping, so nothing
+    in flight is shed by a scale-down."""
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                             queue_high=2.0, queue_low=0.5,
+                             cooldown_steps=1)
+    router = ReplicaRouter(model=model, n_replicas=1, autoscale=policy,
+                           max_slots=2, max_len=32, buckets=[8],
+                           max_queue=16)
+    rng = np.random.RandomState(3)
+    reqs = [router.submit(rng.randint(1, 97, size=4).tolist(),
+                          max_new_tokens=4) for _ in range(12)]
+    router.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    s = router.stats()
+    assert s["autoscale"]["scale_ups"] >= 1
+    assert s["completed"] == 12
+    # idle steps past the cooldown: shrink back to min_replicas
+    for _ in range(10):
+        router.step()
+    s = router.stats()
+    assert s["autoscale"]["scale_downs"] >= 1
+    assert s["replicas"] == 1
+    assert s["autoscale"]["retiring"] == 0
+
+
+def test_router_drain_returns_shed_count(model):
+    """drain() reports how many queued requests it gave up on — the
+    scale-in/shutdown accounting hook."""
+    clk = VirtualClock()
+    router = ReplicaRouter(model=model, n_replicas=1, max_slots=1,
+                           max_len=32, buckets=[8], max_queue=8,
+                           slo_ttft_ms=50.0, slo_prefill_ms=1.0,
+                           slo_tpot_ms=1.0, clock=clk.now)
+    rng = np.random.RandomState(4)
+    reqs = [router.submit(rng.randint(1, 97, size=4).tolist(),
+                          max_new_tokens=2) for _ in range(3)]
+    clk.advance(1.0)            # every deadline long expired in-queue
+    shed = router.drain()
+    assert shed == 3
+    assert all(r.state == "shed" and r.shed_reason == "deadline"
+               for r in reqs)
+    assert router.stats()["shed"]["deadline"] == 3
+    # a clean drain sheds nothing
+    assert router.drain() == 0
+
+
+# ------------------------------------------------------- chaos crossover
+@pytest.mark.chaos
+def test_chaos_goodput_degrades_gracefully(model):
+    """Fault injection on submit + alloc: goodput drops versus the
+    clean run, but zero unhandled exceptions escape, zero KV blocks
+    leak, and offered == completed + sheds (every request accounted
+    for)."""
+    def run(spec):
+        vc = VirtualClock()
+        lg = LoadGen(mode="poisson", seed=11, **_LG_KW)
+        if spec:
+            with fault_scope(spec, seed=2):
+                eng = _engine(model, vc.now)
+                return lg.run(eng, clock=vc, step_cost_ms=4.0)
+        eng = _engine(model, vc.now)
+        return lg.run(eng, clock=vc, step_cost_ms=4.0)
+
+    clean = run("")
+    faulty = run("serving.submit:skip@0.25;serving.alloc:skip@0.15")
+    for rep in (clean, faulty):
+        assert rep["exceptions"] == 0
+        assert rep["leaked_kv_blocks"] == 0
+        accounted = rep["completed"] + rep["shed_total"] + sum(
+            1 for d in rep["decisions"] if d[0] == "invalid")
+        assert accounted == rep["offered"]
+    assert faulty["shed"].get("fault", 0) > 0
+    assert faulty["completed"] < clean["completed"]
+    assert faulty["completed"] > 0       # degraded, not dead
+
+
+# ------------------------------------------------------------ the static side
+def test_predictor_admission_params_are_noops():
+    """predict_serving_compiles with SLO/priority/autoscale parameters
+    == without: admission is host-side queue surgery and must never
+    change the compiled step set."""
+    rounds = [[(list(range(1, 9)), 4), (list(range(1, 5)), 1)],
+              [(list(range(1, 9)), 4)]]
+    kw = dict(buckets=[8, 16], max_len=32, block_size=4)
+    plain = predict_serving_compiles(rounds, **kw)
+    assert plain  # non-trivial prediction
+    decorated = predict_serving_compiles(
+        rounds, slo_ttft_ms=250.0, priority_classes=[0, 1, 2],
+        autoscale=(1, 4), **kw)
+    assert decorated == plain
+    with pytest.raises(ValueError, match="slo_ttft_ms"):
+        predict_serving_compiles(rounds, slo_ttft_ms=-1.0, **kw)
+    with pytest.raises(ValueError, match="priority_classes"):
+        predict_serving_compiles(rounds, priority_classes=[], **kw)
+    with pytest.raises(ValueError, match="autoscale"):
+        predict_serving_compiles(rounds, autoscale=(3, 2), **kw)
+
+
+def test_warmup_resets_learned_costs(model):
+    """warmup() pays the compiles then drops the EWMAs, so the first
+    measured admission decision isn't poisoned by trace time."""
+    eng = ServingEngine(model, max_slots=2, max_len=32,
+                        buckets=[8, 16], max_queue=8,
+                        slo_ttft_ms=1000.0)
+    warmup(eng)
+    assert eng._prefill_ewma == {}
+    assert eng._tpot_ewma is None
+    assert eng.idle
+    assert eng.predict_ttft_ms(prompt_len=4) == 0.0   # cold: optimistic

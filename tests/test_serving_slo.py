@@ -1,0 +1,222 @@
+"""SLO-aware admission: predicted TTFT, priority classes, deadlines.
+
+The contract under test (engine.py "Admission"): with
+``slo_ttft_ms`` set the engine admits against a *predicted* TTFT —
+monotone non-decreasing in queue depth — instead of raw depth; a
+submission over budget is shed with ``reason="slo"`` and a
+Retry-After hint sized by the prediction; priority classes (lower =
+more urgent) preempt queued strictly-lower-priority work; and
+deadline-expired queued requests are shed *before* prefill, so an
+already-lost request never burns a dispatch. All of it is host-side
+queue surgery: the compiled step set is identical to a no-SLO engine.
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (QueueFullError, ServingEngine,
+                                ServingHTTPServer)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(11)
+    cfg = GPTConfig(vocab_size=97, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 97, size=n).tolist() for n in sizes]
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+
+# ------------------------------------------------------------ prediction
+def test_predicted_ttft_monotone_in_queue_depth(model):
+    """The property the SLO gate relies on: with pinned costs, the
+    predicted TTFT never decreases as the queue ahead grows, and
+    strictly increases across prefill-wave boundaries."""
+    eng = ServingEngine(model, max_slots=2, max_len=32,
+                        buckets=[8, 16], max_queue=64,
+                        slo_ttft_ms=10_000.0, slo_prefill_ms=10.0,
+                        slo_tpot_ms=2.0)
+    preds = [eng.predict_ttft_ms(prompt_len=4, queue_ahead=q)
+             for q in range(0, 12)]
+    assert all(b >= a for a, b in zip(preds, preds[1:])), preds
+    # one extra wave of prefills every max_slots queued requests
+    assert preds[eng.max_slots] > preds[0]
+    assert preds[2 * eng.max_slots] > preds[eng.max_slots]
+    # an empty queue with free slots costs exactly one prefill
+    assert preds[0] == pytest.approx(10.0)
+
+
+def test_slo_gate_sheds_with_reason_and_retry_after(model):
+    """Costs pinned so one queued wave already blows a 1ms budget: the
+    first submission (empty queue) fits, the next predicts over-SLO
+    and is shed with reason='slo' + a >= 1s Retry-After hint, and
+    stats() reports the shed and the (eventual) attainment."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=16, slo_ttft_ms=25.0,
+                        slo_prefill_ms=10.0, slo_tpot_ms=5.0)
+    p = _prompts((4, 4, 4), seed=1)
+    eng.submit(p[0], max_new_tokens=4)        # q=0: pred = 10ms, fits
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(p[1], max_new_tokens=4)    # q=1: + a 4-token round
+    assert ei.value.reason == "slo"
+    assert ei.value.retry_after_s >= 1
+    assert "predicted TTFT" in str(ei.value)
+    eng.run_until_idle()
+    s = eng.stats()
+    assert s["shed"] == {"slo": 1}
+    assert s["shed_total"] == 1
+    assert s["completed"] == 1
+    assert s["slo_ttft_ms"] == 25.0
+    assert s["slo_attainment"] is not None
+    assert "predicted_ttft_ms" in s
+
+
+def test_depth_only_engine_keeps_plain_queue_full(model):
+    """slo_ttft_ms=0 keeps PR-9 semantics bit-for-bit: depth-gated
+    admission, reason='queue_full', no deadlines stamped."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=1)
+    r = eng.submit(_prompts((4,))[0], max_new_tokens=2)
+    assert r.deadline is None
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(_prompts((4,))[0], max_new_tokens=2)
+    assert ei.value.reason == "queue_full"
+    eng.run_until_idle()
+
+
+# -------------------------------------------------------------- priority
+def test_priority_preempts_queued_lower_priority(model):
+    """A full queue plus an urgent submission: the newest queued
+    request of the worst class is shed (reason='preempted'), the
+    urgent one is admitted, and peers are never victims."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=3)
+    eng.submit(_prompts((4,))[0], max_new_tokens=2, priority=1)
+    low = [eng.submit(p, max_new_tokens=2, priority=2)
+           for p in _prompts((4, 4), seed=2)]
+    urgent = eng.submit(_prompts((4,), seed=3)[0], max_new_tokens=2,
+                        priority=0)
+    assert low[-1].state == "shed"          # newest of the worst class
+    assert low[-1].shed_reason == "preempted"
+    assert low[0].state != "shed"
+    eng.run_until_idle()
+    assert urgent.state == "done"
+    s = eng.stats()
+    assert s["shed"].get("preempted") == 1
+    # peers don't preempt peers: a same-class submission into the
+    # re-filled queue is plain queue_full
+    eng2 = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                         max_queue=1)
+    eng2.submit(_prompts((4,))[0], max_new_tokens=2, priority=1)
+    with pytest.raises(QueueFullError) as ei:
+        eng2.submit(_prompts((4,))[0], max_new_tokens=2, priority=1)
+    assert ei.value.reason == "queue_full"
+    eng2.run_until_idle()
+
+
+def test_priority_orders_admission_fifo_within_class(model):
+    """Mixed-priority queue drains urgent-first, FIFO within a class;
+    all-default queues keep pure submission order (the token-identity
+    oracle of test_serving.py depends on that)."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=16)
+    a = eng.submit(_prompts((4,), seed=4)[0], max_new_tokens=2,
+                   priority=2)
+    b = eng.submit(_prompts((4,), seed=5)[0], max_new_tokens=2,
+                   priority=2)
+    c = eng.submit(_prompts((4,), seed=6)[0], max_new_tokens=2,
+                   priority=0)
+    eng.run_until_idle()
+    assert all(r.state == "done" for r in (a, b, c))
+    assert c.first_token_at < a.first_token_at < b.first_token_at
+
+
+# -------------------------------------------------------------- deadline
+def test_deadline_expired_queued_requests_shed_before_prefill(model):
+    """Virtual clock jumps past every deadline while the requests sit
+    queued: the scheduler sheds them (reason='deadline') without
+    spending a single prefill dispatch."""
+    clk = _Clock()
+    eng = ServingEngine(model, max_slots=2, max_len=32, buckets=[8],
+                        max_queue=16, slo_ttft_ms=50.0,
+                        slo_prefill_ms=1.0, slo_tpot_ms=1.0,
+                        clock=clk.now)
+    reqs = [eng.submit(p, max_new_tokens=2)
+            for p in _prompts((4, 4, 4), seed=7)]
+    assert all(r.deadline == pytest.approx(0.05) for r in reqs)
+    clk.t = 1.0                      # everything is now long expired
+    eng.run_until_idle()
+    assert all(r.state == "shed" and r.shed_reason == "deadline"
+               for r in reqs)
+    assert all(r.deadline_met is False for r in reqs)
+    # no prefill entry was ever built, let alone traced
+    assert eng._prefill_fns == {}
+    assert eng.stats()["shed"] == {"deadline": 3}
+
+
+# ------------------------------------------------------------------ http
+def test_http_priority_and_retry_after_from_prediction(model):
+    """The HTTP front end routes the priority field through, surfaces
+    the predicted-TTFT Retry-After and shed reason on 429, and the
+    SLO/shed aggregates in /v1/stats."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=8, slo_ttft_ms=25.0,
+                        slo_prefill_ms=10.0, slo_tpot_ms=5.0)
+    srv = ServingHTTPServer(eng, port=0)
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        body = {"ids": _prompts((4,), seed=8)[0], "max_new_tokens": 4,
+                "priority": 0}
+        conn.request("POST", "/v1/generate", json.dumps(body))
+        r = conn.getresponse()
+        assert r.status == 200
+        assert json.loads(r.read())["state"] == "done"
+        # saturate deterministically: park the scheduler (the HTTP
+        # thread keeps serving) and queue work so the next arrival's
+        # prediction blows the budget
+        eng.stop()
+        # a priority-0 peer: the POST (also priority 0) can't preempt
+        # it, so the over-budget prediction MUST 429
+        queued = eng.submit(_prompts((8,), seed=10)[0],
+                            max_new_tokens=8, priority=0)
+        conn.request("POST", "/v1/generate", json.dumps(body))
+        r = conn.getresponse()
+        payload = json.loads(r.read())
+        assert r.status == 429
+        assert payload["reason"] == "slo"
+        assert int(r.getheader("Retry-After")) >= 1
+        eng.run_until_idle()
+        # done if drained inside its 25ms deadline window, deadline-
+        # shed otherwise — either way admission handled it, host-side
+        assert queued.state in ("done", "shed")
+        conn.request("GET", "/v1/stats")
+        r = conn.getresponse()
+        stats = json.loads(r.read())
+        assert r.status == 200
+        assert stats["shed"].get("slo", 0) >= 1
+        assert stats["slo_attainment"] is not None
+        conn.close()
+    finally:
+        srv.stop()

@@ -32,10 +32,14 @@
 #      /metrics parses as Prometheus text, compile tracker pins the
 #      decode/prefill compile budget, run-log events feed
 #      tools/trace_summary.py)
-#   9. op coverage gate (>= 80% of the reference forward-op surface)
-#  10. API-freeze check (public signature snapshot diff)
-#  11. multi-chip dry-run (GSPMD train step on N virtual devices)
-#  12. README generated fragments vs their registries (no drift)
+#   9. loadgen SLO gate (seeded open-loop traffic through the
+#      SLO-admitting gpt2-tiny engine: goodput > 0 with attainment
+#      reported and zero leaked KV blocks, then the chaos crossover —
+#      submit/alloc faults injected, degradation must stay graceful)
+#  10. op coverage gate (>= 80% of the reference forward-op surface)
+#  11. API-freeze check (public signature snapshot diff)
+#  12. multi-chip dry-run (GSPMD train step on N virtual devices)
+#  13. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -43,7 +47,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/12 import smoke"
+echo "== 1/13 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -52,11 +56,11 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/12 lint (program verifier + shape inference + op-desc compat)"
+echo "== 2/13 lint (program verifier + shape inference + op-desc compat)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --books --shapes
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
-echo "== 3/12 sharding-rule lint (GSPMD pre-flight)"
+echo "== 3/13 sharding-rule lint (GSPMD pre-flight)"
 # the GPT TP table, the ZeRO-style fully-sharded merge, and the serving
 # TP table (the mesh-sharded engine's placement rules on its
 # ("data","model") mesh) against the GPT benchmark model: no unknown
@@ -68,26 +72,26 @@ JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset serving_tp --mesh data=
 JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp+fully_sharded --mesh dp=2,mp=2 --json > /dev/null
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/12 test suite (virtual 8-device CPU mesh)"
+  echo "== 4/13 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 4/12 test suite: SKIPPED (quick mode)"
+  echo "== 4/13 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/12 chaos suite (deterministic fault injection)"
+  echo "== 5/13 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 5/12 chaos suite: reduced subset (quick mode)"
+  echo "== 5/13 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 6/12 serving plane (incl. paged-KV equivalence)"
+  echo "== 6/13 serving plane (incl. paged-KV equivalence)"
   # the full file carries the paged oracle: engine output token-identical
   # to sequential greedy with the prefix cache on AND off, plus the
   # dense paged=False baseline and the paged compile-count pins
@@ -100,7 +104,7 @@ if [[ "${1:-}" != "quick" ]]; then
   # replicas share one model and compile each step exactly once
   python -m pytest tests/test_serving_mesh.py tests/test_serving_router.py -q
 else
-  echo "== 6/12 serving plane: reduced subset (quick mode)"
+  echo "== 6/13 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv or block_allocator \
 or paged_engine_matches or dense_engine_still or prefix_reuse"
@@ -113,7 +117,7 @@ or paged_engine_matches or dense_engine_still or prefix_reuse"
 or head_sharded or drain or chaos_skip"
 fi
 
-echo "== 7/12 speculative decoding gate"
+echo "== 7/13 speculative decoding gate"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -k "spec"
 if [[ "${1:-}" != "quick" ]]; then
   echo "   bench: spec vs non-spec on the repetitive-suffix workload"
@@ -122,7 +126,7 @@ if [[ "${1:-}" != "quick" ]]; then
     BENCH_SERVING_COMPARE=0 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== 8/12 observability gate"
+echo "== 8/13 observability gate"
 # tiny train + serving smoke under the run log: /metrics parses as
 # Prometheus text (incl. KV block-pool gauges), compile tracker pins
 # decode_step_paged==1 compile and one batched prefill dispatch, a
@@ -130,14 +134,57 @@ echo "== 8/12 observability gate"
 # trace_summary
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
-echo "== 9/12 op coverage gate"
+echo "== 9/13 loadgen SLO gate (goodput under real traffic)"
+# seeded open-loop traffic through the gpt2-tiny engine with SLO-aware
+# admission: goodput > 0 with attainment reported, zero leaked KV
+# blocks, zero unhandled exceptions — then the chaos crossover: the
+# same workload with submit/alloc faults injected must degrade
+# gracefully (goodput still > 0, every loss accounted as a shed,
+# still zero leaks)
+if [[ "${1:-}" != "quick" ]]; then
+  LG_DURATION=2; LG_RATE=20
+else
+  LG_DURATION=1; LG_RATE=12
+fi
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode bursty --rate "$LG_RATE" --duration "$LG_DURATION" --seed 0 \
+  --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16 \
+  --new-tokens 2:8 --priority-mix 0:0.2,1:0.6,2:0.2 \
+  --slo-ttft-ms 2000 --json \
+  --expect-goodput-min 0.5 --expect-zero-leaks \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+assert r['slo_attainment'] is not None, r
+assert r['exceptions'] == 0, r
+print(f\"   clean: goodput {r['goodput_per_s']}/s, \"
+      f\"attainment {r['slo_attainment']}\")
+"
+echo "   chaos crossover (serving.submit + serving.alloc faults)"
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode bursty --rate "$LG_RATE" --duration "$LG_DURATION" --seed 0 \
+  --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16 \
+  --new-tokens 2:8 --priority-mix 0:0.2,1:0.6,2:0.2 \
+  --slo-ttft-ms 2000 --json \
+  --fault-spec "serving.submit:skip@0.2;serving.alloc:skip@0.2" \
+  --expect-goodput-min 0.1 --expect-zero-leaks --expect-sheds-min 1 \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+assert r['exceptions'] == 0, r
+assert r['shed'].get('fault', 0) >= 1, r
+print(f\"   chaos: goodput {r['goodput_per_s']}/s, \"
+      f\"{r['shed_total']} shed ({r['shed']}), 0 leaks\")
+"
+
+echo "== 10/13 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 10/12 API freeze"
+echo "== 11/13 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -156,7 +203,7 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 11/12 multi-chip dry run"
+echo "== 12/13 multi-chip dry run"
 # needs the jax_num_cpu_devices config option to carve out virtual CPU
 # devices; older jax builds (0.4.x) don't have it
 if JAX_PLATFORMS=cpu python -c "
@@ -172,7 +219,7 @@ else
   echo "   installed jax has no jax_num_cpu_devices — skipped"
 fi
 
-echo "== 12/12 README generated-fragment sync"
+echo "== 13/13 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

@@ -1,0 +1,588 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serving plane.
+
+Drives a :class:`ServingEngine` or :class:`ReplicaRouter` directly —
+no HTTP hop — with a replayable synthetic arrival process, and reports
+goodput under the TTFT SLO: the regression-locked "real traffic"
+scenario (ROADMAP item 2, ``BENCH_MODEL=loadgen``).
+
+Arrival processes (all derived from one ``np.random.RandomState(seed)``
+by thinning against the peak rate, so the same seed reproduces the
+same trace byte for byte):
+
+- ``poisson``: constant-rate open-loop arrivals (exponential
+  inter-arrival gaps) — the classic steady-state model;
+- ``bursty``: a two-state Markov-modulated Poisson process — calm
+  periods at ``rate`` alternating with bursts at ``rate *
+  burst_factor``, sojourn times exponential around ``switch_every``
+  (calm) and ``switch_every * burst_fraction`` (burst). This is the
+  overload-robustness workload: mean load may be serveable while
+  bursts are not;
+- ``diurnal``: sinusoidal rate ``rate * (1 + amplitude *
+  sin(2*pi*t/period))`` — a whole "day" of traffic compressed into
+  ``duration`` seconds.
+
+Each arrival carries a prompt sampled from a mixed length distribution
+(70% "chat-short" uniform on the lower half of ``prompt_tokens``, 30%
+"doc-long" uniform on the upper half), a new-token budget sampled the
+same way from ``new_tokens``, and a priority class drawn from
+``priority_mix`` (lower = more urgent). ``trace_bytes()`` serializes
+the schedule canonically — the determinism tests assert two same-seed
+generators produce identical bytes AND identical admit/shed decisions.
+
+Two execution modes:
+
+- **wall clock** (default): arrivals are released on the real clock
+  and the target is stepped between releases — the bench/CI path;
+- **virtual clock** (``clock=VirtualClock()``, engines constructed
+  with ``clock=vc.now`` and *pinned* predictor costs): the loop
+  advances time by ``step_cost_ms`` per scheduler step and jumps
+  across idle gaps. Fully deterministic — timestamps, TTFTs, admit
+  and shed decisions replay exactly; used by the determinism tests
+  and the obs_smoke loadgen phase (where it also proves admission
+  adds zero XLA compiles).
+
+Per-request trace rows record arrival time, admit/shed decision (with
+the shed reason), TTFT, TPOT and whether the deadline was met; the
+report aggregates offered load, goodput (SLO-met completions/s),
+throughput, attainment, per-reason sheds, latency percentiles, leaked
+KV blocks (after a prefix-cache flush; the trash block is exempt) and
+the count of unexpected exceptions (the graceful-degradation contract
+demands 0 even under ``FLAGS_fault_spec``).
+
+CLI (gates live in tools/ci.sh; full flag list via --help):
+
+  JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+      --mode bursty --rate 20 --duration 3 --seed 0 \
+      --slo-ttft-ms 2000 --json --expect-goodput-min 0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import List, NamedTuple, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+class Arrival(NamedTuple):
+    t: float               # seconds since the run started
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    priority: int
+
+
+class VirtualClock:
+    """Deterministic time source for replayable runs: pass ``vc.now``
+    as the engine's ``clock`` and let the loadgen loop ``advance`` it
+    a fixed cost per scheduler step."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        if dt < 0:
+            raise ValueError(f"cannot rewind the clock by {dt}")
+        self.t += dt
+
+
+class LoadGen:
+    """Replayable open-loop traffic source; see the module docstring.
+
+    ``rate`` is the calm/mean arrival rate in requests/s (``bursty``
+    exceeds it during bursts, ``diurnal`` oscillates around it);
+    ``duration`` is the arrival window in seconds — the run itself
+    continues until the target drains. ``prompt_tokens`` /
+    ``new_tokens`` are inclusive (lo, hi) ranges; ``priority_mix``
+    maps priority class -> weight (default: everything class 1).
+    """
+
+    MODES = ("poisson", "bursty", "diurnal")
+
+    def __init__(self, mode: str = "poisson", rate: float = 8.0,
+                 duration: float = 4.0, seed: int = 0,
+                 vocab_size: int = 1024,
+                 prompt_tokens: Tuple[int, int] = (4, 24),
+                 new_tokens: Tuple[int, int] = (2, 16),
+                 priority_mix: Optional[dict] = None,
+                 burst_factor: float = 8.0,
+                 burst_fraction: float = 0.25,
+                 switch_every: float = 1.0,
+                 diurnal_period: Optional[float] = None,
+                 diurnal_amplitude: float = 0.8):
+        if mode not in self.MODES:
+            raise ValueError(f"mode must be one of {self.MODES}, "
+                             f"got {mode!r}")
+        if rate <= 0 or duration <= 0:
+            raise ValueError("rate and duration must be > 0")
+        if not (0 < diurnal_amplitude < 1) and mode == "diurnal":
+            raise ValueError("diurnal_amplitude must be in (0, 1)")
+        for lo, hi, name in [(prompt_tokens[0], prompt_tokens[1],
+                              "prompt_tokens"),
+                             (new_tokens[0], new_tokens[1],
+                              "new_tokens")]:
+            if lo < 1 or hi < lo:
+                raise ValueError(f"{name} must satisfy 1 <= lo <= hi")
+        self.mode = mode
+        self.rate = float(rate)
+        self.duration = float(duration)
+        self.seed = int(seed)
+        self.vocab_size = int(vocab_size)
+        self.prompt_tokens = (int(prompt_tokens[0]),
+                              int(prompt_tokens[1]))
+        self.new_tokens = (int(new_tokens[0]), int(new_tokens[1]))
+        mix = priority_mix if priority_mix else {1: 1.0}
+        total = float(sum(mix.values()))
+        if total <= 0 or any(w < 0 for w in mix.values()):
+            raise ValueError("priority_mix weights must be >= 0 with a "
+                             "positive sum")
+        self._pri_vals = sorted(int(p) for p in mix)
+        self._pri_probs = [float(mix[p]) / total for p in self._pri_vals]
+        self.burst_factor = float(burst_factor)
+        self.burst_fraction = float(burst_fraction)
+        self.switch_every = float(switch_every)
+        self.diurnal_period = float(diurnal_period if diurnal_period
+                                    else duration)
+        self.diurnal_amplitude = float(diurnal_amplitude)
+        self._schedule: Optional[List[Arrival]] = None
+
+    # ---------------------------------------------------------- schedule
+    def _burst_segments(self, rng) -> List[Tuple[float, float]]:
+        """Alternating (start_time, rate) segments covering the
+        arrival window — the modulating Markov chain, sampled once."""
+        segs, t, calm = [], 0.0, True
+        while t < self.duration:
+            segs.append((t, self.rate if calm
+                         else self.rate * self.burst_factor))
+            mean = (self.switch_every if calm
+                    else self.switch_every * self.burst_fraction)
+            t += float(rng.exponential(mean))
+            calm = not calm
+        return segs
+
+    def _sample_span(self, rng, lo: int, hi: int) -> int:
+        """Mixed length distribution: 70% uniform on [lo, mid] (the
+        chat-short mode), 30% uniform on [mid, hi] (doc-long)."""
+        mid = (lo + hi) // 2
+        if rng.uniform() < 0.7:
+            return int(rng.randint(lo, mid + 1))
+        return int(rng.randint(mid, hi + 1))
+
+    def schedule(self) -> List[Arrival]:
+        """The full arrival trace (cached; same seed => same trace).
+        Arrivals are generated by thinning a peak-rate Poisson stream,
+        consuming the RNG identically whether a candidate is kept or
+        thinned — replayability does not depend on acceptance."""
+        if self._schedule is not None:
+            return self._schedule
+        rng = np.random.RandomState(self.seed)
+        if self.mode == "poisson":
+            peak = self.rate
+            segs = None
+        elif self.mode == "bursty":
+            peak = self.rate * self.burst_factor
+            segs = self._burst_segments(rng)
+        else:  # diurnal
+            peak = self.rate * (1.0 + self.diurnal_amplitude)
+            segs = None
+
+        def rate_at(t: float) -> float:
+            if self.mode == "poisson":
+                return self.rate
+            if self.mode == "diurnal":
+                return self.rate * (1.0 + self.diurnal_amplitude *
+                                    math.sin(2.0 * math.pi * t /
+                                             self.diurnal_period))
+            r = segs[0][1]
+            for start, seg_rate in segs:
+                if start > t:
+                    break
+                r = seg_rate
+            return r
+
+        out: List[Arrival] = []
+        t = 0.0
+        while True:
+            t += float(rng.exponential(1.0 / peak))
+            if t >= self.duration:
+                break
+            keep = float(rng.uniform()) * peak <= rate_at(t)
+            plen = self._sample_span(rng, *self.prompt_tokens)
+            mnt = self._sample_span(rng, *self.new_tokens)
+            prompt = tuple(int(x) for x in
+                           rng.randint(1, self.vocab_size, size=plen))
+            pri = int(self._pri_vals[int(
+                rng.choice(len(self._pri_vals), p=self._pri_probs))])
+            if keep:
+                out.append(Arrival(round(t, 9), prompt, mnt, pri))
+        self._schedule = out
+        return out
+
+    def trace_bytes(self) -> bytes:
+        """Canonical JSON of the arrival schedule — the byte-identity
+        surface of the determinism contract."""
+        payload = {
+            "mode": self.mode, "rate": self.rate,
+            "duration": self.duration, "seed": self.seed,
+            "arrivals": [[a.t, list(a.prompt), a.max_new_tokens,
+                          a.priority] for a in self.schedule()],
+        }
+        return json.dumps(payload, sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    # --------------------------------------------------------------- run
+    @staticmethod
+    def _engines(target) -> list:
+        engs = getattr(target, "engines", None)
+        if engs is None:
+            return [target]
+        return list(engs) + list(getattr(target, "_retiring", []))
+
+    def run(self, target, clock: Optional[VirtualClock] = None,
+            step_cost_ms: float = 0.0,
+            slo_ttft_ms: Optional[float] = None,
+            include_trace: bool = False,
+            max_steps: int = 200_000) -> dict:
+        """Release the schedule open-loop into ``target`` and drive it
+        to drain; returns the report dict.
+
+        With ``clock`` the run is virtual: the target's engines must
+        share the same clock (``clock=vc.now`` at construction) and
+        each scheduler step advances it ``step_cost_ms``. Without it,
+        arrivals ride the wall clock. ``slo_ttft_ms`` sets a post-hoc
+        SLO for goodput when the engines run without one (the
+        depth-only baseline); engines with their own SLO use their
+        deadline verdicts."""
+        arrivals = self.schedule()
+        records = [{"i": i, "t": a.t, "prompt_tokens": len(a.prompt),
+                    "max_new_tokens": a.max_new_tokens,
+                    "priority": a.priority, "outcome": None,
+                    "reason": None, "req": None}
+                   for i, a in enumerate(arrivals)]
+        from paddle_tpu.serving import QueueFullError
+        exceptions = 0
+        t0 = clock.now() if clock is not None else time.perf_counter()
+
+        def now_s() -> float:
+            return ((clock.now() if clock is not None
+                     else time.perf_counter()) - t0)
+
+        def release(rec, arr):
+            nonlocal exceptions
+            try:
+                rec["req"] = target.submit(
+                    list(arr.prompt), max_new_tokens=arr.max_new_tokens,
+                    priority=arr.priority)
+                rec["outcome"] = "admitted"
+            except QueueFullError as e:
+                rec["outcome"] = "rejected"
+                rec["reason"] = getattr(e, "reason", "queue_full")
+            except ValueError as e:
+                rec["outcome"] = "invalid"
+                rec["reason"] = str(e)
+            except Exception as e:   # graceful degradation: count, go on
+                exceptions += 1
+                rec["outcome"] = "error"
+                rec["reason"] = f"{type(e).__name__}: {e}"
+
+        i, steps = 0, 0
+        while i < len(arrivals) or not target.idle:
+            while i < len(arrivals) and arrivals[i].t <= now_s():
+                release(records[i], arrivals[i])
+                i += 1
+            if target.idle:
+                if i >= len(arrivals):
+                    break
+                gap = arrivals[i].t - now_s()
+                if clock is not None:
+                    clock.advance(max(0.0, gap))
+                else:
+                    time.sleep(min(max(gap, 0.0), 0.05))
+                continue
+            target.step()
+            if clock is not None:
+                clock.advance(step_cost_ms / 1e3)
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"loadgen target not drained after {max_steps} "
+                    "steps")
+        makespan = max(now_s(), 1e-9)
+        return self._report(records, makespan, steps, slo_ttft_ms,
+                            target, exceptions, include_trace)
+
+    def _report(self, records, makespan, steps, slo_ttft_ms, target,
+                exceptions, include_trace) -> dict:
+        shed: dict = {}
+        decisions: List[List] = []
+        ttfts, tpots = [], []
+        completed = slo_met = slo_known = 0
+        for rec in records:
+            req = rec.pop("req")
+            if req is not None:
+                rec["outcome"] = ("done" if req.state == "done"
+                                  else req.state)
+                rec["reason"] = req.shed_reason
+                rec["ttft_ms"] = (None if req.ttft is None
+                                  else round(req.ttft * 1e3, 3))
+                rec["tpot_ms"] = (None if req.tpot is None
+                                  else round(req.tpot * 1e3, 3))
+                met = req.deadline_met
+                if met is None and slo_ttft_ms and req.ttft is not None:
+                    met = req.ttft * 1e3 <= slo_ttft_ms
+                rec["deadline_met"] = met
+                if req.state == "done":
+                    completed += 1
+                    if req.ttft is not None:
+                        ttfts.append(req.ttft * 1e3)
+                    if req.tpot is not None:
+                        tpots.append(req.tpot * 1e3)
+                    if met is not None:
+                        slo_known += 1
+                        slo_met += int(met)
+            if rec["outcome"] in ("shed", "rejected"):
+                key = rec["reason"] or "unknown"
+                shed[key] = shed.get(key, 0) + 1
+            decisions.append([rec["outcome"], rec.get("reason")])
+
+        leaked = 0
+        for eng in self._engines(target):
+            if getattr(eng, "paged", False):
+                eng.cache.flush_prefix_cache()
+                leaked += max(0, eng.cache.allocator.leaked() - 1)
+
+        def pct(vals, q):
+            return (round(float(np.percentile(vals, q)), 3)
+                    if vals else None)
+
+        engine_slo = next((e.slo_ttft_ms
+                           for e in self._engines(target)
+                           if e.slo_ttft_ms), 0.0)
+        report = {
+            "mode": self.mode, "seed": self.seed, "rate": self.rate,
+            "duration_s": self.duration,
+            "offered": len(records),
+            "offered_rate": round(len(records) / self.duration, 3),
+            "makespan_s": round(makespan, 6),
+            "steps": steps,
+            "admitted": sum(1 for d in decisions
+                            if d[0] in ("done", "shed")),
+            "completed": completed,
+            "shed": shed,
+            "shed_total": sum(shed.values()),
+            "exceptions": exceptions,
+            "slo_ttft_ms": engine_slo or slo_ttft_ms or None,
+            "slo_met": slo_met if slo_known else None,
+            "slo_attainment": (round(slo_met / slo_known, 4)
+                               if slo_known else None),
+            "goodput_per_s": (round(slo_met / makespan, 4)
+                              if slo_known else None),
+            "throughput_per_s": round(completed / makespan, 4),
+            "ttft_ms_p50": pct(ttfts, 50),
+            "ttft_ms_p95": pct(ttfts, 95),
+            "ttft_ms_p99": pct(ttfts, 99),
+            "tpot_ms_p50": pct(tpots, 50),
+            "tpot_ms_p99": pct(tpots, 99),
+            "leaked_kv_blocks": leaked,
+            "decisions": decisions,
+        }
+        if include_trace:
+            report["trace"] = records
+        return report
+
+
+def warmup(target, max_new_tokens: int = 2):
+    """Pay the XLA compiles before any measured/admission-bearing
+    traffic: one request per prefill bucket plus the decode step, run
+    to idle, then drop each engine's learned cost EWMAs so predictions
+    reflect steady-state dispatch costs, not trace time."""
+    from paddle_tpu.serving import QueueFullError
+    engines = LoadGen._engines(target)
+    eng = engines[0]
+    for b in eng.buckets:
+        plen = max(1, min(b, eng.max_len - max_new_tokens -
+                          eng.spec_tokens))
+        for _ in range(50):   # ride out injected submit faults
+            try:
+                target.submit([1] * plen,
+                              max_new_tokens=max_new_tokens)
+                break
+            except QueueFullError:
+                target.run_until_idle()
+    target.run_until_idle()
+    for e in engines:
+        e.reset_cost_estimates()
+        if e.paged:
+            e.cache.flush_prefix_cache()
+
+
+# ------------------------------------------------------------------ CLI
+def _parse_range(text: str) -> Tuple[int, int]:
+    lo, hi = (int(p) for p in str(text).split(":"))
+    return lo, hi
+
+
+def _parse_mix(text: str) -> Optional[dict]:
+    if not text:
+        return None
+    out = {}
+    for part in text.split(","):
+        k, v = part.split(":")
+        out[int(k)] = float(v)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop load generator for the serving plane")
+    ap.add_argument("--mode", default="poisson",
+                    choices=list(LoadGen.MODES))
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="calm/mean arrival rate, requests/s")
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="arrival window, seconds")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--model", default="gpt2-tiny",
+                    help="GPT_CONFIGS name")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--buckets", default="16,32,64",
+                    help="comma-separated prefill buckets")
+    ap.add_argument("--prompt-tokens", type=_parse_range, default=(4, 24),
+                    metavar="LO:HI")
+    ap.add_argument("--new-tokens", type=_parse_range, default=(2, 16),
+                    metavar="LO:HI")
+    ap.add_argument("--priority-mix", type=_parse_mix, default=None,
+                    metavar="P:W,P:W", help="priority class weights, "
+                    "e.g. '0:0.1,1:0.8,2:0.1' (lower = more urgent)")
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                    help="> 0 turns on SLO-aware admission; also the "
+                    "goodput SLO for reporting")
+    ap.add_argument("--slo-prefill-ms", type=float, default=0.0,
+                    help="pin the predictor's prefill cost (0 = EWMA)")
+    ap.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                    help="pin the predictor's per-token cost (0 = EWMA)")
+    ap.add_argument("--depth-only", action="store_true",
+                    help="run the engine WITHOUT SLO admission but "
+                    "still score goodput against --slo-ttft-ms "
+                    "(the baseline arm of the bench)")
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--autoscale", default="", metavar="MIN:MAX",
+                    help="enable router autoscaling inside the bounds")
+    ap.add_argument("--virtual-step-ms", type=float, default=0.0,
+                    help="> 0 runs on a virtual clock advancing this "
+                    "much per step (fully deterministic replay)")
+    ap.add_argument("--fault-spec", default="",
+                    help="chaos crossover: FLAGS_fault_spec for the run "
+                    "(e.g. 'serving.submit:skip@0.2;serving.alloc:"
+                    "skip@0.2')")
+    ap.add_argument("--fault-seed", type=int, default=0)
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON line")
+    ap.add_argument("--trace", default="",
+                    help="write the per-request trace JSON here")
+    ap.add_argument("--expect-goodput-min", type=float, default=None,
+                    help="exit 1 unless goodput_per_s >= this")
+    ap.add_argument("--expect-zero-leaks", action="store_true",
+                    help="exit 1 unless leaked_kv_blocks == 0")
+    ap.add_argument("--expect-sheds-min", type=int, default=None,
+                    help="exit 1 unless shed_total >= this (chaos runs "
+                    "must actually shed)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPT_CONFIGS, GPTForCausalLM
+    from paddle_tpu.resilience import fault_scope
+    from paddle_tpu.serving import AutoscalePolicy, ReplicaRouter, \
+        ServingEngine
+    from paddle_tpu.serving.router import _parse_autoscale
+
+    from contextlib import nullcontext
+    ctx = (fault_scope(args.fault_spec, seed=args.fault_seed)
+           if args.fault_spec else nullcontext())
+    cfg = GPT_CONFIGS[args.model]
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    lg = LoadGen(mode=args.mode, rate=args.rate,
+                 duration=args.duration, seed=args.seed,
+                 vocab_size=cfg.vocab_size,
+                 prompt_tokens=args.prompt_tokens,
+                 new_tokens=args.new_tokens,
+                 priority_mix=args.priority_mix)
+    vc = (VirtualClock() if args.virtual_step_ms > 0 else None)
+    eng_kwargs = dict(
+        max_slots=args.slots, max_len=args.max_len,
+        max_queue=args.max_queue,
+        buckets=[int(b) for b in args.buckets.split(",")],
+        slo_ttft_ms=0.0 if args.depth_only else args.slo_ttft_ms,
+        slo_prefill_ms=args.slo_prefill_ms,
+        slo_tpot_ms=args.slo_tpot_ms)
+    if vc is not None:
+        eng_kwargs["clock"] = vc.now
+    with ctx:
+        bounds = _parse_autoscale(args.autoscale)
+        if args.replicas > 1 or bounds is not None:
+            target = ReplicaRouter(
+                model=model, n_replicas=args.replicas,
+                autoscale=(None if bounds is None else AutoscalePolicy(
+                    min_replicas=bounds[0], max_replicas=bounds[1])),
+                **eng_kwargs)
+        else:
+            target = ServingEngine(model, **eng_kwargs)
+        if not args.no_warmup:
+            warmup(target)
+        report = lg.run(target, clock=vc,
+                        step_cost_ms=args.virtual_step_ms,
+                        slo_ttft_ms=args.slo_ttft_ms or None,
+                        include_trace=bool(args.trace))
+    trace = report.pop("trace", None)
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump({"schedule": json.loads(lg.trace_bytes()),
+                       "requests": trace}, f)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for k, v in report.items():
+            if k != "decisions":
+                print(f"{k}: {v}")
+    ok = True
+    if args.expect_goodput_min is not None:
+        g = report["goodput_per_s"]
+        if g is None or g < args.expect_goodput_min:
+            print(f"FAIL: goodput_per_s {g} < "
+                  f"{args.expect_goodput_min}", file=sys.stderr)
+            ok = False
+    if args.expect_zero_leaks and report["leaked_kv_blocks"] != 0:
+        print(f"FAIL: leaked_kv_blocks = "
+              f"{report['leaked_kv_blocks']}", file=sys.stderr)
+        ok = False
+    if args.expect_sheds_min is not None and \
+            report["shed_total"] < args.expect_sheds_min:
+        print(f"FAIL: shed_total {report['shed_total']} < "
+              f"{args.expect_sheds_min}", file=sys.stderr)
+        ok = False
+    if report["exceptions"]:
+        print(f"FAIL: {report['exceptions']} unhandled exceptions",
+              file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,9 +20,14 @@ Asserts, end to end through the observability plane:
     through a 1x1 ("data", "model") serving mesh (new mesh cache key:
     exactly one more compile per site) stays token-identical, with the
     merged four-phase prediction still equal to the tracker;
+  - a seeded bursty loadgen run through an engine with SLO-aware
+    admission (constructor-arg SLO/pins/priorities, never set_flags)
+    completes with goodput > 0, zero leaked KV blocks and ZERO new
+    compiles — and the recompile predictor agrees the admission
+    parameters are no-ops;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
-    carries serving, fault, compile, KV block-pool, attention-impl and
-    int8-quantization metrics;
+    carries serving, fault, compile, KV block-pool, attention-impl,
+    int8-quantization and SLO-admission metrics;
   - tools/trace_summary.py consumes the emitted JSONL run log.
 
 Run from the repo root:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
@@ -240,6 +245,49 @@ def main() -> int:
     print(f"   mesh phase: 2 replicas + 1x1 mesh token-identical, "
           f"merged prediction == observed ({observed4})")
 
+    # -- loadgen phase: SLO-aware admission adds ZERO compiles --------
+    # A bursty open-loop workload on a virtual clock through an engine
+    # with predictive admission (SLO + pinned costs + priority mix —
+    # all constructor args, never set_flags, so the flags version and
+    # the warm step cache survive). Prompt lengths stay inside the
+    # already-compiled bucket: the tracker must not move at all, and
+    # the predictor must agree that admission parameters are no-ops.
+    from tools.loadgen import LoadGen, VirtualClock
+    vc = VirtualClock()
+    eng5 = ServingEngine(model, max_slots=3, max_len=32,
+                         buckets=[8, 16], max_queue=16, block_size=4,
+                         clock=vc.now, slo_ttft_ms=40.0,
+                         slo_prefill_ms=4.0, slo_tpot_ms=1.0)
+    lg = LoadGen(mode="bursty", rate=60.0, duration=1.0, seed=3,
+                 vocab_size=97, prompt_tokens=(3, 7),
+                 new_tokens=(2, 4),
+                 priority_mix={0: 0.2, 1: 0.6, 2: 0.2})
+    report = lg.run(eng5, clock=vc, step_cost_ms=4.0)
+    assert report["offered"] > 0 and report["completed"] > 0, report
+    assert report["exceptions"] == 0, report
+    assert report["leaked_kv_blocks"] == 0, report
+    assert report["slo_attainment"] is not None, report
+    assert len(report["decisions"]) == report["offered"]
+    comp5 = observability.compiles()
+    observed5 = {site: c["count"] for site, c in comp5.items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+    assert observed5 == observed4, (
+        f"SLO-aware admission must add ZERO compiles:\n"
+        f"  before {observed4}\n  after  {observed5}")
+    lg_workload = [[(list(a.prompt), a.max_new_tokens)
+                    for a in lg.schedule()]]
+    plain_pred = predict_serving_compiles(
+        lg_workload, buckets=[8, 16], max_len=32, block_size=4)
+    slo_pred = predict_serving_compiles(
+        lg_workload, buckets=[8, 16], max_len=32, block_size=4,
+        slo_ttft_ms=40.0, priority_classes=[0, 1, 2],
+        autoscale=(1, 2))
+    assert slo_pred == plain_pred, (slo_pred, plain_pred)
+    print(f"   loadgen: {report['completed']}/{report['offered']} done "
+          f"(goodput {report['goodput_per_s']}/s, attainment "
+          f"{report['slo_attainment']}, shed {report['shed_total']}), "
+          f"0 new compiles")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -258,7 +306,8 @@ def main() -> int:
                    "serving_kv_blocks_free", "STAT_serving_prefix_hits",
                    "serving_attn_impl", "serving_kv_dequant_max_abs_err",
                    "STAT_serving_kv_quant_writes", "serving_mesh_devices",
-                   "serving_replicas", "serving_queue_depth"):
+                   "serving_replicas", "serving_queue_depth",
+                   "serving_slo_attainment", "serving_shed_total"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 

@@ -289,8 +289,10 @@ def render_serving_block():
         "back — greedy output stays token-identical to K=0. `submit()`",
         "returns a request handle; `results()` collects them;",
         "`serving.ServingHTTPServer` is the JSON front end",
-        "(`POST /v1/generate`, `GET /v1/stats`, `GET /health`; 429 on",
-        "queue-full backpressure carries a `Retry-After` header).",
+        "(`POST /v1/generate` — with an optional integer `priority`",
+        "field — `GET /v1/stats`, `GET /health`; 429 on admission",
+        "backpressure carries a `Retry-After` header sized by the",
+        "engine's predicted-TTFT model and a `reason` in the body).",
         "Per-phase latency lands in `monitor.stats()` as",
         "`STAT_serving_prefill_ms` / `STAT_serving_decode_ms` /",
         "`STAT_serving_verify_ms`; acceptance as",
@@ -352,6 +354,48 @@ def render_serving_block():
         "`serving_mesh_devices`, `serving_replicas` and per-replica",
         "`serving_queue_depth` gauges, and the run log records",
         "`serving_route` / `serving_drain` events.",
+        "",
+        "Admission is SLO-aware. With `FLAGS_serving_slo_ttft_ms` > 0",
+        "(or `ServingEngine(slo_ttft_ms=...)`) every `submit()` first",
+        "predicts the request's time-to-first-token from live state —",
+        "queue depth in prefill waves, the per-bucket prefill cost, and",
+        "a decode time-per-output-token EWMA (pin both via",
+        "`slo_prefill_ms` / `slo_tpot_ms` for deterministic tests) —",
+        "and rejects requests that cannot meet the deadline instead of",
+        "queueing doomed work; the 429 carries a `Retry-After` sized by",
+        "that same prediction. Requests carry an integer priority class",
+        "(lower = more urgent, default 1, FIFO within a class); when",
+        "the queue is full, an urgent arrival preemptively sheds the",
+        "newest strictly-lower-priority queued request",
+        "(`FLAGS_serving_priority_preempt`), and queued requests whose",
+        "deadline has already expired are shed before ever reaching",
+        "prefill. Every loss is accounted: `engine.stats()` reports",
+        "per-reason shed counts (`queue_full | slo | deadline |",
+        "preempted | fault | drain`) plus `slo_attainment` — the",
+        "fraction of completed requests whose first token met the",
+        "deadline, i.e. the goodput numerator — exported as the",
+        "`serving_shed_total{reason=,priority=}` counter and",
+        "`serving_slo_attainment` gauge on `GET /metrics`. All of this",
+        "is host-side queue surgery: zero new XLA compiles, an",
+        "invariant `analysis.recompile.predict_serving_compiles`",
+        "encodes and CI asserts. On the router, `FLAGS_serving_autoscale",
+        "=MIN:MAX` (or an `AutoscalePolicy`) grows/shrinks the replica",
+        "set from mean queue depth with hysteresis + cooldown —",
+        "retiring replicas drain in the background, admissions route",
+        "around them — and `drain()` returns the count of requests shed",
+        "while giving up. `tools/loadgen.py` closes the loop: an",
+        "open-loop (arrivals don't wait on completions) load generator",
+        "with Poisson / bursty (Markov-modulated) / diurnal arrival",
+        "processes, mixed prompt/output-length and priority",
+        "distributions, and fully replayable seeds — same seed, byte-",
+        "identical arrival trace and identical admit/shed decisions. It",
+        "drives an engine or router directly (no HTTP in the loop) and",
+        "reports goodput (SLO-met completions/s), attainment, per-",
+        "reason sheds, TTFT/TPOT percentiles, and leaked KV blocks",
+        "(must be zero). CI runs a seeded clean + chaos-crossover gate;",
+        "`BENCH_MODEL=loadgen` measures SLO-aware vs depth-only goodput",
+        "at equal offered load and the graceful-degradation contract",
+        "under injected faults.",
         "",
         "Flags:",
         "",
