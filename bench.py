@@ -910,6 +910,107 @@ def child_main_loadgen(batch: int, seq: int, steps: int) -> int:
     return 0
 
 
+def child_main_zero(batch: int, seq: int, steps: int) -> int:
+    """BENCH_MODEL=zero: ZeRO optimizer-plane memory + step-time bench.
+
+    Runs the same gpt2-tiny train step twice over identical batches on
+    a (dp, 1) mesh spanning every visible device (main() carves out
+    BENCH_ZERO_DP=2 virtual CPU devices via XLA_FLAGS when the host
+    has only one): once replicated (stage 0 — plain to_static) and
+    once under BENCH_ZERO_STAGE (default 2: moments sharded + grads
+    reduce-scattered). Reports per-device parameter/optimizer bytes
+    from live ``addressable_shards`` (not estimates) and per-step wall
+    time for both, asserting loss parity and the ZeRO headline:
+    per-device optimizer bytes ~ 1/dp.
+    """
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import paddle_tpu as pt
+    from paddle_tpu import jit, observability
+    from paddle_tpu.distributed import zero
+    from paddle_tpu.models import GPT_CONFIGS, GPTForCausalLM
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    gpt = os.environ.get("BENCH_ZERO_GPT", "gpt2-tiny")
+    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
+    dp = jax.device_count()
+    cfg = GPT_CONFIGS[gpt]
+    mesh = Mesh(np.asarray(jax.devices()).reshape(dp, 1), ("dp", "mp"))
+
+    def build():
+        pt.seed(0)
+        model = GPTForCausalLM(cfg)
+        opt = AdamW(learning_rate=1e-3,
+                    parameters=model.parameters())
+
+        def train_step(ids, labels):
+            loss = model(ids, labels=labels)
+            model.clear_gradients()
+            loss.backward()
+            opt.step()
+            return loss
+        return model, opt, train_step
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (steps, batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=2).astype(np.int32)
+
+    def run(step_fn, report_fn):
+        # warmup pays the (grads-absent + grads-present) compiles
+        np.asarray(step_fn(ids[0], labels[0]).value)
+        np.asarray(step_fn(ids[0], labels[0]).value)
+        t0 = time.perf_counter()
+        losses = [float(np.asarray(step_fn(ids[i], labels[i]).value))
+                  for i in range(steps)]
+        dt = (time.perf_counter() - t0) / steps
+        return losses, dt * 1000, report_fn()
+
+    model0, opt0, fn0 = build()
+    rep_step = jit.to_static(fn0, layers=[model0], optimizers=[opt0])
+    rep_losses, rep_ms, rep_bytes = run(
+        rep_step, lambda: zero.byte_report([model0], [opt0],
+                                           publish=False))
+
+    model1, opt1, fn1 = build()
+    z_step = zero.zero_train_step(
+        fn1, layers=[model1], optimizers=[opt1], mesh=mesh,
+        stage=stage, arg_specs=(P("dp"), P("dp")))
+    z_losses, z_ms, z_bytes = run(z_step, z_step.byte_report)
+
+    parity = all(abs(a - b) <= 2e-3 * abs(a)
+                 for a, b in zip(rep_losses, z_losses))
+    assert parity, (rep_losses, z_losses)
+    ratio = z_bytes["opt_bytes_per_device"] / z_bytes["opt_bytes"]
+    assert ratio <= 1.0 / dp + 0.1, (
+        f"ZeRO-{stage} per-device opt ratio {ratio:.3f} on dp={dp}")
+
+    print(json.dumps({
+        "metric": f"zero{stage}_opt_bytes_per_device_ratio",
+        "value": round(ratio, 4),
+        "unit": "x total (replicated = 1.0)",
+        # the memory win vs the replicated baseline's per-device cost
+        "vs_baseline": round(rep_bytes["opt_bytes_per_device"] /
+                             z_bytes["opt_bytes_per_device"], 4),
+        "dp": dp, "stage": stage, "model": gpt,
+        "batch": batch, "seq": seq, "steps": steps,
+        "loss_parity": parity,
+        "opt_bytes_total": z_bytes["opt_bytes"],
+        "opt_bytes_per_device": z_bytes["opt_bytes_per_device"],
+        "param_bytes_per_device": z_bytes["param_bytes_per_device"],
+        "replicated_opt_bytes_per_device":
+            rep_bytes["opt_bytes_per_device"],
+        "step_time_ms": round(z_ms, 2),
+        "replicated_step_time_ms": round(rep_ms, 2),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "observability": {
+            "compiles": observability.snapshot()["compiles"]},
+    }))
+    return 0
+
+
 def child_main(model_name: str, batch: int, seq: int, steps: int) -> int:
     """Measure one (model, batch, seq, steps) config; print the JSON line.
 
@@ -1005,6 +1106,18 @@ def main() -> int:
         batch = int(os.environ.get("BENCH_BATCH", "4"))
         seq = int(os.environ.get("BENCH_SEQ", "64"))
         steps = int(os.environ.get("BENCH_STEPS", "2"))
+    if model_name == "zero":
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "64"))
+        steps = int(os.environ.get("BENCH_STEPS", "5"))
+        # the ZeRO bench needs a data axis: carve BENCH_ZERO_DP virtual
+        # CPU devices in the child (a no-op when real devices exist)
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
+                not os.environ.get("XLA_FLAGS", "").count("device_count"):
+            dp = int(os.environ.get("BENCH_ZERO_DP", "2"))
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={dp}").strip()
 
     here = os.path.abspath(__file__)
     last_err = ""
@@ -1054,6 +1167,10 @@ if __name__ == "__main__":
             sys.exit(child_main_loadgen(int(sys.argv[i + 2]),
                                         int(sys.argv[i + 3]),
                                         int(sys.argv[i + 4])))
+        if name == "zero":
+            sys.exit(child_main_zero(int(sys.argv[i + 2]),
+                                     int(sys.argv[i + 3]),
+                                     int(sys.argv[i + 4])))
         sys.exit(child_main(name, int(sys.argv[i + 2]),
                             int(sys.argv[i + 3]), int(sys.argv[i + 4])))
     sys.exit(main())

@@ -129,7 +129,8 @@ def predict_serving_compiles(
         n_replicas: int = 1,
         slo_ttft_ms: float = 0.0,
         priority_classes: Optional[Sequence[int]] = None,
-        autoscale: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
+        autoscale: Optional[Tuple[int, int]] = None,
+        weight_swaps: int = 0) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -186,6 +187,12 @@ def predict_serving_compiles(
     trace. The parameters exist so the predictor's signature mirrors
     the engine's and so the zero-new-compiles contract is itself
     regression-tested (predict with them == predict without).
+
+    ``weight_swaps`` (``ServingEngine.swap_weights`` calls interleaved
+    anywhere in the workload) joins that family: compiled steps take
+    the weights as explicit jit inputs with an unchanged abstract
+    shape/dtype/sharding signature, so N live hot-swaps trace nothing —
+    the train→serve loop's zero-new-compiles contract, statically.
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -224,6 +231,9 @@ def predict_serving_compiles(
             raise ValueError(
                 f"autoscale bounds must satisfy 1 <= min <= max, got "
                 f"{autoscale!r}")
+    if int(weight_swaps) < 0:
+        raise ValueError(
+            f"weight_swaps must be >= 0, got {weight_swaps}")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

@@ -2,6 +2,7 @@
 
 from . import env
 from . import fleet
+from . import zero
 from .collective import (ReduceOp, all_gather, all_reduce, barrier,
                          broadcast, reduce, reduce_scatter, scatter, split)
 from .parallel import ParallelEnv, get_rank, get_world_size, init_parallel_env

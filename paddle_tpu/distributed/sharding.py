@@ -318,6 +318,117 @@ def data_parallel_shardings(mesh: Mesh, n_args: int,
 
 
 # ---------------------------------------------------------------------------
+# ZeRO optimizer-state partitioning (distributed/zero.py front end)
+# ---------------------------------------------------------------------------
+
+
+def zero_partition_spec(shape: Sequence[int], mesh, axis: str = "dp",
+                        base: PartitionSpec = P(),
+                        name: Optional[str] = None) -> PartitionSpec:
+    """ZeRO layout for one optimizer accumulator (or stage-2 gradient):
+    keep the tensor's base (tensor-parallel) spec and additionally shard
+    the first dimension the data ``axis`` size divides that the base
+    spec leaves unsharded — ZeRO composed with TP, not instead of it.
+
+    No divisible free dim -> the base spec unchanged, with the same
+    replicated-fallback accounting ``_fit_spec`` uses: a
+    silently-unsharded moment is exactly how a ZeRO run quietly loses
+    its memory win.
+    """
+    mesh = _as_mesh(mesh)
+    size = mesh.shape[axis]
+    dims = list(base or ())
+    dims = dims + [None] * (len(shape) - len(dims))
+    if size > 1 and len(shape) > 0:
+        for i, d in enumerate(shape):
+            if dims[i] is None and d >= size and d % size == 0:
+                dims[i] = axis
+                return P(*dims)
+        _note_replicated_fallback(name, 0, axis, size,
+                                  shape[0] if len(shape) else 0)
+    return P(*dims) if any(d is not None for d in dims) else P()
+
+
+def zero_grad_specs(spec, mesh: Mesh, rules: ShardingRules, *,
+                    axis: str = "dp") -> List[PartitionSpec]:
+    """Stage-2 gradient PartitionSpec per ``spec.params`` entry: the
+    param's rule spec with the data axis added (``zero_partition_spec``)
+    — grads enter and leave the compiled step reduce-scattered onto the
+    same shards the optimizer moments live on."""
+    p_specs = param_partition_specs(spec, mesh, rules)
+    names = _param_names_by_id(spec.layers)
+    return [zero_partition_spec(tuple(p.value.shape), mesh, axis=axis,
+                                base=ps, name=names.get(id(p), p.name))
+            for p, ps in zip(spec.params, p_specs)]
+
+
+def opt_state_shardings(spec, mesh: Mesh, rules: ShardingRules, *,
+                        axis: str = "dp", stage: int = 1) -> List[Dict]:
+    """The ``"opt"`` entries of :func:`state_shardings` under ZeRO-
+    ``stage``: moment accumulators (shape == their param's) shard over
+    the data ``axis`` on top of their tensor-parallel spec, scalar
+    accumulators (beta_pow ``(1,)``) replicate. ``stage <= 0`` returns
+    the plain param-inherited layouts."""
+    if stage <= 0:
+        return state_shardings(spec, mesh, rules)["opt"]
+    p_specs = param_partition_specs(spec, mesh, rules)
+    names = _param_names_by_id(spec.layers)
+    zsh_by_id = {}
+    shape_by_id = {}
+    for p, ps in zip(spec.params, p_specs):
+        shape_by_id[id(p)] = tuple(p.value.shape)
+        zsh_by_id[id(p)] = NamedSharding(mesh, zero_partition_spec(
+            tuple(p.value.shape), mesh, axis=axis, base=ps,
+            name=names.get(id(p), p.name)))
+    repl = NamedSharding(mesh, P())
+
+    def opt_sh(state_dict):
+        out = {}
+        for key, v in state_dict.items():
+            pid = key[0] if isinstance(key, tuple) else None
+            if pid in zsh_by_id and tuple(v.shape) == shape_by_id[pid]:
+                out[key] = zsh_by_id[pid]
+            else:
+                out[key] = repl
+        return out
+
+    return [opt_sh(o._eager_state) for o in spec.optimizers]
+
+
+def estimate_zero_opt_bytes(named_params, mesh, rules: ShardingRules, *,
+                            axis: str = "dp", stage: int = 1,
+                            dtype_bytes: int = 4,
+                            accums_per_param: int = 2,
+                            scalar_accums: int = 2) -> Dict[str, int]:
+    """Static optimizer-state byte estimate under ZeRO — the
+    ``lint_sharding`` companion to ``distributed.zero.byte_report``,
+    needing only names+shapes (no devices). Defaults model the adam
+    family's eager state: two moment tensors per param plus two ``(1,)``
+    scalars. Returns ``{"opt_bytes", "opt_bytes_per_device"}``."""
+    mesh = _as_mesh(mesh)
+    total = per_device = 0
+    for name, shape in _normalize_named_params(named_params):
+        n = dtype_bytes
+        for d in shape:
+            n *= int(d)
+        base = rules.spec_for(name, shape, mesh)
+        zspec = base if stage <= 0 else zero_partition_spec(
+            shape, mesh, axis=axis, base=base, name=name)
+        shards = 1
+        for ax in zspec:
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                if a is not None:
+                    shards *= mesh.shape[a]
+        moment = accums_per_param * n
+        total += moment
+        per_device += moment // shards
+        scalars = scalar_accums * dtype_bytes
+        total += scalars
+        per_device += scalars
+    return {"opt_bytes": total, "opt_bytes_per_device": per_device}
+
+
+# ---------------------------------------------------------------------------
 # static rule linting (tools/lint_sharding.py front end)
 # ---------------------------------------------------------------------------
 

@@ -189,6 +189,15 @@ define_flag("ps_prefer_native", True,
             "make_server: try the C++ PS server first, falling back "
             "to the Python one when the toolchain is unavailable.")
 
+# Distributed training plane (paddle_tpu/distributed).
+define_flag("zero_stage", 0,
+            "distributed.zero.zero_train_step default ZeRO stage: "
+            "0 = optimizer state replicated (plain to_static "
+            "semantics), 1 = optimizer moments sharded over the data "
+            "axis, 2 = gradients reduce-scattered onto the same "
+            "shards as well (grads enter and leave the compiled step "
+            "data-sharded).")
+
 # Serving plane (paddle_tpu/serving): continuous-batching inference
 # engine geometry + admission control. Constructor arguments override;
 # the flags are the deployment-config surface.

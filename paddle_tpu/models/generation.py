@@ -24,6 +24,7 @@ to K+1 tokens while staying on a single compiled executable.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional
 
 import numpy as np
@@ -38,6 +39,58 @@ from ..dygraph.tensor import Tensor
 def _t(x, dtype=jnp.int32):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x, dtype),
                                                   stop_gradient=True)
+
+
+def param_leaves(model):
+    """Current parameter arrays of ``model`` in ``named_parameters()``
+    order — the explicit leading jit input of every compiled step.
+
+    Weights used to be closed over as trace-time constants; threading
+    them as inputs instead is what makes a live
+    ``ServingEngine.swap_weights`` visible to already-compiled
+    executables: same abstract shape/dtype/sharding signature, so the
+    step cache entry (and its compile count) is untouched.
+    """
+    return [p.value for _, p in model.named_parameters()]
+
+
+@contextmanager
+def _borrowed_params(model, values):
+    """Assign (traced) arrays into the eager Parameters for the duration
+    of a trace, restoring the concrete values after — the same
+    restore-on-exit contract ``jit.to_static`` keeps for its state spec,
+    so a mid-trace raise never leaves the model holding dead tracers."""
+    params = [p for _, p in model.named_parameters()]
+    saved = [p.value for p in params]
+    try:
+        for p, v in zip(params, values):
+            p.value = v
+        yield
+    finally:
+        for p, v in zip(params, saved):
+            p.value = v
+
+
+def _inject_params(model, raw):
+    """Wrap a compiled step so callers keep the param-free signature:
+    the wrapper prepends the model's *current* parameter arrays on every
+    call (post-swap weights ride in as data, not as constants)."""
+    def fn(*args):
+        return raw(param_leaves(model), *args)
+    fn.traces = raw.traces
+    return fn
+
+
+def _mesh_param_shardings(model, mesh):
+    """NamedSharding per ``named_parameters()`` entry under the serving
+    mesh — the same ``SERVING_TP_RULES`` fit ``_place_on_mesh`` used to
+    put the params there, so the jit in_shardings always agree with the
+    resident layout and a swap's ``device_put`` keeps them."""
+    from jax.sharding import NamedSharding
+    from ..distributed.sharding import SERVING_TP_RULES
+    return [NamedSharding(mesh, SERVING_TP_RULES.spec_for(
+                name, p.value.shape, mesh))
+            for name, p in model.named_parameters()]
 
 
 def step_entry(model, key, build):
@@ -100,14 +153,15 @@ def decode_step(model):
 
     Cached in the unified :func:`step_entry` cache, keyed by the
     flag-plane version so a ``set_flags`` retraces (same contract as
-    jit.to_static). Parameters are closed over as constants: decoding
-    assumes frozen weights.
+    jit.to_static). Parameters thread through as explicit jit inputs
+    (injected by the wrapper from the model's live values), so a
+    ``swap_weights`` takes effect without a retrace.
     """
     from ..observability import compile_tracker as _ct
 
     def _build():
-        def _step(tokens, pos, caches):
-            with no_grad():
+        def _step(params, tokens, pos, caches):
+            with no_grad(), _borrowed_params(model, params):
                 tcaches = [(Tensor(k, stop_gradient=True),
                             Tensor(v, stop_gradient=True))
                            for k, v in caches]
@@ -117,7 +171,7 @@ def decode_step(model):
             nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
             return nxt, lg, [(c[0].value, c[1].value) for c in newc]
 
-        fn = _ct.tracked_jit("decode_step", _step)
+        fn = _inject_params(model, _ct.tracked_jit("decode_step", _step))
         return {"fn": fn, "traces": fn.traces}
 
     return step_entry(model, ("decode",), _build)
@@ -149,8 +203,8 @@ def verify_step(model, spec_tokens: int):
         raise ValueError(f"verify_step needs spec_tokens >= 1, got {k}")
 
     def _build():
-        def _step(tokens, pos, caches):
-            with no_grad():
+        def _step(params, tokens, pos, caches):
+            with no_grad(), _borrowed_params(model, params):
                 tcaches = [(Tensor(kk, stop_gradient=True),
                             Tensor(vv, stop_gradient=True))
                            for kk, vv in caches]
@@ -161,7 +215,9 @@ def verify_step(model, spec_tokens: int):
             return nxt, lg, [(c[0].value, c[1].value) for c in newc]
 
         from ..observability import compile_tracker as _ct
-        fn = _ct.tracked_jit("verify_step", _step, labels={"k": str(k)})
+        fn = _inject_params(
+            model, _ct.tracked_jit("verify_step", _step,
+                                   labels={"k": str(k)}))
         return {"fn": fn, "traces": fn.traces}
 
     return step_entry(model, ("verify", k), _build)
@@ -220,8 +276,8 @@ def decode_step_paged(model, mesh=None, kv_dtype: str = "f32"):
     mkey = mesh_cache_key(mesh)
 
     def _build():
-        def _step(tokens, pos, tables, pools):
-            with no_grad():
+        def _step(params, tokens, pos, tables, pools):
+            with no_grad(), _borrowed_params(model, params):
                 logits, newp = model(_t(tokens[:, None]),
                                      cache=_wrap_pools(pools),
                                      cache_pos=pos, block_tables=tables)
@@ -234,9 +290,12 @@ def decode_step_paged(model, mesh=None, kv_dtype: str = "f32"):
         if mesh is not None:
             repl, pools_sh = _mesh_step_shardings(model, mesh, kv_dtype)
             jit_kwargs = dict(
-                in_shardings=(repl, repl, repl, pools_sh),
+                in_shardings=(_mesh_param_shardings(model, mesh),
+                              repl, repl, repl, pools_sh),
                 out_shardings=(repl, repl, pools_sh, repl))
-        fn = _ct.tracked_jit("decode_step_paged", _step, **jit_kwargs)
+        fn = _inject_params(
+            model, _ct.tracked_jit("decode_step_paged", _step,
+                                   **jit_kwargs))
         return {"fn": fn, "traces": fn.traces}
 
     key = (("decode_paged",) if mkey is None
@@ -266,8 +325,8 @@ def verify_step_paged(model, spec_tokens: int, mesh=None,
     mkey = mesh_cache_key(mesh)
 
     def _build():
-        def _step(tokens, pos, tables, pools):
-            with no_grad():
+        def _step(params, tokens, pos, tables, pools):
+            with no_grad(), _borrowed_params(model, params):
                 logits, newp = model(_t(tokens), cache=_wrap_pools(pools),
                                      cache_pos=pos, block_tables=tables)
             lg = logits.value                            # [b, K+1, V]
@@ -280,10 +339,12 @@ def verify_step_paged(model, spec_tokens: int, mesh=None,
         if mesh is not None:
             repl, pools_sh = _mesh_step_shardings(model, mesh, kv_dtype)
             jit_kwargs = dict(
-                in_shardings=(repl, repl, repl, pools_sh),
+                in_shardings=(_mesh_param_shardings(model, mesh),
+                              repl, repl, repl, pools_sh),
                 out_shardings=(repl, repl, pools_sh, repl))
-        fn = _ct.tracked_jit("verify_step_paged", _step,
-                             labels={"k": str(k)}, **jit_kwargs)
+        fn = _inject_params(
+            model, _ct.tracked_jit("verify_step_paged", _step,
+                                   labels={"k": str(k)}, **jit_kwargs))
         return {"fn": fn, "traces": fn.traces}
 
     key = (("verify_paged", k) if mkey is None

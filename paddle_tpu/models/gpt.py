@@ -45,17 +45,28 @@ class GPTConfig:
     # rematerialize each block's activations in backward (batch-size
     # lever; fleet.utils.recompute over every decoder block)
     recompute: bool = False
+    # pad the vocab embedding rows up to a multiple of this, so a
+    # vocab-parallel sharding axis always divides the table (the
+    # standard 50257 -> 50304 trick as a knob). Logits are sliced back
+    # to vocab_size, pad rows never receive lookups or gradients.
+    vocab_pad_to: int = 1
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def padded_vocab_size(self) -> int:
+        pad = max(1, int(self.vocab_pad_to))
+        return -(-self.vocab_size // pad) * pad
 
     def num_params(self, include_embeddings: bool = True) -> int:
         h, f, L = self.hidden_size, self.ffn_hidden_size, self.num_layers
         per_layer = (4 * h * h + 4 * h) + (2 * h * f + h + f) + 4 * h
         n = L * per_layer + 2 * h  # final LN
         if include_embeddings:
-            n += (self.vocab_size + self.max_position_embeddings) * h
+            n += (self.padded_vocab_size
+                  + self.max_position_embeddings) * h
         return n
 
 
@@ -258,7 +269,8 @@ class GPTModel(Layer):
         super().__init__()
         self.cfg = cfg
         w = ParamAttr(initializer=NormalInitializer(0.0, cfg.init_std))
-        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=w)
+        self.wte = Embedding(cfg.padded_vocab_size, cfg.hidden_size,
+                             weight_attr=w)
         self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size,
                              weight_attr=w)
         self.drop = Dropout(cfg.dropout)
@@ -385,6 +397,11 @@ class GPTForCausalLM(Layer):
         logits = run_op("matmul_v2",
                         {"X": [h], "Y": [self.gpt.wte.weight]},
                         {"trans_y": True})["Out"][0]
+        if self.cfg.padded_vocab_size != self.cfg.vocab_size:
+            # pad rows exist only for sharding divisibility: slice the
+            # tied head back so argmax/softmax never see them (the
+            # slice op is differentiable — pad rows get zero grad)
+            logits = logits[:, :, :self.cfg.vocab_size]
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.cfg.vocab_size]),

@@ -31,7 +31,7 @@ INSTRUMENT_DOCS = {
         "counter — XLA compiles per tracked_jit site (executor_step, "
         "parallel_executor_step, decode_step[_paged], "
         "verify_step[_paged], serving_prefill[_paged]{bucket=...}, "
-        "to_static, to_static_multi_step)",
+        "to_static, to_static_multi_step, zero_train_step{stage=...})",
     "xla_compile_ms":
         "histogram — wall ms of calls that triggered an XLA compile",
     "serving_ttft_seconds{engine=...}":
@@ -66,6 +66,15 @@ INSTRUMENT_DOCS = {
         "counter — requests shed, by reason (queue_full | slo | "
         "deadline | preempted | fault | drain) and priority class; "
         "submit-time rejections included",
+    "serving_weight_version{engine=...}":
+        "gauge — live weight hot-swaps applied to an engine's model "
+        "(0 = the weights it was built with; bumps once per "
+        "swap_weights call, per replica in a rolling router swap)",
+    "zero_param_bytes_per_device{stage=...} / "
+    "zero_opt_bytes_per_device{stage=...}":
+        "gauges — max over devices of resident parameter / "
+        "optimizer-state bytes for the last zero_train_step state "
+        "(the ZeRO memory win: opt bytes ~ 1/dp at stage >= 1)",
     "STAT_serving_kv_quant_writes / _rows":
         "counters — int8-quantizing step dispatches and KV rows "
         "quantized through them",
@@ -109,6 +118,10 @@ EVENT_DOCS = {
                           "requests given up on while draining)",
     "serving_autoscale": "AutoscalePolicy changed the replica count "
                          "(replicas_from, replicas_to, retiring)",
+    "serving_weight_swap": "live weight hot-swap applied to a running "
+                           "engine (engine, version, params, "
+                           "reset_costs) — the train→serve publish "
+                           "step; zero new compiles by construction",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

@@ -475,6 +475,12 @@ class ServingEngine:
             "mesh size; 1 for a single-device engine)"
             ).labels(engine=eid).set(
                 1 if self.mesh is None else self.mesh.devices.size)
+        self._weight_version = 0
+        self._weight_version_g = _obs.gauge(
+            "serving_weight_version",
+            "live weight hot-swaps applied to this engine's model "
+            "(0 = the weights it was built with)").labels(engine=eid)
+        self._weight_version_g.set(0)
         self._qerr_max = 0.0
         self._qerr_gauge = None
         if self.kv_dtype == "int8":
@@ -506,6 +512,79 @@ class ServingEngine:
         self.cache.set_arrays([
             tuple(jax.device_put(a, sh) for a, sh in zip(layer, shs))
             for layer, shs in zip(pools, kv_pool_shardings(mesh, pools))])
+
+    # ------------------------------------------------- weight hot-swap
+    def swap_weights(self, state, *, reset_costs: bool = True) -> int:
+        """Swap the live model weights in place — the serve half of the
+        train→serve loop: a training job publishes a checkpoint into
+        this *running* engine between iterations, no drain, no restart.
+
+        ``state`` maps dotted ``named_parameters()`` names to arrays
+        (numpy/jnp/Tensor — e.g. ``zero.weights_from_checkpoint``'s
+        output); every live parameter must be present with its exact
+        shape. Because compiled steps take the weights as explicit jit
+        inputs (``models/generation.param_leaves``), the new values ride
+        into the *existing* executables as data: the unified step cache
+        is untouched and the compile tracker observes **zero new
+        compiles**. The assignment happens under the step lock, so
+        in-flight requests see a clean cut between steps: tokens decoded
+        before the swap came from the old weights, tokens after from the
+        new — KV entries written by the old weights are intentionally
+        kept (the continual-pretraining contract; restart the request
+        for a pure-new-weights answer).
+
+        Under a mesh the new arrays are placed per ``SERVING_TP_RULES``
+        first, preserving the resident layout. ``reset_costs`` drops the
+        learned prefill/TPOT EWMAs afterwards (pins stay): the new
+        weights' dispatch costs re-learn from fresh observations while
+        admission predictions stay monotone (they fall back to the
+        global/pinned costs, never to garbage). Returns the new weight
+        version (also on the ``serving_weight_version`` gauge).
+        """
+        named = list(self.model.named_parameters())
+        known = {name for name, _ in named}
+        unknown = sorted(set(state) - known)
+        missing = sorted(known - set(state))
+        if unknown or missing:
+            raise ValueError(
+                f"swap_weights state does not match the live model: "
+                f"missing {missing[:3]}{'...' if len(missing) > 3 else ''}, "
+                f"unknown {unknown[:3]}{'...' if len(unknown) > 3 else ''}")
+        staged = []
+        for name, p in named:
+            v = state[name]
+            v = getattr(v, "value", v)
+            v = jnp.asarray(v, p.value.dtype)
+            if tuple(v.shape) != tuple(p.value.shape):
+                raise ValueError(
+                    f"swap_weights: {name!r} has shape "
+                    f"{tuple(v.shape)}, live model expects "
+                    f"{tuple(p.value.shape)} — a different architecture "
+                    "needs a new engine, not a swap")
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+                spec = SERVING_TP_RULES.spec_for(name, p.value.shape,
+                                                 self.mesh)
+                v = jax.device_put(v, NamedSharding(self.mesh, spec))
+            staged.append((p, v))
+        with self._step_lock:
+            for p, v in staged:
+                p.value = v
+            self._weight_version += 1
+            version = self._weight_version
+        self._weight_version_g.set(version)
+        _runlog.log_event("serving_weight_swap", engine=self._eid,
+                          version=version, params=len(staged),
+                          reset_costs=bool(reset_costs))
+        _monitor.stat_add("STAT_serving_weight_swaps")
+        if reset_costs:
+            self.reset_cost_estimates()
+        return version
+
+    @property
+    def weight_version(self) -> int:
+        """Hot-swaps applied so far (0 = construction weights)."""
+        return self._weight_version
 
     # --------------------------------------------------- TTFT prediction
     _EWMA_ALPHA = 0.3
@@ -735,8 +814,11 @@ class ServingEngine:
         model, max_len, slots = self.model, self.max_len, self.max_slots
 
         def _build():
-            def _prefill(ids, last):
-                with no_grad():
+            from ..models.generation import (_borrowed_params,
+                                             _inject_params)
+
+            def _prefill(params, ids, last):
+                with no_grad(), _borrowed_params(model, params):
                     cache = model.gpt.gen_fixed_cache(slots, max_len)
                     logits, newc = model(
                         Tensor(ids, stop_gradient=True), cache=cache,
@@ -746,8 +828,9 @@ class ServingEngine:
                                          axis=1)[:, 0]
                 return lg, [(c[0].value, c[1].value) for c in newc]
 
-            fn = _ct.tracked_jit("serving_prefill", _prefill,
-                                 labels={"bucket": str(bucket)})
+            fn = _inject_params(
+                model, _ct.tracked_jit("serving_prefill", _prefill,
+                                       labels={"bucket": str(bucket)}))
             return {"fn": fn, "traces": fn.traces}
 
         ent = step_entry(model, ("prefill", bucket, slots, max_len),
@@ -802,10 +885,13 @@ class ServingEngine:
         model, mesh, kv_dtype = self.model, self.mesh, self.kv_dtype
 
         def _build():
-            def _prefill(ids, last, pos, tables, pools):
+            from ..models.generation import (_borrowed_params,
+                                             _inject_params)
+
+            def _prefill(params, ids, last, pos, tables, pools):
                 from ..models.generation import (_unwrap_pools,
                                                  _wrap_pools)
-                with no_grad():
+                with no_grad(), _borrowed_params(model, params):
                     logits, newp = model(
                         Tensor(ids, stop_gradient=True),
                         cache=_wrap_pools(pools),
@@ -818,15 +904,18 @@ class ServingEngine:
 
             jit_kwargs = {}
             if mesh is not None:
-                from ..models.generation import _mesh_step_shardings
+                from ..models.generation import (_mesh_param_shardings,
+                                                 _mesh_step_shardings)
                 repl, pools_sh = _mesh_step_shardings(model, mesh,
                                                       kv_dtype)
                 jit_kwargs = dict(
-                    in_shardings=(repl, repl, repl, repl, pools_sh),
+                    in_shardings=(_mesh_param_shardings(model, mesh),
+                                  repl, repl, repl, repl, pools_sh),
                     out_shardings=(repl, pools_sh, repl))
-            fn = _ct.tracked_jit("serving_prefill_paged", _prefill,
-                                 labels={"bucket": str(bucket)},
-                                 **jit_kwargs)
+            fn = _inject_params(
+                model, _ct.tracked_jit("serving_prefill_paged", _prefill,
+                                       labels={"bucket": str(bucket)},
+                                       **jit_kwargs))
             return {"fn": fn, "traces": fn.traces}
 
         ent = step_entry(model, key, _build)

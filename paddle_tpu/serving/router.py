@@ -389,6 +389,21 @@ class ReplicaRouter:
         _runlog.log_event("serving_drain_done", shed=shed)
         return shed
 
+    def swap_weights(self, state, *, reset_costs: bool = True
+                     ) -> List[int]:
+        """Rolling weight hot-swap across the fleet: every replica —
+        retiring ones included, they still finish requests — swaps in
+        turn via ``ServingEngine.swap_weights``, each under its own step
+        lock, with the others serving throughout. No ``drain()``, no
+        admission pause: the fleet is briefly mixed-version (normal for
+        a rolling deploy; per-replica ``serving_weight_version`` gauges
+        show the wavefront) and converges within one pass. Returns the
+        per-replica versions after the swap."""
+        with self._lock:
+            engines = list(self.engines) + list(self._retiring)
+        return [eng.swap_weights(state, reset_costs=reset_costs)
+                for eng in engines]
+
     def results(self, reqs=None, timeout: Optional[float] = None
                 ) -> List[Request]:
         """Wait for requests across all replicas, submission order."""

@@ -161,6 +161,10 @@ def test_ps_tier_wide_deep_program_trains():
         table_name="wd_emb", sparse_lr=5.0, dense_lr=0.05)
     assert "distributed_lookup_table_grad" in [
         op.type for op in main.global_block().ops]
+    # unseeded programs draw OS-entropy init (executor contract) and the
+    # 0.75x loss bar is borderline under unlucky draws — pin the seed
+    main.random_seed = 7
+    startup.random_seed = 7
     scope, exe = Scope(), Executor()
     exe.run(startup, scope=scope)
     rng = np.random.RandomState(3)

@@ -34,9 +34,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 # the tiny-but-structurally-faithful GPT used across CI gates
 # (tools/obs_smoke.py, the serving tests): every TP rule family
-# (qkv/out_proj/fc1/fc2/wte) has a live target
+# (qkv/out_proj/fc1/fc2/wte) has a live target. vocab_pad_to=2 pads the
+# deliberately-awkward 97-row vocab to 98 so the vocab-parallel wte
+# rule divides cleanly — `--preset gpt_tp --strict` runs warning-free
+# (the old vocab-97 replicated fallback was the one expected finding).
 GPT_CFG = dict(vocab_size=97, max_position_embeddings=64, hidden_size=32,
-               num_layers=2, num_heads=4, ffn_hidden_size=64)
+               num_layers=2, num_heads=4, ffn_hidden_size=64,
+               vocab_pad_to=2)
 
 
 def build_model():
@@ -100,11 +104,19 @@ def main(argv=None):
                          "size [64]")
     ap.add_argument("--strict", action="store_true",
                     help="treat warnings as fatal too")
+    ap.add_argument("--zero-stage", type=int, default=-1,
+                    help="also estimate per-device optimizer-state "
+                         "bytes under this ZeRO stage (0|1|2; -1 = "
+                         "skip) [-1]")
+    ap.add_argument("--zero-axis", default="dp",
+                    help="mesh axis ZeRO shards optimizer state over "
+                         "[dp]")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON report on stdout instead of text")
     args = ap.parse_args(argv)
 
-    from paddle_tpu.distributed.sharding import lint_sharding_rules
+    from paddle_tpu.distributed.sharding import (estimate_zero_opt_bytes,
+                                                 lint_sharding_rules)
 
     mesh = parse_mesh(args.mesh)
     rules = resolve_rules(args.preset)
@@ -112,6 +124,15 @@ def main(argv=None):
     result = lint_sharding_rules(
         rules, model, mesh, dtype_bytes=args.dtype_bytes,
         replicated_warn_mb=args.replicated_warn_mb)
+    zero = None
+    if args.zero_stage >= 0:
+        if args.zero_axis not in mesh:
+            raise SystemExit(
+                f"--zero-axis {args.zero_axis!r} not in --mesh "
+                f"{sorted(mesh)}")
+        zero = estimate_zero_opt_bytes(
+            model, mesh, rules, axis=args.zero_axis,
+            stage=args.zero_stage, dtype_bytes=args.dtype_bytes)
     failed = bool(result.errors) or (args.strict
                                      and bool(result.warnings))
 
@@ -132,6 +153,9 @@ def main(argv=None):
             "total_bytes": result.total_bytes,
             "per_device_bytes": result.per_device_bytes,
             "replicated_bytes": result.replicated_bytes,
+            **({"zero": {"stage": args.zero_stage,
+                         "axis": args.zero_axis, **zero}}
+               if zero is not None else {}),
         }, indent=2))
         return 1 if failed else 0
 
@@ -150,6 +174,10 @@ def main(argv=None):
           f"per-device={result.per_device_bytes} "
           f"({result.per_device_bytes / mib:.2f} MiB), "
           f"replicated={result.replicated_bytes}")
+    if zero is not None:
+        print(f"  ZeRO-{args.zero_stage} optimizer bytes (axis "
+              f"{args.zero_axis!r}): total={zero['opt_bytes']}, "
+              f"per-device={zero['opt_bytes_per_device']}")
     print(f"{'FAIL' if failed else 'ok'}: {len(result.errors)} error(s), "
           f"{len(result.warnings)} warning(s)")
     return 1 if failed else 0

@@ -253,7 +253,8 @@ class LoadGen:
             step_cost_ms: float = 0.0,
             slo_ttft_ms: Optional[float] = None,
             include_trace: bool = False,
-            max_steps: int = 200_000) -> dict:
+            max_steps: int = 200_000,
+            on_step=None) -> dict:
         """Release the schedule open-loop into ``target`` and drive it
         to drain; returns the report dict.
 
@@ -263,7 +264,10 @@ class LoadGen:
         arrivals ride the wall clock. ``slo_ttft_ms`` sets a post-hoc
         SLO for goodput when the engines run without one (the
         depth-only baseline); engines with their own SLO use their
-        deadline verdicts."""
+        deadline verdicts. ``on_step`` (called with the 0-based step
+        index after each scheduler step) is the deterministic
+        mid-burst hook — hot-swap-under-load tests fire
+        ``swap_weights`` from it at an exact step."""
         arrivals = self.schedule()
         records = [{"i": i, "t": a.t, "prompt_tokens": len(a.prompt),
                     "max_new_tokens": a.max_new_tokens,
@@ -311,6 +315,8 @@ class LoadGen:
                     time.sleep(min(max(gap, 0.0), 0.05))
                 continue
             target.step()
+            if on_step is not None:
+                on_step(steps)
             if clock is not None:
                 clock.advance(step_cost_ms / 1e3)
             steps += 1

@@ -25,6 +25,10 @@ Asserts, end to end through the observability plane:
     completes with goodput > 0, zero leaked KV blocks and ZERO new
     compiles — and the recompile predictor agrees the admission
     parameters are no-ops;
+  - a live weight hot-swap (``swap_weights``) into the still-warm
+    loadgen engine adds zero compiles, decodes the new weights'
+    greedy tokens, and matches the predictor's ``weight_swaps``
+    no-op claim;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
     int8-quantization and SLO-admission metrics;
@@ -288,6 +292,40 @@ def main() -> int:
           f"{report['slo_attainment']}, shed {report['shed_total']}), "
           f"0 new compiles")
 
+    # -- hot-swap phase: live weight swap adds ZERO compiles ----------
+    # Publish fresh weights into the still-warm loadgen engine: the
+    # compiled steps take weights as explicit jit inputs, so the
+    # tracker must not move, post-swap traffic must decode the NEW
+    # model's greedy tokens, and the predictor must agree that
+    # weight_swaps is a no-op.
+    from paddle_tpu.models.generation import greedy_search
+    pt.seed(23)
+    swap_model = GPTForCausalLM(cfg)
+    swap_model.eval()
+    version = eng5.swap_weights(
+        {n: p.value for n, p in swap_model.named_parameters()})
+    assert version == 1 and eng5.weight_version == 1
+    p_swap = rng.randint(1, 97, size=5).tolist()
+    r_swap = eng5.submit(p_swap, max_new_tokens=4)
+    eng5.run_until_idle()
+    comp6 = observability.compiles()
+    observed6 = {site: c["count"] for site, c in comp6.items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+    assert observed6 == observed5, (
+        f"live weight swap must add ZERO compiles:\n"
+        f"  before {observed5}\n  after  {observed6}")
+    ref_swap = greedy_search(swap_model, np.asarray([p_swap]),
+                             max_new_tokens=4,
+                             cache_len=32)[0].tolist()
+    assert r_swap.output_ids == ref_swap, (
+        "post-swap tokens != new-weight greedy")
+    swap_pred = predict_serving_compiles(
+        lg_workload, buckets=[8, 16], max_len=32, block_size=4,
+        weight_swaps=1)
+    assert swap_pred == plain_pred, (swap_pred, plain_pred)
+    print(f"   hot swap: v{version} live, tokens match the new "
+          f"weights, 0 new compiles (predicted == observed)")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -307,7 +345,8 @@ def main() -> int:
                    "serving_attn_impl", "serving_kv_dequant_max_abs_err",
                    "STAT_serving_kv_quant_writes", "serving_mesh_devices",
                    "serving_replicas", "serving_queue_depth",
-                   "serving_slo_attainment", "serving_shed_total"):
+                   "serving_slo_attainment", "serving_shed_total",
+                   "serving_weight_version"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 
@@ -319,7 +358,7 @@ def main() -> int:
         for line in f:
             kinds.add(json.loads(line)["kind"])
     for k in ("train_step", "guardian_skip", "fault_injected",
-              "serving_admit", "serving_finish"):
+              "serving_admit", "serving_finish", "serving_weight_swap"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])

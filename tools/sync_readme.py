@@ -18,6 +18,9 @@ Three fragments are generated, everything else stays hand-written:
   - the "Serving" section between the
     `<!-- BEGIN GENERATED: serving -->` markers (from the registered
     `FLAGS_serving_*` flags + the serving fault sites)
+  - the "Train→serve loop" section between the
+    `<!-- BEGIN GENERATED: train-serve -->` markers (from the
+    registered `FLAGS_zero_*` flags)
   - the "Observability" section between the
     `<!-- BEGIN GENERATED: observability -->` markers (from
     observability.INSTRUMENT_DOCS / EVENT_DOCS + the registered flags)
@@ -449,6 +452,112 @@ def sync_serving_block(text, check):
     return text[:b] + "\n" + want + "\n" + text[e:], None
 
 
+_TRAINSERVE_BEGIN = "<!-- BEGIN GENERATED: train-serve -->"
+_TRAINSERVE_END = "<!-- END GENERATED: train-serve -->"
+_TRAINSERVE_FLAGS = ("zero_stage",)
+
+
+def render_trainserve_block():
+    """ZeRO optimizer plane + live weight hot-swap, with the
+    `zero_*` flag rows pulled from the live flag registry."""
+    import textwrap
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu import flags
+
+    def bullet(head, body):
+        return "\n".join(textwrap.wrap(
+            f"- {head} — {body}", width=76, subsequent_indent="  "))
+
+    lines = [
+        "Training and serving close into one loop: train with the",
+        "optimizer state ZeRO-sharded across the data axis, publish the",
+        "weights through a checkpoint, and hot-swap them into a",
+        "*running* `ServingEngine` without draining requests or paying",
+        "a single new XLA compile.",
+        "",
+        "`paddle_tpu.distributed.zero.zero_train_step(fn, layers=...,",
+        "optimizers=..., mesh=..., stage=...)` is a drop-in for",
+        "`jit.to_static` that implements ZeRO-1/2 purely with",
+        "pjit/`NamedSharding` — no `shard_map`, no hand-written",
+        "collectives. `sharding.opt_state_shardings(...)` assigns each",
+        "Adam moment a `PartitionSpec` with the data axis added to its",
+        "first divisible free dimension (`zero_partition_spec`), so",
+        "GSPMD materializes each device's 1/dp optimizer shard and",
+        "inserts the gather; stage 2 additionally annotates gradients",
+        "with the same specs, turning the grad all-reduce into a",
+        "reduce-scatter. Undivisible tensors fall back to their base",
+        "spec (replicated moments), scalars (`_lr`, Adam step counts)",
+        "stay replicated, and tensor-parallel param rules compose:",
+        "moments shard on BOTH the TP axis and the data axis. The",
+        "wrapper publishes live per-device byte accounting",
+        "(`zero_opt_bytes` / `zero_opt_bytes_per_device` gauges,",
+        "measured from `addressable_shards`, plus",
+        "`zero.byte_report(...)`), and",
+        "`tools/lint_sharding.py --zero-stage N` folds the same",
+        "estimate into the lint report before any training run.",
+        "",
+        "The serve half: `zero.save_train_state(saver, layers,",
+        "optimizers, step)` gathers the sharded optimizer state and",
+        "writes one `CheckpointSaver` checkpoint (params under",
+        "`param/<name>`, moments under `opt<i>/<key>`, the ZeRO stage",
+        "in metadata); `zero.weights_from_checkpoint(state)` strips it",
+        "back to a `{name: array}` mapping; and",
+        "`ServingEngine.swap_weights(weights, reset_costs=True)`",
+        "installs the new weights between engine steps under the step",
+        "lock — names/shapes validated, arrays re-placed onto the",
+        "engine's mesh per the `serving_tp` rules, the admission",
+        "controller's learned cost model optionally reset. Because",
+        "every compiled prefill/decode/verify step takes the params as",
+        "a donated *input* (not a closure constant), the unified step",
+        "cache is untouched: a swap costs ZERO new compiles —",
+        "`analysis.predict_serving_compiles(..., weight_swaps=N)` is a",
+        "validated no-op — and the next step serves the new weights.",
+        "`ReplicaRouter.swap_weights(...)` rolls the swap across",
+        "replicas one engine at a time (drain-free; stragglers keep",
+        "serving the old version until their turn). Each swap bumps the",
+        "`serving_weight_version` gauge and logs a",
+        "`serving_weight_swap` run-log event.",
+        "",
+        "`tools/zero_smoke.py` (CI gate) trains 2 ZeRO steps at dp=2,",
+        "asserts per-device optimizer bytes ~1/2 of total with",
+        "loss-for-loss parity against the unsharded baseline, then",
+        "publishes and hot-swaps into a live engine asserting",
+        "token-correct output and 0 compiles. `BENCH_MODEL=zero`",
+        "benchmarks the per-device byte ratio and step time against",
+        "replicated Adam.",
+        "",
+        "Flags:",
+        "",
+    ]
+    defs = flags.list_flags()
+    for name in _TRAINSERVE_FLAGS:
+        d = defs[name]
+        lines.append(bullet(
+            f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
+    return "\n".join(lines)
+
+
+def sync_trainserve_block(text, check):
+    """Returns (new_text, drift_message_or_None)."""
+    try:
+        b = text.index(_TRAINSERVE_BEGIN) + len(_TRAINSERVE_BEGIN)
+        e = text.index(_TRAINSERVE_END)
+    except ValueError:
+        raise SystemExit("README train-serve markers not found")
+    current = text[b:e].strip("\n")
+    want = render_trainserve_block()
+    if current == want:
+        print("README train-serve block in sync")
+        return text, None
+    if check:
+        return text, ("README train-serve block DRIFTS from the "
+                      "zero/flag registries — rerun "
+                      "tools/sync_readme.py")
+    print("README train-serve block regenerated")
+    return text[:b] + "\n" + want + "\n" + text[e:], None
+
+
 _OBS_BEGIN = "<!-- BEGIN GENERATED: observability -->"
 _OBS_END = "<!-- END GENERATED: observability -->"
 _OBS_FLAGS = ("warn_recompiles", "runlog_dir", "runlog_max_mb")
@@ -553,7 +662,8 @@ def main():
     orig = text
     drifts = []
     for sync in (sync_headline, sync_checks_block, sync_fault_block,
-                 sync_serving_block, sync_observability_block):
+                 sync_serving_block, sync_trainserve_block,
+                 sync_observability_block):
         text, drift = sync(text, args.check)
         if drift:
             drifts.append(drift)
