@@ -761,7 +761,15 @@ def child_main_loadgen(batch: int, seq: int, steps: int) -> int:
     - phase C (chaos crossover): the same SLO engine under
       FLAGS_fault_spec submit/alloc faults — goodput degrades but
       stays > 0, zero leaked KV blocks, zero unhandled exceptions,
-      every lost request accounted as a shed.
+      every lost request accounted as a shed;
+    - phase D (disagg vs symmetric): the same trace through a
+      3-replica symmetric ReplicaRouter and through a 1 prefill x
+      2 decode DisaggRouter — equal worker count, identical
+      geometry. Everywhere: zero leaks, zero exceptions, and ZERO
+      new compiles (both topologies share the model's step cache).
+      On real TPU hardware the role split must also win TTFT p95
+      (prefill batches no longer stall running decodes); on CPU
+      the timings are reported without a win gate.
 
     ``vs_baseline`` is goodput_B / goodput_A.
     """
@@ -869,6 +877,32 @@ def child_main_loadgen(batch: int, seq: int, steps: int) -> int:
                          sum(1 for d in rep_c["decisions"]
                              if d[0] == "invalid"))
             assert accounted == rep_c["offered"], rep_c
+
+        # -- phase D: disaggregated P/D fleet vs symmetric router -----
+        from paddle_tpu.serving import DisaggRouter, ReplicaRouter
+        sym = ReplicaRouter(model, n_replicas=3, **eng_kw)
+        warmup(sym)
+        rep_sym = LoadGen(**lg_kw).run(sym, slo_ttft_ms=slo_ms)
+        compiles_sym = serving_compiles()
+        fleet = DisaggRouter(model, n_prefill=1, n_decode=2, **eng_kw)
+        warmup(fleet)
+        rep_d = LoadGen(**lg_kw).run(fleet, slo_ttft_ms=slo_ms)
+        compiles_d = serving_compiles()
+        assert compiles_d == compiles_sym, (
+            f"disaggregated roles must add ZERO compiles:\n"
+            f"  symmetric {compiles_sym}\n  disagg    {compiles_d}")
+        fleet_st = fleet.stats()
+        if gate:
+            for rep in (rep_sym, rep_d):
+                assert rep["exceptions"] == 0, rep
+                assert rep["leaked_kv_blocks"] == 0, rep
+                assert rep["completed"] > 0, rep
+            assert fleet_st["handoffs_adopted"] >= 1, fleet_st
+            if dev.platform == "tpu":
+                assert (rep_d["ttft_ms_p95"] or 0) <= \
+                       (rep_sym["ttft_ms_p95"] or 0), (
+                    f"disagg TTFT p95 {rep_d['ttft_ms_p95']}ms worse "
+                    f"than symmetric {rep_sym['ttft_ms_p95']}ms")
     except Exception as e:
         msg = str(e)
         if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
@@ -902,6 +936,16 @@ def child_main_loadgen(batch: int, seq: int, steps: int) -> int:
                       goodput_ratio_vs_clean=(
                           round(goodput_c / goodput_b, 2)
                           if goodput_b else None)),
+        "symmetric_router": dict(phase(rep_sym), workers=3),
+        "disagg": dict(
+            phase(rep_d), workers=3, topology="1x2",
+            handoffs_adopted=fleet_st["handoffs_adopted"],
+            affinity_hits=fleet_st["affinity_hits"],
+            fleet_prefix_hit_rate=fleet_st["fleet_prefix_hit_rate"],
+            ttft_p95_ratio_vs_symmetric=(
+                round(rep_d["ttft_ms_p95"] / rep_sym["ttft_ms_p95"], 3)
+                if rep_d["ttft_ms_p95"] and rep_sym["ttft_ms_p95"]
+                else None)),
         "serving_compiles": compiles_b,
         "device": getattr(dev, "device_kind", str(dev)),
     }

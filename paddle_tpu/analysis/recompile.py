@@ -130,7 +130,8 @@ def predict_serving_compiles(
         slo_ttft_ms: float = 0.0,
         priority_classes: Optional[Sequence[int]] = None,
         autoscale: Optional[Tuple[int, int]] = None,
-        weight_swaps: int = 0) -> Dict[str, int]:
+        weight_swaps: int = 0,
+        disagg: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -193,6 +194,18 @@ def predict_serving_compiles(
     the weights as explicit jit inputs with an unchanged abstract
     shape/dtype/sharding signature, so N live hot-swaps trace nothing —
     the train→serve loop's zero-new-compiles contract, statically.
+
+    ``disagg`` (``FLAGS_serving_disagg``: a ``(n_prefill, n_decode)``
+    disaggregated fleet behind a ``DisaggRouter``) is the newest
+    member of the validated-no-op family: prefill-only and decode-only
+    engine roles call the *same* compiled steps at the same geometry —
+    the unified step cache keys on geometry, never on role — the KV
+    handoff is host-side block-table surgery, and prefix-affinity
+    routing only changes *which* pool a prompt lands in (if anything
+    it makes this predictor's single-prefix-cache model MORE accurate,
+    since affinity concentrates shared prefixes the way one shared
+    cache would). Splitting P+D workers therefore adds zero compiles
+    over a symmetric fleet.
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -234,6 +247,16 @@ def predict_serving_compiles(
     if int(weight_swaps) < 0:
         raise ValueError(
             f"weight_swaps must be >= 0, got {weight_swaps}")
+    if disagg is not None:
+        p, d = (int(n) for n in disagg)
+        if p < 1 or d < 1:
+            raise ValueError(
+                f"disagg must be (n_prefill >= 1, n_decode >= 1), got "
+                f"{disagg!r}")
+        if not paged:
+            raise ValueError(
+                "disagg requires paged=True (the prefill->decode KV "
+                "handoff is a block-table splice)")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

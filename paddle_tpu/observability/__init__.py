@@ -70,6 +70,18 @@ INSTRUMENT_DOCS = {
         "gauge — live weight hot-swaps applied to an engine's model "
         "(0 = the weights it was built with; bumps once per "
         "swap_weights call, per replica in a rolling router swap)",
+    "serving_prefix_affinity_hits{router=...} / _misses{router=...}":
+        "counters — DisaggRouter routing decisions that landed on the "
+        "prefill worker already holding the request's longest cached "
+        "prefix vs fell back to least-loaded (the fleet-wide prefix "
+        "index; FLAGS_serving_prefix_affinity)",
+    "serving_handoff_queue_depth{router=...}":
+        "gauge — finished prefills waiting for a decode worker to "
+        "adopt their KV blocks (bounded by "
+        "FLAGS_serving_handoff_queue; full = prefill backpressure)",
+    "serving_disagg_workers{router=..., role=...}":
+        "gauge — single-role workers in a disaggregated fleet, by "
+        "role (prefill | decode)",
     "zero_param_bytes_per_device{stage=...} / "
     "zero_opt_bytes_per_device{stage=...}":
         "gauges — max over devices of resident parameter / "
@@ -122,6 +134,21 @@ EVENT_DOCS = {
                            "engine (engine, version, params, "
                            "reset_costs) — the train→serve publish "
                            "step; zero new compiles by construction",
+    "serving_request": "one arrival at the serving front door (t, "
+                       "prompt, max_new_tokens, priority) — the "
+                       "replayable record tools/trace_convert.py "
+                       "turns into a loadgen trace",
+    "serving_handoff": "disaggregated KV handoff (stage=export: a "
+                       "prefill worker emitted the record; "
+                       "stage=adopt: a decode worker spliced/copied "
+                       "it in — `copied` marks cross-pool)",
+    "serving_drain_replica": "ReplicaRouter drained one replica out "
+                             "of the set (replica, rerouted, "
+                             "replicas_left); its queued requests "
+                             "re-homed onto live peers",
+    "serving_worker_kill": "DisaggRouter tore a worker down (role, "
+                           "worker, shed, rerouted) — the chaos "
+                           "teardown path, leak-free by contract",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

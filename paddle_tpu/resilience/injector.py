@@ -91,6 +91,12 @@ FAULT_SITE_DOCS: Dict[str, str] = {
                      "QueueFullError backpressure), `skip` sheds it "
                      "immediately; requests already placed on a "
                      "replica are untouched",
+    "serving.handoff": "DecodeEngine adoption of one prefill->decode "
+                       "KV handoff record (disaggregated serving) — "
+                       "drop/error are retried via RetryPolicy, "
+                       "`skip` and retry exhaustion shed that request "
+                       "with every block reference released (the "
+                       "leak-free teardown the chaos suite asserts)",
 }
 FAULT_SITES: Tuple[str, ...] = tuple(FAULT_SITE_DOCS)
 

@@ -25,16 +25,37 @@ replica scaling). With ``FLAGS_serving_slo_ttft_ms`` set the engine
 admits against a predicted TTFT instead of raw queue depth — priority
 classes, preemptive shedding of queued low-priority work, and
 deadline-expired sheds before prefill; ``tools/loadgen.py`` is the
-open-loop traffic source that exercises all of it. See engine.py for
-the scheduler, kv_cache.py for the memory managers, router.py for the
-replica front end, http.py for the JSON front end.
+open-loop traffic source that exercises all of it.
+
+``FLAGS_serving_disagg`` trades the symmetric replica set for a
+*disaggregated* fleet (:class:`DisaggRouter` in disagg.py): P
+prefill-only workers run the bucketed prompt pass and export each
+request's committed KV blocks — an ownership-transfer record over the
+paged pool — through a bounded handoff queue to D decode-only workers,
+which splice the block table in for free when co-located on one
+:class:`BlockPool` or copy the blocks across pools otherwise. Routing
+is prefix-affine (``FLAGS_serving_prefix_affinity``): a fleet-wide
+rolling-hash prefix index sends each request to the worker already
+holding its longest cached prefix, so hit rates compound across the
+fleet instead of fragmenting per replica. Same compiled steps, zero
+extra XLA compiles, token-identical output.
+
+See engine.py for the scheduler, kv_cache.py for the memory managers,
+router.py for the symmetric replica front end, disagg.py for the
+disaggregated fleet, http.py for the JSON front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine
+from .disagg import (DecodeEngine, DisaggRouter, HandoffQueue,
+                     PrefillEngine)
 from .http import ServingHTTPServer
-from .kv_cache import BlockAllocator, BlockKVCache, SlotKVCache
+from .kv_cache import (BlockAllocator, BlockKVCache, BlockPool,
+                       SlotKVCache, prefix_chain_keys)
 from .router import AutoscalePolicy, ReplicaRouter
 
 __all__ = ["ServingEngine", "Request", "QueueFullError",
            "SlotKVCache", "BlockKVCache", "BlockAllocator",
-           "ServingHTTPServer", "ReplicaRouter", "AutoscalePolicy"]
+           "BlockPool", "prefix_chain_keys",
+           "ServingHTTPServer", "ReplicaRouter", "AutoscalePolicy",
+           "DisaggRouter", "PrefillEngine", "DecodeEngine",
+           "HandoffQueue"]

@@ -1,0 +1,796 @@
+"""Disaggregated prefill/decode serving — a fleet of single-role
+engines behind one ``submit()`` front door.
+
+The :class:`~paddle_tpu.serving.router.ReplicaRouter` (PR 9) scales
+*symmetric* replicas: every engine runs both phases, so a long prefill
+wave stalls the decode batch behind it and TTFT inherits decode-batch
+jitter. Production fleets (DistServe, Splitwise) split the roles:
+
+- :class:`PrefillEngine` only admits — it runs the bucketed batched
+  prompt pass, emits the first generated token (prefill-logits argmax,
+  exactly as the symmetric engine does), then *exports* the request:
+  the row's block table plus its ``len(prompt)`` committed KV blocks
+  leave the cache as an ownership-transfer record
+  (``BlockKVCache.export_row``) and enter the fleet's bounded
+  :class:`HandoffQueue`.
+- :class:`DecodeEngine` only decodes — each step it adopts what the
+  queue holds: a record whose blocks live in its own
+  :class:`~paddle_tpu.serving.kv_cache.BlockPool` (co-located roles)
+  splices in as pure host-side bookkeeping
+  (``import_row`` — zero ref changes, zero bytes moved), a record from
+  a foreign pool copies its committed blocks through the destination
+  allocator (``adopt_row``), after which the source refs drop. Either
+  way ``BlockAllocator.leaked()`` stays exact across the handoff.
+- :class:`DisaggRouter` owns the fleet: P prefill workers feed D
+  decode workers through the queue, whose bound backpressures
+  admission (a full queue means prefill stops admitting rather than
+  pinning unbounded finished prefills).
+
+Routing gains **fleet-wide prefix affinity** (``FLAGS_serving_prefix_
+affinity``): the router keeps a rolling-hash prefix index — the same
+``hash((parent_key, chunk))`` chain the pool-level prefix cache
+publishes under (``kv_cache.prefix_chain_keys``) — mapping chain keys
+to the prefill worker that last prefilled that prefix. A request walks
+its own chain deepest-first and routes to the indexed worker (verified
+against the worker's actual cache; a stale entry still routes there so
+queued same-prefix bursts coalesce), falling back to least-loaded on a
+miss. Hit rates compound across the fleet instead of fragmenting
+per-replica; ``serving_prefix_affinity_{hits,misses}`` count the
+routing decisions and the existing ``serving_kv_blocks_*`` gauges keep
+accounting for the blocks themselves.
+
+Every compiled step is shared with the symmetric path: the unified
+per-model step cache (``models.generation.step_entry``) keys on
+geometry, never on role, so a disaggregated fleet at the same
+geometry adds **zero XLA compiles** — ``analysis.recompile.
+predict_serving_compiles(disagg=...)`` encodes exactly this, and the
+fleet's output is token-identical to a symmetric router on the same
+seeded workload (greedy argmax does not care which chip ran it).
+
+Chaos: ``kill_prefill_worker`` tears a prefill worker down mid-flight
+— queued requests re-route to surviving workers, in-flight prefills
+and undelivered handoff records shed with every block reference
+released — and the ``serving.handoff`` fault site injects drops at
+adoption time, retried via ``RetryPolicy.from_flags``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import monitor as _monitor
+from .. import observability as _obs
+from ..observability import runlog as _runlog
+from ..resilience.injector import fault_point
+from ..resilience.retry import RetryError, RetryPolicy
+from .engine import QueueFullError, Request, ServingEngine, _Shed
+from .kv_cache import prefix_chain_keys
+
+
+def parse_disagg(text: str) -> Optional[Tuple[int, int]]:
+    """'PxD' -> (n_prefill, n_decode), None when empty."""
+    text = str(text).strip()
+    if not text:
+        return None
+    try:
+        p, d = (int(s) for s in text.lower().split("x"))
+    except Exception:
+        raise ValueError(
+            f"serving_disagg must be 'PxD' (e.g. '1x2'), got {text!r}")
+    if p < 1 or d < 1:
+        raise ValueError(
+            f"serving_disagg needs at least 1 worker per role, "
+            f"got {text!r}")
+    return p, d
+
+
+class _Handoff:
+    """One finished prefill in flight between roles: the request, the
+    exported block record (which *owns* the blocks' references until
+    adopted or released), and the prefill worker that produced it —
+    the chaos path sheds a killed worker's undelivered records by
+    matching on ``src``."""
+
+    __slots__ = ("req", "rec", "src")
+
+    def __init__(self, req: Request, rec: dict, src: "PrefillEngine"):
+        self.req = req
+        self.rec = rec
+        self.src = src
+
+
+class HandoffQueue:
+    """Bounded FIFO between the prefill and decode roles.
+
+    The bound is the backpressure contract: when full, prefill workers
+    stop admitting (their finished-but-undelivered work would pin KV
+    blocks indefinitely otherwise). Decode workers ``take`` the oldest
+    record they can adopt — optionally filtered, so a co-located
+    worker prefers records it can splice for free.
+    """
+
+    def __init__(self, bound: int):
+        if bound < 1:
+            raise ValueError(f"handoff bound must be >= 1, got {bound}")
+        self.bound = int(bound)
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def room(self) -> int:
+        with self._lock:
+            return self.bound - len(self._items)
+
+    def put(self, item: _Handoff) -> bool:
+        with self._lock:
+            if len(self._items) >= self.bound:
+                return False
+            self._items.append(item)
+            return True
+
+    def take(self, match=None) -> Optional[_Handoff]:
+        """Remove and return the oldest item (matching ``match`` when
+        given), or None."""
+        with self._lock:
+            for i, item in enumerate(self._items):
+                if match is None or match(item):
+                    del self._items[i]
+                    return item
+            return None
+
+    def put_back(self, item: _Handoff):
+        """Return an item taken but not adoptable right now to the
+        front, preserving FIFO order for the next attempt."""
+        with self._lock:
+            self._items.appendleft(item)
+
+    def evict_from(self, src: "PrefillEngine") -> List[_Handoff]:
+        """Remove every undelivered record a (killed) prefill worker
+        produced; the caller owns shedding them + their block refs."""
+        with self._lock:
+            mine = [it for it in self._items if it.src is src]
+            self._items = deque(
+                it for it in self._items if it.src is not src)
+            return mine
+
+
+class PrefillEngine(ServingEngine):
+    """The admit-only role: bucketed batched prefill, then export.
+
+    ``step()`` admits (one prefill dispatch per bucket — the compiled
+    functions are the symmetric engine's, shared through the unified
+    step cache) and immediately exports every still-running row into
+    the handoff queue; rows free the moment the export record exists,
+    so a prefill worker's row count bounds its *per-step* admission
+    batch, not its lifetime concurrency. Requests that finish on their
+    prefill token (``max_new_tokens == 1`` or an EOS first token)
+    never hand off — they completed here.
+
+    Backpressure: no admission happens while the handoff queue is full
+    or earlier exports are still waiting to enqueue (``_pending``).
+    """
+
+    def __init__(self, model, handoff: HandoffQueue, **kwargs):
+        if kwargs.get("paged") is False:
+            raise ValueError(
+                "disaggregated serving requires the paged KV cache "
+                "(the handoff is a block-table splice)")
+        kwargs["paged"] = True
+        super().__init__(model, **kwargs)
+        self._handoff = handoff
+        self._pending: deque = deque()   # exported, waiting for room
+
+    def _flush_pending(self) -> int:
+        moved = 0
+        while self._pending:
+            if not self._handoff.put(self._pending[0]):
+                break
+            self._pending.popleft()
+            moved += 1
+        return moved
+
+    def _stage_running(self) -> int:
+        """Export every running row into ``_pending`` (deterministic
+        request-id order so seeded runs replay exactly)."""
+        staged = 0
+        for row in sorted(self._active,
+                          key=lambda r: self._active[r].id):
+            req = self._active.pop(row)
+            rec = self.cache.export_row(row)
+            req.slot = None          # in flight between roles
+            self._pending.append(_Handoff(req, rec, self))
+            staged += 1
+            if _runlog.enabled():
+                _runlog.log_event(
+                    "serving_handoff", request=req.id, stage="export",
+                    engine=self._eid, blocks=len(rec["blocks"]),
+                    length=rec["length"])
+        return staged
+
+    def step(self) -> bool:
+        with self._step_lock:
+            _monitor.stat_add("STAT_serving_steps")
+            worked = self._flush_pending() > 0
+            if not self._pending and self._handoff.room > 0:
+                worked = bool(self._admit()) or worked
+                worked = self._stage_running() > 0 or worked
+                worked = self._flush_pending() > 0 or worked
+            if self.paged:
+                self._blocks_used_g.set(self.cache.blocks_used)
+                self._blocks_free_g.set(self.cache.blocks_free)
+            return worked
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            queued = bool(self._queue)
+        return not queued and not self._active and not self._pending
+
+    def shed_pending(self, reason: str = "fault") -> int:
+        """Shed every exported-but-undelivered record, releasing its
+        block references — the killed-worker cleanup path."""
+        shed = 0
+        while self._pending:
+            item = self._pending.popleft()
+            item.rec["pool"].release_blocks(item.rec["blocks"])
+            self._shed(item.req, _Shed(
+                "prefill worker torn down before handoff"),
+                reason=reason)
+            shed += 1
+        return shed
+
+
+class DecodeEngine(ServingEngine):
+    """The decode-only role: adopt handoffs, then batched decode (or
+    speculative draft–verify) — the same compiled steps the symmetric
+    engine uses, at the same geometry, so the role split costs zero
+    XLA compiles.
+
+    Adoption prefers records whose blocks already live in this
+    worker's pool (co-located prefill: ``import_row``, a free splice)
+    and falls back to cross-pool block copies (``adopt_row``). A
+    record that doesn't fit right now (no free row / dry pool) stays
+    queued with its references intact — that *is* the backpressure.
+    """
+
+    def __init__(self, model, handoff: HandoffQueue, **kwargs):
+        if kwargs.get("paged") is False:
+            raise ValueError(
+                "disaggregated serving requires the paged KV cache "
+                "(the handoff is a block-table splice)")
+        kwargs["paged"] = True
+        super().__init__(model, **kwargs)
+        self._handoff = handoff
+        self.adopted = 0          # handoffs spliced/copied in
+        self.adopted_copies = 0   # the cross-pool subset
+
+    def submit(self, *a, **k):
+        raise RuntimeError(
+            "DecodeEngine does not accept submissions; submit through "
+            "the DisaggRouter (prefill workers feed this engine)")
+
+    def _handoff_attempt(self, item: _Handoff) -> Optional[int]:
+        """Adopt one record; None = no capacity (leave it queued).
+        The ``serving.handoff`` fault site injects here: ``skip``
+        sheds the request, drop/error retries per RetryPolicy."""
+        kind = fault_point("serving.handoff")
+        if kind == "skip":
+            raise _Shed("injected shed at serving.handoff")
+        same_pool = item.rec["pool"] is self.cache.pool
+        row = (self.cache.import_row(item.rec) if same_pool
+               else self.cache.adopt_row(item.rec))
+        if row is None:
+            return None
+        if not same_pool:
+            # the copy is done; drop the record's source references
+            item.rec["pool"].release_blocks(item.rec["blocks"])
+            self.adopted_copies += 1
+        return row
+
+    def _adopt_handoffs(self) -> int:
+        """Drain what fits: same-pool records first (free splices),
+        then cross-pool copies, oldest first within each class."""
+        adopted = 0
+        for match in (lambda it: it.rec["pool"] is self.cache.pool,
+                      None):
+            while self.cache.num_free > 0:
+                item = self._handoff.take(match)
+                if item is None:
+                    break
+                try:
+                    row = RetryPolicy.from_flags(
+                        "serving.handoff").call(
+                            self._handoff_attempt, item)
+                except (_Shed, RetryError) as e:
+                    item.rec["pool"].release_blocks(
+                        item.rec["blocks"])
+                    self._shed(item.req, e)
+                    continue
+                if row is None:      # no space: keep refs, retry later
+                    self._handoff.put_back(item)
+                    break
+                item.req.slot = row
+                self._active[row] = item.req
+                self.adopted += 1
+                adopted += 1
+                _monitor.stat_add("STAT_serving_handoffs")
+                if _runlog.enabled():
+                    _runlog.log_event(
+                        "serving_handoff", request=item.req.id,
+                        stage="adopt", engine=self._eid, slot=row,
+                        copied=not (item.rec["pool"]
+                                    is self.cache.pool))
+        return adopted
+
+    def step(self) -> bool:
+        with self._step_lock:
+            _monitor.stat_add("STAT_serving_steps")
+            worked = self._adopt_handoffs() > 0
+            produced = (self._spec_decode() if self.spec_tokens
+                        else self._decode())
+            if self.paged:
+                self._blocks_used_g.set(self.cache.blocks_used)
+                self._blocks_free_g.set(self.cache.blocks_free)
+            return bool(worked or produced)
+
+
+class DisaggRouter:
+    """One ``submit()`` front door over a disaggregated fleet: P
+    :class:`PrefillEngine` workers feed D :class:`DecodeEngine`
+    workers through a bounded :class:`HandoffQueue`.
+
+    ``colocate=True`` (default) pairs decode worker ``j`` with prefill
+    worker ``j % P``'s :class:`BlockPool` — the handoff is then a pure
+    block-table splice. ``colocate=False`` gives every worker its own
+    pool (the multi-host shape) and handoffs copy committed blocks
+    through the destination allocator.
+
+    The interface mirrors :class:`ReplicaRouter` (``submit`` /
+    ``step`` / ``run_until_idle`` / ``drain`` / ``results`` /
+    ``stats`` / ``start`` / ``stop``) so ``tools/loadgen.py`` drives
+    either interchangeably.
+    """
+
+    _router_ids = itertools.count()
+
+    # fleet-wide affinity index bound: entries are (int key -> engine)
+    # pairs, evicted LRU — big enough to cover every prefix the pools
+    # can physically cache, small enough to never matter in memory
+    AFFINITY_CAP = 8192
+
+    def __init__(self, model, n_prefill: Optional[int] = None,
+                 n_decode: Optional[int] = None,
+                 prefix_affinity: Optional[bool] = None,
+                 handoff_queue: Optional[int] = None,
+                 colocate: bool = True, **engine_kwargs):
+        from .. import flags as _flags
+        g = _flags.get_flags(["serving_disagg",
+                              "serving_prefix_affinity",
+                              "serving_handoff_queue"])
+        if n_prefill is None or n_decode is None:
+            dims = parse_disagg(g["serving_disagg"])
+            if dims is None:
+                dims = (1, 1)
+            n_prefill = int(n_prefill if n_prefill is not None
+                            else dims[0])
+            n_decode = int(n_decode if n_decode is not None
+                           else dims[1])
+        if n_prefill < 1 or n_decode < 1:
+            raise ValueError(
+                f"need at least 1 worker per role, got "
+                f"{n_prefill} prefill x {n_decode} decode")
+        self.prefix_affinity = bool(
+            prefix_affinity if prefix_affinity is not None
+            else g["serving_prefix_affinity"])
+        bound = int(handoff_queue if handoff_queue is not None
+                    else g["serving_handoff_queue"])
+        self._handoff = HandoffQueue(bound)
+        self._model = model
+        self.prefills: List[PrefillEngine] = [
+            PrefillEngine(model, self._handoff, **engine_kwargs)
+            for _ in range(n_prefill)]
+        self.decodes: List[DecodeEngine] = []
+        for j in range(n_decode):
+            kw = dict(engine_kwargs)
+            if colocate:
+                kw["kv_pool"] = \
+                    self.prefills[j % n_prefill].cache.pool
+            self.decodes.append(
+                DecodeEngine(model, self._handoff, **kw))
+        self.colocate = bool(colocate)
+        self._killed: List[PrefillEngine] = []
+        self._draining = False
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # chain key -> PrefillEngine that last prefilled that prefix
+        self._affinity: "OrderedDict[int, PrefillEngine]" = \
+            OrderedDict()
+        rid = str(next(DisaggRouter._router_ids))
+        self._rid = rid
+        self._aff_hits = _obs.counter(
+            "serving_prefix_affinity_hits",
+            "requests routed to the prefill worker already holding "
+            "their longest cached prefix (fleet prefix index)"
+            ).labels(router=rid)
+        self._aff_misses = _obs.counter(
+            "serving_prefix_affinity_misses",
+            "requests routed least-loaded because no live worker held "
+            "any of their prefix (or the index was stale)"
+            ).labels(router=rid)
+        self._handoff_gauge = _obs.gauge(
+            "serving_handoff_queue_depth",
+            "finished prefills waiting for a decode worker to adopt "
+            "their KV blocks (bounded; full = prefill backpressure)"
+            ).labels(router=rid)
+        self._handoff_gauge.set(0)
+        _obs.gauge(
+            "serving_disagg_workers",
+            "single-role workers in this disaggregated fleet, by role"
+            ).labels(router=rid, role="prefill").set(n_prefill)
+        _obs.gauge(
+            "serving_disagg_workers",
+            "single-role workers in this disaggregated fleet, by role"
+            ).labels(router=rid, role="decode").set(n_decode)
+
+    # ----------------------------------------------------------- routing
+    @property
+    def engines(self) -> List[ServingEngine]:
+        """All live workers, prefill first — the duck-typed surface
+        loadgen and the leak checks walk."""
+        return list(self.prefills) + list(self.decodes)
+
+    @property
+    def _retiring(self) -> List[ServingEngine]:
+        # interface parity with ReplicaRouter (loadgen walks this)
+        return list(self._killed)
+
+    def _depth(self, eng: ServingEngine) -> int:
+        with eng._lock:
+            return len(eng._queue) + len(eng._active)
+
+    def _blocks_free(self, eng: ServingEngine) -> int:
+        return eng.cache.blocks_free
+
+    def _least_loaded(self) -> List[int]:
+        return sorted(
+            (i for i, e in enumerate(self.prefills)
+             if not e.draining),
+            key=lambda i: (self._depth(self.prefills[i]),
+                           -self._blocks_free(self.prefills[i]), i))
+
+    def _affinity_pick(self, prompt: Sequence[int],
+                       keys: Sequence[int]) -> Optional[int]:
+        """Deepest indexed chain key whose worker is alive — verified
+        against the worker's actual pool (a stale hit still routes
+        there: queued same-prefix requests coalesce and re-publish)."""
+        for key in reversed(keys):
+            eng = self._affinity.get(key)
+            if eng is None or eng.draining or \
+                    eng not in self.prefills:
+                continue
+            self._affinity.move_to_end(key)
+            idx = self.prefills.index(eng)
+            if eng.cache.match_prefix_blocks(prompt) > 0:
+                self._aff_hits.add(1)
+                _monitor.stat_add("STAT_serving_affinity_hits")
+            else:
+                self._aff_misses.add(1)
+                _monitor.stat_add("STAT_serving_affinity_misses")
+            return idx
+        return None
+
+    def _publish_affinity(self, keys: Sequence[int],
+                          eng: "PrefillEngine"):
+        for key in keys:
+            self._affinity[key] = eng
+            self._affinity.move_to_end(key)
+        while len(self._affinity) > self.AFFINITY_CAP:
+            self._affinity.popitem(last=False)
+
+    def _route_attempt(self, prompt, max_new_tokens, eos_token_id,
+                       priority) -> Request:
+        kind = fault_point("serving.route")
+        if kind == "skip":
+            _monitor.stat_add("STAT_serving_route_shed")
+            raise QueueFullError(
+                "submission shed by injected fault at serving.route",
+                reason="fault")
+        keys: List[int] = []
+        order = self._least_loaded()
+        if not order:
+            raise QueueFullError("no live prefill worker", reason="drain")
+        if self.prefix_affinity:
+            bs = self.prefills[0].cache.block_size
+            keys = prefix_chain_keys(prompt, bs)
+            pick = self._affinity_pick(prompt, keys) if keys else None
+            if pick is None and keys:
+                self._aff_misses.add(1)
+                _monitor.stat_add("STAT_serving_affinity_misses")
+            if pick is not None:
+                order = [pick] + [i for i in order if i != pick]
+        last_err: Optional[QueueFullError] = None
+        for i in order:
+            eng = self.prefills[i]
+            try:
+                req = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                                 eos_token_id=eos_token_id,
+                                 priority=priority, _log_request=False)
+            except QueueFullError as e:
+                last_err = e
+                continue
+            _monitor.stat_add("STAT_serving_routed")
+            _runlog.log_event("serving_route", request=req.id,
+                              replica=i, depth=self._depth(eng),
+                              kv_blocks_free=self._blocks_free(eng),
+                              role="prefill")
+            if self.prefix_affinity and keys:
+                self._publish_affinity(keys, eng)
+            return req
+        _monitor.stat_add("STAT_serving_route_shed")
+        raise last_err if last_err is not None else QueueFullError(
+            "every prefill worker queue is full")
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               priority: Optional[int] = None,
+               _log_request: bool = True) -> Request:
+        """Route one request to a prefill worker — prefix-affine when
+        the fleet index knows the prompt's prefix, least-loaded
+        otherwise. Decode capacity is reached through the handoff
+        queue, never directly."""
+        with self._lock:
+            if self._draining:
+                raise QueueFullError("router is draining: submissions "
+                                     "are shed for rolling shutdown",
+                                     reason="drain")
+        if _log_request and _runlog.enabled():
+            prompt = [int(t) for t in prompt]
+            _runlog.log_event(
+                "serving_request",
+                t=round(self.prefills[0]._clock(), 6), prompt=prompt,
+                max_new_tokens=int(
+                    max_new_tokens if max_new_tokens is not None
+                    else self.prefills[0].default_max_new_tokens),
+                priority=int(priority if priority is not None else 1),
+                router=self._rid)
+        try:
+            return RetryPolicy.from_flags("serving.route").call(
+                self._route_attempt, prompt, max_new_tokens,
+                eos_token_id, priority)
+        except RetryError as e:
+            _monitor.stat_add("STAT_serving_route_shed")
+            raise QueueFullError(
+                f"routing retries exhausted: {e}", reason="fault") from e
+
+    # ---------------------------------------------------------- stepping
+    def step(self) -> bool:
+        """One fleet iteration: every prefill worker (admission +
+        export), then every decode worker (adoption + decode), in
+        fixed order — the deterministic test/benchmark path."""
+        worked = False
+        for eng in list(self.prefills):
+            worked = eng.step() or worked
+        for eng in list(self.decodes):
+            worked = eng.step() or worked
+        self._handoff_gauge.set(len(self._handoff))
+        return worked
+
+    @property
+    def idle(self) -> bool:
+        return (len(self._handoff) == 0 and
+                all(e.idle for e in self.prefills) and
+                all(e.idle for e in self.decodes))
+
+    def run_until_idle(self, max_steps: int = 10_000) -> int:
+        steps = 0
+        while not self.idle:
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"disagg fleet not idle after {max_steps} steps "
+                    f"({len(self._handoff)} handoffs queued)")
+        return steps
+
+    def drain(self, max_steps: int = 10_000) -> int:
+        """Stop admissions and run the fleet to idle; returns how many
+        requests were shed on the way down."""
+        with self._lock:
+            self._draining = True
+        engines = self.engines + self._retiring
+        def _shed_total(e):
+            with e._lock:
+                return sum(e._shed_by_reason.values())
+        before = sum(_shed_total(e) for e in engines)
+        _runlog.log_event("serving_drain",
+                          replicas=len(engines),
+                          queued=[self._depth(e) for e in engines])
+        self.run_until_idle(max_steps)
+        _monitor.stat_add("STAT_serving_drained")
+        shed = sum(_shed_total(e) for e in engines) - before
+        if shed:
+            _monitor.stat_add("STAT_serving_drain_shed", shed)
+        _runlog.log_event("serving_drain_done", shed=shed)
+        return shed
+
+    # ------------------------------------------------------------- chaos
+    def kill_prefill_worker(self, index: int) -> dict:
+        """Tear one prefill worker down mid-flight (chaos): queued
+        requests re-route to surviving prefill workers with capacity,
+        in-flight prefills and undelivered handoff records shed with
+        every block reference released, and the fleet prefix index
+        forgets the worker. Returns the cleanup accounting."""
+        with self._lock:
+            if not 0 <= index < len(self.prefills):
+                raise IndexError(
+                    f"prefill worker {index} out of range "
+                    f"(have {len(self.prefills)})")
+            if len(self.prefills) == 1:
+                # no survivor can take the queue: everything sheds
+                pass
+            eng = self.prefills.pop(index)
+            eng.draining = True
+            self._killed.append(eng)
+        # forget the worker in the affinity index
+        for key in [k for k, v in self._affinity.items() if v is eng]:
+            del self._affinity[key]
+        # undelivered handoff records: shed + release their refs
+        shed = 0
+        for item in self._handoff.evict_from(eng):
+            item.rec["pool"].release_blocks(item.rec["blocks"])
+            eng._shed(item.req, _Shed(
+                "prefill worker killed before handoff delivery"))
+            shed += 1
+        with eng._step_lock:
+            shed += eng.shed_pending()
+            # mid-prefill actives: row + blocks released through the
+            # normal retirement path
+            for row, req in list(eng._active.items()):
+                del eng._active[row]
+                eng.cache.release(row)
+                eng._shed(req, _Shed("prefill worker killed"))
+                shed += 1
+        # still-queued requests re-home onto survivors
+        rerouted = 0
+        for req in eng.take_queued():
+            placed = False
+            for i in self._least_loaded():
+                if self.prefills[i].adopt_request(req):
+                    placed = True
+                    rerouted += 1
+                    _monitor.stat_add("STAT_serving_rerouted")
+                    break
+            if not placed:
+                eng._shed(req, QueueFullError(
+                    "no surviving prefill worker could adopt the "
+                    "request", reason="drain"), reason="drain")
+                shed += 1
+        # the prefix cache's own refs would read as leaks of a dead
+        # worker; flush unless a co-located decode still shares the
+        # pool (then its lifecycle owns them)
+        if not any(d.cache.pool is eng.cache.pool
+                   for d in self.decodes):
+            eng.cache.flush_prefix_cache()
+        _monitor.stat_add("STAT_serving_worker_killed")
+        _runlog.log_event("serving_worker_kill", role="prefill",
+                          worker=index, shed=shed, rerouted=rerouted,
+                          prefills_left=len(self.prefills))
+        return {"shed": shed, "rerouted": rerouted,
+                "prefills_left": len(self.prefills)}
+
+    # ---------------------------------------------------------- plumbing
+    def swap_weights(self, state, *, reset_costs: bool = True
+                     ) -> List[int]:
+        """Rolling weight hot-swap across both roles (same contract as
+        ``ReplicaRouter.swap_weights``)."""
+        with self._lock:
+            engines = self.engines + self._retiring
+        return [eng.swap_weights(state, reset_costs=reset_costs)
+                for eng in engines]
+
+    def results(self, reqs=None, timeout: Optional[float] = None
+                ) -> List[Request]:
+        """Wait for requests, submission order. Requests live in the
+        prefill workers' ``_all`` (submission lands there; adoption
+        moves only the KV, not the bookkeeping), deduped by id in case
+        a re-routed request was adopted into a second worker's list."""
+        if reqs is None:
+            seen: Dict[int, Request] = {}
+            for eng in self.prefills + self._killed:
+                with eng._lock:
+                    for r in eng._all:
+                        seen.setdefault(r.id, r)
+            out = sorted(seen.values(), key=lambda r: r.id)
+            for r in out:
+                if not r.wait(timeout):
+                    raise TimeoutError(
+                        f"request {r.id} not finished within {timeout}s")
+            return out
+        out = list(reqs)
+        for r in out:
+            if not r.wait(timeout):
+                raise TimeoutError(
+                    f"request {r.id} not finished within {timeout}s")
+        return out
+
+    def start(self):
+        """One scheduler thread for the whole fleet: co-located roles
+        share BlockPool state, so a single stepper keeps every
+        host-side mutation on one thread (the same reason one engine
+        has one step lock)."""
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+
+        def _loop():
+            idle_wait = self.prefills[0].idle_wait
+            while not self._stop_evt.is_set():
+                if not self.step():
+                    self._stop_evt.wait(idle_wait)
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="serving-disagg")
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def stats(self) -> dict:
+        """Fleet view: per-role worker counts and queue depths, the
+        handoff queue, affinity counters, and the pool-level prefix
+        hit rate aggregated over *unique* pools (co-located roles
+        share one — double counting would flatter the rate)."""
+        engines = self.engines + self._retiring
+        shed: dict = {}
+        completed = 0
+        for e in engines:
+            with e._lock:
+                completed += e._completed
+                for k, v in e._shed_by_reason.items():
+                    shed[k] = shed.get(k, 0) + v
+        pools = {}
+        for e in engines:
+            pools[id(e.cache.pool)] = e.cache.pool
+        hits = sum(p.prefix_hits for p in pools.values())
+        misses = sum(p.prefix_misses for p in pools.values())
+        adopted = sum(d.adopted for d in self.decodes)
+        copies = sum(d.adopted_copies for d in self.decodes)
+        return {
+            "prefill_workers": len(self.prefills),
+            "decode_workers": len(self.decodes),
+            "colocated": self.colocate,
+            "draining": self._draining,
+            "handoff_queued": len(self._handoff),
+            "handoff_bound": self._handoff.bound,
+            "handoffs_adopted": adopted,
+            "handoffs_copied": copies,
+            "prefix_affinity": self.prefix_affinity,
+            "affinity_hits": int(self._aff_hits.value),
+            "affinity_misses": int(self._aff_misses.value),
+            "affinity_index_entries": len(self._affinity),
+            "fleet_prefix_hits": hits,
+            "fleet_prefix_misses": misses,
+            "fleet_prefix_hit_rate": (
+                round(hits / (hits + misses), 4)
+                if hits + misses else None),
+            "completed": completed,
+            "shed": shed,
+            "shed_total": sum(shed.values()),
+            "queue_depths": [self._depth(e) for e in self.prefills],
+            "kv_blocks_free": [self._blocks_free(e)
+                               for e in self.prefills],
+            "per_prefill": [e.stats() for e in self.prefills],
+            "per_decode": [e.stats() for e in self.decodes],
+        }

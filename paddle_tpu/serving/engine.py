@@ -283,7 +283,7 @@ class ServingEngine:
                  slo_prefill_ms: Optional[float] = None,
                  slo_tpot_ms: Optional[float] = None,
                  priority_preempt: Optional[bool] = None,
-                 clock=None):
+                 clock=None, kv_pool=None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -385,7 +385,29 @@ class ServingEngine:
         self.mesh = mesh
         self.mesh_shape = (None if mesh is None else
                            tuple(int(s) for s in mesh.devices.shape))
-        if self.paged:
+        if kv_pool is not None:
+            # co-located disaggregated roles share one physical pool:
+            # geometry comes from the pool (not the flags) so the
+            # sharing cache cannot drift from what the blocks are
+            if not self.paged:
+                raise ValueError(
+                    "kv_pool sharing requires the paged KV cache "
+                    "(FLAGS_serving_paged)")
+            if self.mesh is not None:
+                raise ValueError(
+                    "kv_pool sharing and mesh placement are mutually "
+                    "exclusive — the pool is placed once by the engine "
+                    "that built it")
+            if kv_dtype is None:
+                self.kv_dtype = kv_pool.kv_dtype
+            self.cache = BlockKVCache(
+                cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                self.max_slots, self.max_len,
+                block_size=kv_pool.block_size,
+                prefix_cache=bool(prefix_cache if prefix_cache is not None
+                                  else g["serving_prefix_cache"]),
+                kv_dtype=self.kv_dtype, pool=kv_pool)
+        elif self.paged:
             self.cache = BlockKVCache(
                 cfg.num_layers, cfg.num_heads, cfg.head_dim,
                 self.max_slots, self.max_len,
@@ -410,6 +432,10 @@ class ServingEngine:
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}
         self._all: List[Request] = []
+        # a draining engine refuses new submissions (reason="drain");
+        # routers skip it when routing and may re-home its queue via
+        # take_queued()/adopt_request() on a live peer
+        self.draining = False
         self._lock = threading.Lock()        # queue + _all
         self._step_lock = threading.Lock()   # one scheduler at a time
         self._wake = threading.Event()
@@ -689,7 +715,8 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
-               priority: Optional[int] = None) -> Request:
+               priority: Optional[int] = None,
+               _log_request: bool = True) -> Request:
         """Queue a generation request; returns its handle immediately.
 
         ``priority`` is an integer class, lower = more urgent (default
@@ -728,6 +755,21 @@ class ServingEngine:
                     f"has {self.cache.num_blocks - 1} usable; raise "
                     "FLAGS_serving_num_blocks or shorten the request")
         pr = int(priority if priority is not None else 1)
+        now = self._clock()
+        if _log_request and _runlog.enabled():
+            # the replayable arrival record (tools/trace_convert.py):
+            # everything loadgen needs to re-offer this exact request.
+            # Routers log one fleet-level event themselves and pass
+            # _log_request=False so fan-out doesn't duplicate arrivals.
+            _runlog.log_event("serving_request", t=round(now, 6),
+                              prompt=prompt, max_new_tokens=mnt,
+                              priority=pr, engine=self._eid)
+        if self.draining:
+            _monitor.stat_add("STAT_serving_rejected")
+            self._count_shed("drain", pr)
+            raise QueueFullError("engine is draining; resubmit to a "
+                                 "live replica", reason="drain",
+                                 retry_after_s=self._retry_after_s(0.0))
         # raising kinds reject this submission pre-queue; `skip` sheds
         # it through the same backpressure exit as a full queue
         kind = fault_point("serving.submit")
@@ -737,7 +779,6 @@ class ServingEngine:
             raise QueueFullError("submission shed by injected fault at "
                                  "serving.submit", reason="fault",
                                  retry_after_s=self._retry_after_s(0.0))
-        now = self._clock()
         req = Request(prompt, mnt, eos, priority=pr, now=now)
         if self.slo_ttft_ms:
             req.deadline = now + self.slo_ttft_ms / 1e3
@@ -789,6 +830,35 @@ class ServingEngine:
         _monitor.stat_add("STAT_serving_submitted")
         self._wake.set()
         return req
+
+    def take_queued(self) -> List["Request"]:
+        """Pop every still-queued (not yet admitted) request — the
+        drain/re-route path: a router moves these onto live peers via
+        :meth:`adopt_request` instead of letting them die with this
+        engine. The requests stay in ``_all`` here so their handles
+        keep resolving for whoever holds them."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
+
+    def adopt_request(self, req: "Request") -> bool:
+        """Enqueue an already-validated request re-routed from a
+        draining peer. Depth backpressure only (no SLO re-prediction —
+        the request was admitted once already); returns False when the
+        queue is full so the router can try the next peer."""
+        if self.draining:
+            return False
+        if len(req.prompt) + req.max_new_tokens + self.spec_tokens > \
+                self.max_len:
+            return False  # peer geometry differs; not adoptable here
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                return False
+            self._queue.append(req)
+            self._all.append(req)
+        self._wake.set()
+        return True
 
     # ----------------------------------------------------------- prefill
     def _bucket_for(self, length: int) -> int:

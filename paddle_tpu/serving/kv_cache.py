@@ -18,6 +18,16 @@ Two designs live here:
   row per request), kept as the ``paged=False`` fallback and the
   benchmark baseline the paged cache is measured against.
 
+The physical half of :class:`BlockKVCache` — the block arrays, the
+ref-counted :class:`BlockAllocator` and the prefix cache — lives in a
+:class:`BlockPool` so several caches can share one pool handle:
+co-located prefill and decode engine roles (``serving/disagg.py``)
+splice a request's block table from one cache into another as pure
+host-side bookkeeping (``export_row``/``import_row`` — an ownership
+transfer, zero ref changes), while engines on distinct pools copy the
+committed blocks through the destination allocator (``adopt_row``).
+Either way ``BlockAllocator.leaked()`` stays exact across the handoff.
+
 Both keep every buffer at a fixed shape so the batched decode step has
 a single signature and compiles exactly once; admitting or retiring a
 request is bookkeeping, never a recompile.
@@ -219,6 +229,122 @@ class _PrefixEntry:
         self.tokens = tokens
 
 
+def prefix_chain_keys(prompt: Sequence[int], block_size: int) -> List[int]:
+    """Rolling-hash chain keys for each *full* block of ``prompt`` —
+    the same ``hash((parent_key, chunk))`` chain :class:`BlockKVCache`
+    publishes prefix entries under, exposed so a router can keep a
+    fleet-wide prefix index (prefix-affinity routing) without touching
+    any pool's internals."""
+    bs = int(block_size)
+    keys: List[int] = []
+    key = None
+    for i in range(len(prompt) // bs):
+        chunk = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
+        key = hash((key, chunk))
+        keys.append(key)
+    return keys
+
+
+class BlockPool:
+    """The shareable physical half of :class:`BlockKVCache`: the
+    per-layer block arrays, the ref-counted :class:`BlockAllocator`
+    and the rolling-hash prefix cache, plus the pool-global counters.
+
+    Several caches may hold one pool (co-located prefill/decode engine
+    roles): each cache keeps its own row state (block tables, lengths,
+    free rows) while allocation, prefix sharing and the functional
+    array updates all land here — ``set_arrays`` through any sharing
+    cache replaces the arrays every other cache reads.
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 block_size: int = 16, num_blocks: int = 2,
+                 dtype=None, kv_dtype: str = "f32"):
+        import jax.numpy as jnp
+        if kv_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'f32', 'bf16' or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        if dtype is None:
+            dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                     "int8": jnp.int8}[kv_dtype]
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={num_blocks} leaves no usable block after "
+                f"reserving the trash block")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        shape = (self.num_blocks, num_heads, self.block_size, head_dim)
+        if kv_dtype == "int8":
+            # 4-tuple layers: int8 code pools + per-block-per-head
+            # absmax scales (ops.attention_ops.block_scatter_write_quant
+            # is the only writer; the structural 2-vs-4 tuple width is
+            # what the model forward dispatches on)
+            sshape = (self.num_blocks, num_heads)
+            self.layers: List[Tuple[jax.Array, ...]] = [
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(num_layers)]
+        else:
+            self.layers = [
+                (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(num_layers)]
+        self.allocator = BlockAllocator(self.num_blocks)
+        trash = self.allocator.alloc()
+        assert trash == BlockKVCache.TRASH
+        # key -> _PrefixEntry, move_to_end on touch => LRU eviction order
+        self._prefix: "OrderedDict[int, _PrefixEntry]" = OrderedDict()
+        self.prefix_hits = 0       # token-weighted: shared tokens reused
+        self.prefix_misses = 0     # prompt tokens prefilled from scratch
+        self.blocks_allocated_total = 0  # fresh allocs (bench: bytes/request)
+
+    def alloc_block(self) -> Optional[int]:
+        """Fresh block, evicting idle prefix-cache entries if needed."""
+        blk = self.allocator.alloc()
+        while blk is None and self._evict_one_prefix():
+            blk = self.allocator.alloc()
+        return blk
+
+    def _drop_entry(self, ent: _PrefixEntry):
+        del self._prefix[ent.key]
+        self.allocator.deref(ent.block)
+        if ent.parent_block is not None:
+            self.allocator.deref(ent.parent_block)
+
+    def _evict_one_prefix(self) -> bool:
+        """Drop the least-recently-used cache-only prefix entry.
+
+        Only entries whose block sits at refcount 1 (held solely by the
+        cache) are evictable; entries a live request still references
+        are skipped. A chain parent carries a pin from each cached
+        child, so eviction proceeds leaf-first regardless of LRU order.
+        """
+        for key in list(self._prefix):
+            ent = self._prefix[key]
+            if self.allocator.refcount[ent.block] == 1:
+                self._drop_entry(ent)
+                return True
+        return False
+
+    def release_blocks(self, blocks: Sequence[int]):
+        """Drop one reference per block — how an aborted handoff
+        record (exported but never adopted) returns its ownership."""
+        for blk in blocks:
+            self.allocator.deref(int(blk))
+
+    def flush_prefix_cache(self):
+        """Drop every cached prefix ref (tests / memory pressure).
+        Live requests keep their own refs; only cache refs drop."""
+        for key in list(self._prefix):
+            self._drop_entry(self._prefix[key])
+
+
 class BlockKVCache:
     """Block-paged KV storage + ref-counted allocator + prefix cache.
 
@@ -258,59 +384,112 @@ class BlockKVCache:
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  max_slots: int, max_len: int, block_size: int = 16,
                  num_blocks: int = 0, prefix_cache: bool = True,
-                 dtype=None, kv_dtype: str = "f32"):
-        import jax.numpy as jnp
-        if kv_dtype not in ("f32", "bf16", "int8"):
-            raise ValueError(
-                f"kv_dtype must be 'f32', 'bf16' or 'int8', got {kv_dtype!r}")
-        self.kv_dtype = kv_dtype
-        if dtype is None:
-            dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16,
-                     "int8": jnp.int8}[kv_dtype]
-        if block_size < 1:
-            raise ValueError(f"block_size must be >= 1, got {block_size}")
+                 dtype=None, kv_dtype: str = "f32",
+                 pool: Optional[BlockPool] = None):
         self.max_slots = int(max_slots)
         self.max_len = int(max_len)
-        self.block_size = int(block_size)
-        self.blocks_per_row = -(-self.max_len // self.block_size)
-        if num_blocks <= 0:
-            # worst case every slot is full-length, +1 for the trash block
-            num_blocks = self.max_slots * self.blocks_per_row + 1
-        if num_blocks < 2:
-            raise ValueError(
-                f"num_blocks={num_blocks} leaves no usable block after "
-                f"reserving the trash block")
-        self.num_blocks = int(num_blocks)
-        shape = (self.num_blocks, num_heads, self.block_size, head_dim)
-        if kv_dtype == "int8":
-            # 4-tuple layers: int8 code pools + per-block-per-head
-            # absmax scales (ops.attention_ops.block_scatter_write_quant
-            # is the only writer; the structural 2-vs-4 tuple width is
-            # what the model forward dispatches on)
-            sshape = (self.num_blocks, num_heads)
-            self.layers: List[Tuple[jax.Array, ...]] = [
-                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
-                 jnp.zeros(sshape, jnp.float32),
-                 jnp.zeros(sshape, jnp.float32))
-                for _ in range(num_layers)]
+        if pool is not None:
+            # co-located caches share one pool handle: validate the
+            # geometry this cache was asked for against what the pool
+            # physically is (a mismatched compiled step would silently
+            # read the wrong blocks otherwise)
+            if pool.num_layers != int(num_layers) or \
+                    pool.num_heads != int(num_heads) or \
+                    pool.head_dim != int(head_dim):
+                raise ValueError(
+                    f"shared pool is {pool.num_layers} layers x "
+                    f"{pool.num_heads} heads x {pool.head_dim} dims; "
+                    f"cache wants {num_layers}x{num_heads}x{head_dim}")
+            if num_blocks > 0 and int(num_blocks) != pool.num_blocks:
+                raise ValueError(
+                    f"shared pool has {pool.num_blocks} blocks; cannot "
+                    f"resize to {num_blocks} through a sharing cache")
+            if int(block_size) != pool.block_size:
+                raise ValueError(
+                    f"shared pool block_size={pool.block_size} != "
+                    f"requested {block_size}")
+            if kv_dtype != pool.kv_dtype:
+                raise ValueError(
+                    f"shared pool kv_dtype={pool.kv_dtype!r} != "
+                    f"requested {kv_dtype!r}")
+            self.pool = pool
         else:
-            self.layers = [
-                (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                for _ in range(num_layers)]
-        self.allocator = BlockAllocator(self.num_blocks)
-        trash = self.allocator.alloc()
-        assert trash == self.TRASH
+            block_size = int(block_size)
+            if block_size < 1:
+                raise ValueError(
+                    f"block_size must be >= 1, got {block_size}")
+            if num_blocks <= 0:
+                # worst case every slot is full-length, +1 trash block
+                num_blocks = (self.max_slots *
+                              (-(-self.max_len // block_size)) + 1)
+            self.pool = BlockPool(num_layers, num_heads, head_dim,
+                                  block_size=block_size,
+                                  num_blocks=num_blocks, dtype=dtype,
+                                  kv_dtype=kv_dtype)
+        self.blocks_per_row = -(-self.max_len // self.pool.block_size)
         self.tables = np.full((self.max_slots, self.blocks_per_row),
                               self.TRASH, np.int32)
         self.lengths = np.zeros(self.max_slots, np.int32)
         self._nblocks = np.zeros(self.max_slots, np.int32)  # owned per row
         self._free_rows = list(range(self.max_slots))
         self.prefix_cache_enabled = bool(prefix_cache)
-        # key -> _PrefixEntry, move_to_end on touch => LRU eviction order
-        self._prefix: "OrderedDict[int, _PrefixEntry]" = OrderedDict()
-        self.prefix_hits = 0       # token-weighted: shared tokens reused
-        self.prefix_misses = 0     # prompt tokens prefilled from scratch
-        self.blocks_allocated_total = 0  # fresh allocs (bench: bytes/request)
+
+    # -- pool delegation ---------------------------------------------
+    # the physical state lives in self.pool so sharing caches observe
+    # every functional array replacement and every counter bump; these
+    # properties keep the long-standing cache-level API intact
+
+    @property
+    def kv_dtype(self) -> str:
+        return self.pool.kv_dtype
+
+    @property
+    def block_size(self) -> int:
+        return self.pool.block_size
+
+    @property
+    def num_blocks(self) -> int:
+        return self.pool.num_blocks
+
+    @property
+    def layers(self):
+        return self.pool.layers
+
+    @layers.setter
+    def layers(self, value):
+        self.pool.layers = value
+
+    @property
+    def allocator(self) -> BlockAllocator:
+        return self.pool.allocator
+
+    @property
+    def _prefix(self) -> "OrderedDict[int, _PrefixEntry]":
+        return self.pool._prefix
+
+    @property
+    def prefix_hits(self) -> int:
+        return self.pool.prefix_hits
+
+    @prefix_hits.setter
+    def prefix_hits(self, value: int):
+        self.pool.prefix_hits = value
+
+    @property
+    def prefix_misses(self) -> int:
+        return self.pool.prefix_misses
+
+    @prefix_misses.setter
+    def prefix_misses(self, value: int):
+        self.pool.prefix_misses = value
+
+    @property
+    def blocks_allocated_total(self) -> int:
+        return self.pool.blocks_allocated_total
+
+    @blocks_allocated_total.setter
+    def blocks_allocated_total(self, value: int):
+        self.pool.blocks_allocated_total = value
 
     # -- geometry ----------------------------------------------------
 
@@ -337,32 +516,13 @@ class BlockKVCache:
     # -- allocation --------------------------------------------------
 
     def _alloc_block(self) -> Optional[int]:
-        """Fresh block, evicting idle prefix-cache entries if needed."""
-        blk = self.allocator.alloc()
-        while blk is None and self._evict_one_prefix():
-            blk = self.allocator.alloc()
-        return blk
+        return self.pool.alloc_block()
 
     def _drop_entry(self, ent: _PrefixEntry):
-        del self._prefix[ent.key]
-        self.allocator.deref(ent.block)
-        if ent.parent_block is not None:
-            self.allocator.deref(ent.parent_block)
+        self.pool._drop_entry(ent)
 
     def _evict_one_prefix(self) -> bool:
-        """Drop the least-recently-used cache-only prefix entry.
-
-        Only entries whose block sits at refcount 1 (held solely by the
-        cache) are evictable; entries a live request still references
-        are skipped. A chain parent carries a pin from each cached
-        child, so eviction proceeds leaf-first regardless of LRU order.
-        """
-        for key in list(self._prefix):
-            ent = self._prefix[key]
-            if self.allocator.refcount[ent.block] == 1:
-                self._drop_entry(ent)
-                return True
-        return False
+        return self.pool._evict_one_prefix()
 
     def _match_prefix(self, prompt: Sequence[int]) -> List[_PrefixEntry]:
         """Longest chain of cached full blocks covering the prompt."""
@@ -505,12 +665,124 @@ class BlockKVCache:
     def flush_prefix_cache(self):
         """Drop every cached prefix ref (tests / memory pressure).
         Live requests keep their own refs; only cache refs drop."""
-        for key in list(self._prefix):
-            self._drop_entry(self._prefix[key])
+        self.pool.flush_prefix_cache()
 
     @property
     def prefix_entries(self) -> int:
         return len(self._prefix)
+
+    def match_prefix_blocks(self, prompt: Sequence[int]) -> int:
+        """How many full leading blocks of ``prompt`` this pool's
+        prefix cache already holds — a read-only probe (no LRU touch,
+        no refs) for prefix-affinity routing verification."""
+        return len(self._match_prefix(prompt))
+
+    # -- cross-cache handoff (disaggregated prefill/decode) ----------
+
+    def export_row(self, row: int) -> Dict[str, object]:
+        """Detach a row for handoff: the returned record *owns* the
+        row's block references (no deref happens here — ownership
+        transfers from the row to the record), and the row itself is
+        freed for the next admission. The record must eventually be
+        passed to :meth:`import_row`/:meth:`adopt_row` on the
+        destination cache, or its refs dropped via
+        ``record["pool"].release_blocks(record["blocks"])`` — else
+        ``leaked()`` rightly reports the blocks as lost."""
+        n = int(self._nblocks[row])
+        rec = {
+            "blocks": [int(b) for b in self.tables[row, :n]],
+            "length": int(self.lengths[row]),
+            "pool": self.pool,
+        }
+        self.tables[row] = self.TRASH
+        self._nblocks[row] = 0
+        self.lengths[row] = 0
+        insort(self._free_rows, row)
+        return rec
+
+    def import_row(self, rec: Dict[str, object]) -> Optional[int]:
+        """Adopt an exported record whose blocks live in *this* pool:
+        a pure host-side table splice — zero ref changes, the record's
+        ownership moves to the new row. Returns the row, or None when
+        no row is free (the record keeps its refs; retry later)."""
+        if rec["pool"] is not self.pool:
+            raise ValueError(
+                "import_row requires a record from the same BlockPool; "
+                "use adopt_row for cross-pool handoff")
+        blocks = rec["blocks"]
+        if len(blocks) > self.blocks_per_row:
+            raise ValueError(
+                f"record spans {len(blocks)} blocks > blocks_per_row="
+                f"{self.blocks_per_row}")
+        if not self._free_rows:
+            return None
+        row = self._free_rows.pop(0)
+        self.tables[row] = self.TRASH
+        self.tables[row, :len(blocks)] = blocks
+        self._nblocks[row] = len(blocks)
+        self.lengths[row] = int(rec["length"])
+        return row
+
+    def adopt_row(self, rec: Dict[str, object]) -> Optional[int]:
+        """Adopt an exported record from a *different* pool: allocate
+        fresh blocks here (all-or-nothing) and copy the committed
+        blocks' contents across. Returns the row, or None when rows or
+        blocks run out (the record keeps its source refs; retry or
+        abort). On success the caller still owns the source refs and
+        must drop them via ``rec["pool"].release_blocks(...)``."""
+        src_pool: BlockPool = rec["pool"]  # type: ignore[assignment]
+        if src_pool is self.pool:
+            raise ValueError(
+                "adopt_row is for cross-pool handoff; use import_row "
+                "when the record already lives in this pool")
+        if src_pool.num_layers != self.pool.num_layers or \
+                src_pool.num_heads != self.pool.num_heads or \
+                src_pool.head_dim != self.pool.head_dim or \
+                src_pool.block_size != self.pool.block_size or \
+                src_pool.kv_dtype != self.pool.kv_dtype:
+            raise ValueError("cannot adopt blocks across pools with "
+                             "different geometry or kv_dtype")
+        blocks = [int(b) for b in rec["blocks"]]  # type: ignore[union-attr]
+        length = int(rec["length"])  # type: ignore[arg-type]
+        if len(blocks) > self.blocks_per_row:
+            raise ValueError(
+                f"record spans {len(blocks)} blocks > blocks_per_row="
+                f"{self.blocks_per_row}")
+        if not self._free_rows:
+            return None
+        taken: List[int] = []
+        for _ in blocks:
+            blk = self._alloc_block()
+            if blk is None:
+                for b in taken:
+                    self.allocator.deref(b)
+                return None
+            taken.append(blk)
+        if taken and self.kv_dtype == "int8":
+            # same stale-scale hazard as acquire(): zero the reclaimed
+            # blocks' scales first, then the copy below overwrites the
+            # committed ones with the source's real scales
+            idx = np.asarray(taken, np.int32)
+            self.layers = [
+                (k, v, ks.at[idx].set(0.0), vs.at[idx].set(0.0))
+                for k, v, ks, vs in self.layers]
+        # only blocks holding committed KV carry data worth moving;
+        # trailing reservation blocks are uninitialized by contract
+        ncommit = min(len(blocks), self.blocks_needed(length))
+        if ncommit:
+            src_idx = np.asarray(blocks[:ncommit], np.int32)
+            dst_idx = np.asarray(taken[:ncommit], np.int32)
+            self.layers = [
+                tuple(a.at[dst_idx].set(sa[src_idx])
+                      for a, sa in zip(layer, src_layer))
+                for layer, src_layer in zip(self.layers, src_pool.layers)]
+        row = self._free_rows.pop(0)
+        self.blocks_allocated_total += len(taken)
+        self.tables[row] = self.TRASH
+        self.tables[row, :len(taken)] = taken
+        self._nblocks[row] = len(taken)
+        self.lengths[row] = length
+        return row
 
     # -- per-step bookkeeping (same contract as SlotKVCache) ---------
 

@@ -241,7 +241,7 @@ class ReplicaRouter:
             g.set(0)
 
     def _route_attempt(self, prompt, max_new_tokens, eos_token_id,
-                       priority) -> Request:
+                       priority, _log_request=True) -> Request:
         kind = fault_point("serving.route")
         if kind == "skip":
             _monitor.stat_add("STAT_serving_route_shed")
@@ -258,10 +258,18 @@ class ReplicaRouter:
         last_err: Optional[QueueFullError] = None
         for i in order:
             eng = self.engines[i]
+            if getattr(eng, "draining", False):
+                # a draining replica sheds everything it's offered;
+                # skipping it here is what re-routes the request to a
+                # peer with capacity instead of dropping it
+                last_err = QueueFullError(
+                    f"replica {i} is draining", reason="drain")
+                continue
             try:
                 req = eng.submit(prompt, max_new_tokens=max_new_tokens,
                                  eos_token_id=eos_token_id,
-                                 priority=priority)
+                                 priority=priority,
+                                 _log_request=_log_request)
             except QueueFullError as e:
                 last_err = e
                 continue
@@ -278,7 +286,8 @@ class ReplicaRouter:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
-               priority: Optional[int] = None) -> Request:
+               priority: Optional[int] = None,
+               _log_request: bool = True) -> Request:
         """Route one request to the least-loaded replica; returns its
         :class:`Request` handle. ``priority`` passes through to the
         chosen engine's admission. Raises :class:`QueueFullError` when
@@ -292,7 +301,7 @@ class ReplicaRouter:
         try:
             return RetryPolicy.from_flags("serving.route").call(
                 self._route_attempt, prompt, max_new_tokens,
-                eos_token_id, priority)
+                eos_token_id, priority, _log_request)
         except RetryError as e:
             _monitor.stat_add("STAT_serving_route_shed")
             raise QueueFullError(
@@ -389,6 +398,59 @@ class ReplicaRouter:
         _runlog.log_event("serving_drain_done", shed=shed)
         return shed
 
+    def _rehome_queued(self, src: ServingEngine,
+                       peers: Sequence[ServingEngine]) -> int:
+        """Move ``src``'s still-queued requests onto the least-loaded
+        live peers via ``adopt_request``; requests no peer can take are
+        shed (reason="drain") through ``src`` so the accounting
+        identity holds. Returns how many were re-homed."""
+        moved = 0
+        for req in src.take_queued():
+            placed = False
+            for peer in sorted(
+                    (p for p in peers
+                     if not getattr(p, "draining", False)),
+                    key=lambda p: (self._depth(p),
+                                   -self._blocks_free(p))):
+                if peer.adopt_request(req):
+                    placed = True
+                    moved += 1
+                    _monitor.stat_add("STAT_serving_rerouted")
+                    break
+            if not placed:
+                src._shed(req, QueueFullError(
+                    "no live replica could adopt the request during "
+                    "drain", reason="drain"), reason="drain")
+        return moved
+
+    def drain_replica(self, index: int) -> int:
+        """Drain ONE replica out of the set (targeted scale-down /
+        maintenance): it stops receiving routes and submissions, its
+        queued-but-unadmitted requests are re-routed onto live peers
+        with capacity (shed reason="drain" only when no peer can take
+        them), and it moves to the retiring list where it keeps
+        stepping until its in-flight work finishes. Returns how many
+        queued requests were re-homed."""
+        with self._lock:
+            if not 0 <= index < len(self.engines):
+                raise IndexError(
+                    f"replica index {index} out of range "
+                    f"(have {len(self.engines)})")
+            if len(self.engines) == 1:
+                raise ValueError(
+                    "cannot drain the last replica; use drain() for "
+                    "full shutdown")
+            eng = self.engines.pop(index)
+            eng.draining = True
+            self._retiring.append(eng)
+        moved = self._rehome_queued(eng, self.engines)
+        self._replicas_gauge.set(len(self.engines))
+        self._update_depth_gauges()
+        _runlog.log_event("serving_drain_replica", replica=index,
+                          rerouted=moved,
+                          replicas_left=len(self.engines))
+        return moved
+
     def swap_weights(self, state, *, reset_costs: bool = True
                      ) -> List[int]:
         """Rolling weight hot-swap across the fleet: every replica —
@@ -410,9 +472,13 @@ class ReplicaRouter:
         if reqs is not None:
             out = list(reqs)
         else:
-            out = sorted((r for eng in self.engines + self._retiring
-                          for r in eng.results()), key=lambda r: r.id)
-            return out
+            # a re-homed request lives in both the drained source's and
+            # the adopting peer's book-keeping — dedupe by request id
+            seen: dict = {}
+            for eng in self.engines + self._retiring:
+                for r in eng.results():
+                    seen.setdefault(r.id, r)
+            return sorted(seen.values(), key=lambda r: r.id)
         for r in out:
             if not r.wait(timeout):
                 raise TimeoutError(

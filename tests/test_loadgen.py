@@ -120,6 +120,53 @@ def test_slo_admission_beats_depth_only_on_goodput(model):
         (base["goodput_per_s"], aware["goodput_per_s"])
 
 
+# ----------------------------------------------------- trace round-trip
+def test_from_trace_replays_own_schedule():
+    """LoadGen.from_trace on a generator's own canonical trace yields
+    the identical arrival schedule (the --replay fast path)."""
+    import json
+    a = LoadGen(mode="diurnal", seed=21, **_LG_KW)
+    b = LoadGen.from_trace(json.loads(a.trace_bytes()))
+    assert b.schedule() == a.schedule()
+    assert b.trace_bytes() == a.trace_bytes()
+
+
+def test_trace_convert_roundtrip_replays_decisions(model, tmp_path):
+    """The incident-replay loop: a run's serving_request runlog events
+    -> tools/trace_convert -> LoadGen.from_trace reproduces the
+    workload — every offered request present with its prompt, budget,
+    and priority — and a replay on a fresh engine (virtual clock,
+    pinned costs) makes the identical admit/shed decisions."""
+    import glob
+    from tools.trace_convert import events_to_trace, load_events
+
+    saved = pt.get_flags(["runlog_dir"])
+    pt.set_flags({"runlog_dir": str(tmp_path)})
+    try:
+        vc = VirtualClock()
+        lg = LoadGen(mode="bursty", seed=17, **_LG_KW)
+        rep1 = lg.run(_engine(model, vc.now), clock=vc,
+                      step_cost_ms=4.0)
+    finally:
+        pt.set_flags(saved)
+
+    files = glob.glob(str(tmp_path / "runlog-*.jsonl*"))
+    trace = events_to_trace(load_events(files))
+    sched = lg.schedule()
+    assert len(trace["arrivals"]) == rep1["offered"] == len(sched)
+    assert [a[1:] for a in trace["arrivals"]] == \
+        [[list(s.prompt), s.max_new_tokens, s.priority] for s in sched]
+
+    lg2 = LoadGen.from_trace(trace)
+    vc2 = VirtualClock()
+    rep2 = lg2.run(_engine(model, vc2.now), clock=vc2,
+                   step_cost_ms=4.0)
+    assert rep2["offered"] == rep1["offered"]
+    assert rep2["decisions"] == rep1["decisions"]
+    assert rep2["shed"] == rep1["shed"]
+    assert rep2["leaked_kv_blocks"] == 0
+
+
 # ------------------------------------------------------------ elasticity
 def test_autoscale_up_under_pressure_then_down(model):
     """Queue pressure grows the fleet inside the policy bounds; calm

@@ -169,6 +169,47 @@ def test_router_prebuilt_engines_roundtrip(model):
         assert r.output_ids == ref
 
 
+def test_router_drain_replica_rehomes_queued_requests(model):
+    """Targeted scale-down: draining one replica re-routes its queued
+    requests onto live peers instead of shedding them — the regression
+    where a draining replica silently dropped its queue. Every request
+    finishes, and results() lists each re-homed request exactly once."""
+    monitor.reset()
+    rt = _router(model)
+    prompts = _prompts((3, 6, 4, 7), seed=20)
+    reqs = [rt.engines[0].submit(p, max_new_tokens=3)
+            for p in prompts]               # all queued on replica 0
+    moved = rt.drain_replica(0)
+    assert moved == len(prompts)
+    assert monitor.stat_get("STAT_serving_rerouted") == len(prompts)
+    assert len(rt.engines) == 1
+    rt.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    for p, r in zip(prompts, reqs):
+        ref = greedy_search(model, np.asarray([p]), max_new_tokens=3,
+                            cache_len=32)[0].tolist()
+        assert r.output_ids == ref
+    ids = [r.id for r in rt.results()]
+    assert len(ids) == len(set(ids)) == len(prompts)
+    with pytest.raises(ValueError):         # can't drain the last one
+        rt.drain_replica(0)
+    with pytest.raises(IndexError):
+        rt.drain_replica(5)
+
+
+def test_router_submit_skips_draining_replica(model):
+    """A replica marked draining must not attract routes even when it
+    is the least loaded — and must not rack up shed counters from
+    submissions it was never eligible for."""
+    rt = _router(model)
+    rt.engines[0].draining = True           # emptiest, but off-limits
+    r = rt.submit(_prompts((4,), seed=21)[0], max_new_tokens=2)
+    assert r in rt.engines[1]._all
+    assert len(rt.engines[0]._all) == 0
+    rt.engines[0].draining = False
+    rt.run_until_idle()
+
+
 # ---------------------------------------------------------------------------
 # chaos: the serving.route fault site
 # ---------------------------------------------------------------------------

@@ -23,7 +23,12 @@
 #      plus the mesh-serving gate: tensor-parallel pjit steps
 #      (FLAGS_serving_mesh) and the data-parallel ReplicaRouter
 #      (FLAGS_serving_replicas) token-identical to greedy with the
-#      step-compile budget shared across replicas
+#      step-compile budget shared across replicas; plus the
+#      disaggregated-serving gate: a prefill/decode role-split fleet
+#      (FLAGS_serving_disagg, KV block handoff + prefix-affinity
+#      routing) token-identical to the symmetric router at zero extra
+#      compiles, with the chaos kill-prefill-worker path leaking
+#      nothing
 #   7. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
@@ -35,7 +40,9 @@
 #   9. loadgen SLO gate (seeded open-loop traffic through the
 #      SLO-admitting gpt2-tiny engine: goodput > 0 with attainment
 #      reported and zero leaked KV blocks, then the chaos crossover —
-#      submit/alloc faults injected, degradation must stay graceful)
+#      submit/alloc faults injected, degradation must stay graceful —
+#      then the same traffic through a --disagg 1x2 fleet: goodput
+#      still > 0, handoffs actually happened, still zero leaks)
 #  10. op coverage gate (>= 80% of the reference forward-op surface)
 #  11. API-freeze check (public signature snapshot diff)
 #  12. multi-chip dry-run (GSPMD train step on N virtual devices)
@@ -108,6 +115,11 @@ if [[ "${1:-}" != "quick" ]]; then
   # AND on a real (1,2) head-split over the virtual devices; N router
   # replicas share one model and compile each step exactly once
   python -m pytest tests/test_serving_mesh.py tests/test_serving_router.py -q
+  echo "   disaggregated prefill/decode gate (handoff + prefix affinity)"
+  # role-split fleet token-identical to the symmetric ReplicaRouter at
+  # zero extra compiles; affinity routing beats least-loaded on shared
+  # prefixes; killing a prefill worker mid-handoff leaks nothing
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serving_disagg.py -q
 else
   echo "== 6/14 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
@@ -120,6 +132,11 @@ or paged_engine_matches or dense_engine_still or prefix_reuse"
     -q -m "not slow" \
     -k "matches_sequential_greedy or unified_cache or share_compiled \
 or head_sharded or drain or chaos_skip"
+  echo "   disaggregated prefill/decode gate: reduced subset (quick mode)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serving_disagg.py \
+    -q -m "not slow" \
+    -k "matches_symmetric or zero_compiles or backpressure \
+or flag_parsing"
 fi
 
 echo "== 7/14 speculative decoding gate"
@@ -180,6 +197,23 @@ assert r['exceptions'] == 0, r
 assert r['shed'].get('fault', 0) >= 1, r
 print(f\"   chaos: goodput {r['goodput_per_s']}/s, \"
       f\"{r['shed_total']} shed ({r['shed']}), 0 leaks\")
+"
+echo "   disagg fleet (1 prefill x 2 decode, prefix-affinity routing)"
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode bursty --rate "$LG_RATE" --duration "$LG_DURATION" --seed 0 \
+  --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16 \
+  --new-tokens 2:8 --slo-ttft-ms 2000 --disagg 1x2 --json \
+  --expect-goodput-min 0.5 --expect-zero-leaks \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+assert r['exceptions'] == 0, r
+d = r['disagg']
+assert d['prefill_workers'] == 1 and d['decode_workers'] == 2, d
+assert d['handoffs_adopted'] >= 1, d
+print(f\"   disagg: goodput {r['goodput_per_s']}/s, \"
+      f\"{d['handoffs_adopted']} handoffs \"
+      f\"({d['affinity_hits']} affinity hits), 0 leaks\")
 "
 
 echo "== 10/14 op coverage gate"

@@ -157,6 +157,41 @@ class LoadGen:
         self.diurnal_amplitude = float(diurnal_amplitude)
         self._schedule: Optional[List[Arrival]] = None
 
+    @classmethod
+    def from_trace(cls, trace) -> "LoadGen":
+        """Build a generator that replays a recorded trace instead of
+        sampling one: ``trace`` is a path or a dict shaped like
+        ``tools/trace_convert.py`` output (or ``trace_bytes()``) —
+        ``{"arrivals": [[t, prompt, max_new_tokens, priority], ...]}``
+        plus optional ``mode``/``rate``/``duration``/``seed`` metadata
+        (nested under ``"meta"`` or top-level). The schedule is
+        installed verbatim, so ``run()`` re-fights the recorded
+        workload deterministically."""
+        if isinstance(trace, (str, os.PathLike)):
+            with open(trace) as f:
+                trace = json.load(f)
+        meta = dict(trace.get("meta") or {})
+        for k in ("mode", "rate", "duration", "seed"):
+            if k not in meta and k in trace:
+                meta[k] = trace[k]
+        arrivals = [Arrival(float(t), tuple(int(x) for x in prompt),
+                            int(mnt), int(pri))
+                    for t, prompt, mnt, pri in trace["arrivals"]]
+        last_t = max((a.t for a in arrivals), default=0.0)
+        duration = float(meta.get("duration") or 0.0)
+        if duration <= last_t:
+            duration = last_t + 1e-6 if arrivals else 1.0
+        rate = float(meta.get("rate") or 0.0)
+        if rate <= 0:
+            rate = max(len(arrivals) / duration, 1e-9)
+        mode = meta.get("mode", "poisson")
+        if mode not in cls.MODES:   # replayed traces keep MODES closed
+            mode = "poisson"
+        lg = cls(mode=mode, rate=rate, duration=duration,
+                 seed=int(meta.get("seed", 0)))
+        lg._schedule = arrivals
+        return lg
+
     # ---------------------------------------------------------- schedule
     def _burst_segments(self, rng) -> List[Tuple[float, float]]:
         """Alternating (start_time, rate) segments covering the
@@ -363,10 +398,15 @@ class LoadGen:
             decisions.append([rec["outcome"], rec.get("reason")])
 
         leaked = 0
+        seen_allocs = set()   # co-located disagg roles share one pool
         for eng in self._engines(target):
             if getattr(eng, "paged", False):
+                alloc = eng.cache.allocator
+                if id(alloc) in seen_allocs:
+                    continue
+                seen_allocs.add(id(alloc))
                 eng.cache.flush_prefix_cache()
-                leaked += max(0, eng.cache.allocator.leaked() - 1)
+                leaked += max(0, alloc.leaked() - 1)
 
         def pct(vals, q):
             return (round(float(np.percentile(vals, q)), 3)
@@ -403,6 +443,14 @@ class LoadGen:
             "leaked_kv_blocks": leaked,
             "decisions": decisions,
         }
+        stats = getattr(target, "stats", None)
+        st = stats() if callable(stats) else {}
+        if "prefill_workers" in st:
+            report["disagg"] = {k: st[k] for k in (
+                "prefill_workers", "decode_workers", "colocated",
+                "handoffs_adopted", "handoffs_copied", "prefix_affinity",
+                "affinity_hits", "affinity_misses",
+                "fleet_prefix_hit_rate")}
         if include_trace:
             report["trace"] = records
         return report
@@ -421,8 +469,12 @@ def warmup(target, max_new_tokens: int = 2):
                           eng.spec_tokens))
         for _ in range(50):   # ride out injected submit faults
             try:
+                # warmup traffic stays out of the runlog so replayable
+                # traces (tools/trace_convert.py) carry only the
+                # measured workload
                 target.submit([1] * plen,
-                              max_new_tokens=max_new_tokens)
+                              max_new_tokens=max_new_tokens,
+                              _log_request=False)
                 break
             except QueueFullError:
                 target.run_until_idle()
@@ -487,6 +539,17 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--autoscale", default="", metavar="MIN:MAX",
                     help="enable router autoscaling inside the bounds")
+    ap.add_argument("--disagg", default="", metavar="PxD",
+                    help="run a disaggregated fleet of P prefill-only "
+                    "+ D decode-only workers behind a DisaggRouter "
+                    "instead of symmetric replicas (e.g. '1x2')")
+    ap.add_argument("--no-prefix-affinity", action="store_true",
+                    help="with --disagg: route least-loaded instead of "
+                    "to the worker holding the longest cached prefix")
+    ap.add_argument("--replay", default="", metavar="TRACE.json",
+                    help="replay a recorded arrival trace (from "
+                    "tools/trace_convert.py or a prior --trace file) "
+                    "instead of sampling a schedule")
     ap.add_argument("--virtual-step-ms", type=float, default=0.0,
                     help="> 0 runs on a virtual clock advancing this "
                     "much per step (fully deterministic replay)")
@@ -524,12 +587,15 @@ def main(argv=None) -> int:
     pt.seed(0)
     model = GPTForCausalLM(cfg)
     model.eval()
-    lg = LoadGen(mode=args.mode, rate=args.rate,
-                 duration=args.duration, seed=args.seed,
-                 vocab_size=cfg.vocab_size,
-                 prompt_tokens=args.prompt_tokens,
-                 new_tokens=args.new_tokens,
-                 priority_mix=args.priority_mix)
+    if args.replay:
+        lg = LoadGen.from_trace(args.replay)
+    else:
+        lg = LoadGen(mode=args.mode, rate=args.rate,
+                     duration=args.duration, seed=args.seed,
+                     vocab_size=cfg.vocab_size,
+                     prompt_tokens=args.prompt_tokens,
+                     new_tokens=args.new_tokens,
+                     priority_mix=args.priority_mix)
     vc = (VirtualClock() if args.virtual_step_ms > 0 else None)
     eng_kwargs = dict(
         max_slots=args.slots, max_len=args.max_len,
@@ -542,7 +608,15 @@ def main(argv=None) -> int:
         eng_kwargs["clock"] = vc.now
     with ctx:
         bounds = _parse_autoscale(args.autoscale)
-        if args.replicas > 1 or bounds is not None:
+        if args.disagg:
+            from paddle_tpu import flags as _fl
+            from paddle_tpu.serving import DisaggRouter
+            _fl.set_flags({
+                "serving_disagg": args.disagg,
+                "serving_prefix_affinity":
+                    not args.no_prefix_affinity})
+            target = DisaggRouter(model=model, **eng_kwargs)
+        elif args.replicas > 1 or bounds is not None:
             target = ReplicaRouter(
                 model=model, n_replicas=args.replicas,
                 autoscale=(None if bounds is None else AutoscalePolicy(

@@ -25,6 +25,11 @@ Asserts, end to end through the observability plane:
     completes with goodput > 0, zero leaked KV blocks and ZERO new
     compiles — and the recompile predictor agrees the admission
     parameters are no-ops;
+  - the same workload through a 1 prefill x 2 decode DisaggRouter
+    fleet stays token-identical with ZERO new compiles (role-split
+    engines share the symmetric engines' step cache), scores a
+    prefix-affinity routing hit on the repeated prompt, leaks no KV
+    blocks, and matches the predictor's ``disagg`` no-op claim;
   - a live weight hot-swap (``swap_weights``) into the still-warm
     loadgen engine adds zero compiles, decodes the new weights'
     greedy tokens, and matches the predictor's ``weight_swaps``
@@ -292,6 +297,53 @@ def main() -> int:
           f"{report['slo_attainment']}, shed {report['shed_total']}), "
           f"0 new compiles")
 
+    # -- disagg phase: P/D role split adds ZERO compiles --------------
+    # (Before the hot-swap phase: swap_weights mutates the shared
+    # model in place, so the old-weight reference outputs only hold
+    # until then.) The same workload through a 1 prefill x 2 decode
+    # DisaggRouter at the same geometry: both roles reuse the
+    # symmetric engines' compiled steps (the step cache keys on
+    # geometry, never role), the KV handoff is host-side block
+    # surgery, and re-submitting prompts[2] scores a prefix-affinity
+    # routing hit. Token-identical, tracker frozen, predictor agrees
+    # disagg is a no-op, zero leaks.
+    from paddle_tpu.serving import DisaggRouter
+    fleet = DisaggRouter(model, n_prefill=1, n_decode=2, max_slots=3,
+                         max_len=32, buckets=[8, 16], max_queue=16,
+                         block_size=4)
+    reqs7 = [fleet.submit(p, max_new_tokens=4) for p in prompts]
+    fleet.run_until_idle()
+    rep7 = fleet.submit(prompts[2], max_new_tokens=4)
+    fleet.run_until_idle()
+    for a, b in zip(reqs + [rep], reqs7 + [rep7]):
+        assert a.output_ids == b.output_ids, (
+            f"disagg fleet diverged on request {b.id}: "
+            f"{a.output_ids} vs {b.output_ids}")
+    st7 = fleet.stats()
+    assert st7["prefill_workers"] == 1 and st7["decode_workers"] == 2
+    assert st7["handoffs_adopted"] >= len(prompts), st7
+    assert st7["affinity_hits"] >= 1, st7
+    comp7 = observability.compiles()
+    observed7 = {site: c["count"] for site, c in comp7.items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+    assert observed7 == observed5, (
+        f"disaggregated roles must add ZERO compiles:\n"
+        f"  before {observed5}\n  after  {observed7}")
+    disagg_pred = predict_serving_compiles(
+        workload, buckets=[8, 16], max_len=32, block_size=4,
+        disagg=(1, 2))
+    assert disagg_pred == predicted, (disagg_pred, predicted)
+    pools = {}
+    for e in fleet.engines:
+        pools[id(e.cache.pool)] = e.cache
+    for cache in pools.values():
+        cache.flush_prefix_cache()
+        assert cache.allocator.leaked() == 1   # trash block only
+    print(f"   disagg: 1x2 fleet token-identical, "
+          f"{st7['handoffs_adopted']} handoffs "
+          f"({st7['affinity_hits']} affinity hits), 0 new compiles, "
+          f"0 leaked blocks")
+
     # -- hot-swap phase: live weight swap adds ZERO compiles ----------
     # Publish fresh weights into the still-warm loadgen engine: the
     # compiled steps take weights as explicit jit inputs, so the
@@ -311,9 +363,9 @@ def main() -> int:
     comp6 = observability.compiles()
     observed6 = {site: c["count"] for site, c in comp6.items()
                  if site.startswith(("serving_", "decode_", "verify_"))}
-    assert observed6 == observed5, (
+    assert observed6 == observed7, (
         f"live weight swap must add ZERO compiles:\n"
-        f"  before {observed5}\n  after  {observed6}")
+        f"  before {observed7}\n  after  {observed6}")
     ref_swap = greedy_search(swap_model, np.asarray([p_swap]),
                              max_new_tokens=4,
                              cache_len=32)[0].tolist()
@@ -346,7 +398,10 @@ def main() -> int:
                    "STAT_serving_kv_quant_writes", "serving_mesh_devices",
                    "serving_replicas", "serving_queue_depth",
                    "serving_slo_attainment", "serving_shed_total",
-                   "serving_weight_version"):
+                   "serving_weight_version",
+                   "serving_prefix_affinity_hits",
+                   "serving_handoff_queue_depth",
+                   "serving_disagg_workers"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 
@@ -358,7 +413,8 @@ def main() -> int:
         for line in f:
             kinds.add(json.loads(line)["kind"])
     for k in ("train_step", "guardian_skip", "fault_injected",
-              "serving_admit", "serving_finish", "serving_weight_swap"):
+              "serving_admit", "serving_finish", "serving_weight_swap",
+              "serving_request", "serving_handoff"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])

@@ -400,6 +400,45 @@ def render_serving_block():
         "at equal offered load and the graceful-degradation contract",
         "under injected faults.",
         "",
+        "Prefill and decode can also split into dedicated roles.",
+        "`FLAGS_serving_disagg=PxD` (or `serving.DisaggRouter`) runs a",
+        "disaggregated fleet: P prefill workers admit and prefill,",
+        "then hand each request off through a bounded queue",
+        "(`FLAGS_serving_handoff_queue`; a full queue backpressures",
+        "admission instead of buffering unboundedly) to D decode",
+        "workers as an ownership-transfer record — the request, its",
+        "first token, and its physical KV blocks. Co-located roles",
+        "share one block pool, so adoption is a zero-copy ref-count",
+        "splice of the exported block table; cross-pool adoption is an",
+        "all-or-nothing block copy that releases the source blocks",
+        "only once every destination block is committed. Routing is",
+        "prefix-affine (`FLAGS_serving_prefix_affinity`): a fleet-wide",
+        "rolling-hash index over published prefix chains steers each",
+        "prompt to the prefill worker already holding its longest",
+        "cached prefix (verified against the worker's live pool before",
+        "use, so stale entries can't misroute), falling back to least-",
+        "loaded. The split adds ZERO compiles — both roles reuse the",
+        "per-model step cache, which keys on geometry, never role —",
+        "an invariant `predict_serving_compiles(disagg=...)` encodes",
+        "and CI asserts, alongside the token-identity oracle against",
+        "the symmetric `ReplicaRouter` (prefix affinity on and off,",
+        "speculative K>0, int8 KV). `router.stats()` reports handoff",
+        "and affinity counters plus the fleet prefix hit rate;",
+        "`GET /metrics` grows `serving_disagg_workers`,",
+        "`serving_handoff_queue_depth` and",
+        "`serving_prefix_affinity_hits`; the run log records",
+        "`serving_handoff` events, and `serving_request` arrival",
+        "events feed `tools/trace_convert.py`, which turns any run log",
+        "into a replayable trace for `tools/loadgen.py --replay` —",
+        "re-run production arrivals against a different topology,",
+        "byte-identical. Chaos is first-class: the `serving.handoff`",
+        "fault site sheds or retries cleanly, and",
+        "`kill_prefill_worker()` re-homes queued work, purges the dead",
+        "worker's affinity entries and sheds in-flight handoffs with",
+        "zero leaked blocks. `BENCH_MODEL=loadgen` compares the fleet",
+        "against a symmetric router at equal worker count (TTFT p95 +",
+        "goodput; the win is gated on real TPU hardware).",
+        "",
         "Flags:",
         "",
     ]

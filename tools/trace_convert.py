@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Convert a run log into a replayable loadgen trace.
+
+Every serving front door (``ServingEngine.submit``, ``ReplicaRouter``,
+``DisaggRouter``) logs one ``serving_request`` event per arrival —
+``t`` (engine-clock seconds), ``prompt``, ``max_new_tokens``,
+``priority``. This tool filters those events out of a runlog JSONL
+file (``FLAGS_runlog_dir/runlog-<pid>.jsonl``), re-bases time so the
+first arrival lands at t=0, and emits the trace format
+``tools/loadgen.py --replay`` / ``LoadGen.from_trace`` consume::
+
+    {"meta": {"source": ..., "duration": ..., "rate": ...},
+     "arrivals": [[t, prompt, max_new_tokens, priority], ...]}
+
+So a production incident captured in the run log replays — same
+prompts, same spacing — against any engine/fleet configuration::
+
+    python tools/trace_convert.py /tmp/runlog/runlog-1234.jsonl \
+        -o incident.json
+    python tools/loadgen.py --replay incident.json --disagg 1x2 \
+        --virtual-step-ms 5 --json
+
+Rotated siblings (``.jsonl.1``) can be passed alongside the active
+file; events merge and sort by (t, seq) regardless of file order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional
+
+
+def events_to_trace(events: Iterable[dict],
+                    source: Optional[str] = None,
+                    engine: Optional[str] = None) -> Dict:
+    """Build a replayable trace from parsed runlog event dicts.
+
+    Keeps only ``kind == "serving_request"`` events (optionally those
+    whose ``engine``/``router`` label equals ``engine``), sorts by
+    (t, seq) so interleaved producers land in arrival order, and
+    re-bases ``t`` to the first kept arrival.
+    """
+    kept = []
+    for ev in events:
+        if ev.get("kind") != "serving_request":
+            continue
+        if engine is not None and \
+                ev.get("engine", ev.get("router")) != engine:
+            continue
+        kept.append(ev)
+    kept.sort(key=lambda ev: (float(ev["t"]), int(ev.get("seq", 0))))
+    t0 = float(kept[0]["t"]) if kept else 0.0
+    arrivals: List[list] = []
+    for ev in kept:
+        arrivals.append([round(float(ev["t"]) - t0, 6),
+                         [int(x) for x in ev["prompt"]],
+                         int(ev["max_new_tokens"]),
+                         int(ev.get("priority", 1))])
+    duration = arrivals[-1][0] if arrivals else 0.0
+    meta: Dict = {"events": len(arrivals), "duration": duration}
+    if duration > 0:
+        meta["rate"] = round(len(arrivals) / duration, 6)
+    if source:
+        meta["source"] = source
+    if engine:
+        meta["engine"] = engine
+    return {"meta": meta, "arrivals": arrivals}
+
+
+def load_events(paths: Iterable[str]) -> List[dict]:
+    """Parse runlog JSONL files; blank and truncated trailing lines
+    (a live writer mid-append) are skipped, not fatal."""
+    events: List[dict] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="turn runlog serving_request events into a "
+                    "replayable loadgen trace")
+    ap.add_argument("runlog", nargs="+",
+                    help="runlog JSONL file(s); rotated .1 siblings "
+                    "merge in sorted by time")
+    ap.add_argument("-o", "--out", default="",
+                    help="write the trace JSON here (default stdout)")
+    ap.add_argument("--engine", default=None,
+                    help="keep only events from this engine/router "
+                    "label")
+    args = ap.parse_args(argv)
+
+    trace = events_to_trace(load_events(args.runlog),
+                            source=",".join(args.runlog),
+                            engine=args.engine)
+    if not trace["arrivals"]:
+        print("no serving_request events found", file=sys.stderr)
+        return 1
+    payload = json.dumps(trace, separators=(",", ":"))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+        print(f"{trace['meta']['events']} arrivals over "
+              f"{trace['meta']['duration']:.3f}s -> {args.out}")
+    else:
+        print(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
