@@ -131,7 +131,9 @@ def predict_serving_compiles(
         priority_classes: Optional[Sequence[int]] = None,
         autoscale: Optional[Tuple[int, int]] = None,
         weight_swaps: int = 0,
-        disagg: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
+        disagg: Optional[Tuple[int, int]] = None,
+        sampling: Optional[Sequence[Tuple[float, int, float]]] = None,
+        lora: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -206,6 +208,29 @@ def predict_serving_compiles(
     since affinity concentrates shared prefixes the way one shared
     cache would). Splitting P+D workers therefore adds zero compiles
     over a symmetric fleet.
+
+    ``sampling`` (the distinct per-request ``(temperature, top_k,
+    top_p)`` recipes a workload carries — ``FLAGS`` have no say here,
+    sampling is per-request data) is a validated no-op for the same
+    reason the SLO family is: the compiled steps take one fixed-shape
+    per-slot ``samp`` tuple (temperatures, top-k/top-p cutoffs, RNG
+    keys, additive mask rows) as a plain jit input, so a batch mixing
+    greedy, sampled, and grammar-masked rows traces NOTHING beyond the
+    all-greedy baseline — sampling-as-data, never compile keys. JSON-
+    constrained rows ride the same mask input; stop sequences are
+    host-side suffix checks. Ten thousand distinct recipes predict the
+    same counts as none.
+
+    ``lora`` (``(rank, max_adapters)``, ``FLAGS_serving_lora_rank`` /
+    ``_max_adapters``: the paged multi-tenant adapter pool) behaves
+    like ``mesh_shape``: the pool geometry joins the step cache key —
+    an engine built with a pool compiles its steps once under the new
+    key (a separate phase to merge when you enable it mid-run) — but
+    within a phase it's a validated no-op: per-row adapter pages are
+    gathered *inside* the step from one more fixed-shape input, so
+    adapter loads, evictions and any per-tenant traffic mix trace
+    nothing. Requires ``paged=True`` (the pool reuses the block
+    allocator's discipline).
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -257,6 +282,22 @@ def predict_serving_compiles(
             raise ValueError(
                 "disagg requires paged=True (the prefill->decode KV "
                 "handoff is a block-table splice)")
+    if sampling is not None:
+        from ..serving.decoding import DecodeParams
+        for rec in sampling:
+            t, k, p = rec
+            DecodeParams(temperature=float(t), top_k=int(k),
+                         top_p=float(p))   # range-validates, else raises
+    if lora is not None:
+        rank, max_adapters = (int(n) for n in lora)
+        if rank < 1 or max_adapters < 1:
+            raise ValueError(
+                f"lora must be (rank >= 1, max_adapters >= 1), got "
+                f"{lora!r}")
+        if not paged:
+            raise ValueError(
+                "lora requires paged=True (the adapter pool is paged "
+                "like the KV cache)")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

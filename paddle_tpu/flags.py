@@ -358,6 +358,24 @@ define_flag("serving_handoff_queue", 16,
             "A full queue backpressures prefill workers (they stop "
             "admitting) instead of buffering unbounded finished "
             "prefills whose KV blocks are pinned until adoption.")
+define_flag("serving_lora_rank", 0,
+            "Multi-tenant paged LoRA: rank of the per-tenant low-rank "
+            "adapters (serving/lora.py LoRAPool). > 0 builds the "
+            "engine with an adapter pool whose stacked factors are "
+            "plain inputs to the compiled steps — per-row adapter "
+            "pages are gathered inside the step (the BlockKVCache "
+            "block-table trick applied to weights), so base and "
+            "per-tenant rows mix in one batch of one executable and "
+            "loading/evicting adapters never recompiles. 0 disables "
+            "(no pool, no lora step input). Requires the paged KV "
+            "cache. Constructor state read once, like the SLO knobs.")
+define_flag("serving_lora_max_adapters", 4,
+            "Multi-tenant paged LoRA: adapter pages in the pool "
+            "(tenants resident at once; +1 all-zero base page is "
+            "added internally). A load into a full pool raises until "
+            "an adapter is evicted; eviction refuses while in-flight "
+            "requests still pin the page (the KV-block refcount "
+            "discipline applied to weights).")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

@@ -144,12 +144,18 @@ def decode_step(model):
     """The per-model compiled decode step for fixed-capacity caches.
 
     Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn`` maps
-    ``(tokens [b] i32, pos [b] i32, caches [(k, v) arrays])`` to
-    ``(next_tokens [b] i32, last_logits [b, V], new_caches)``: it writes
-    each row's token at that row's cache offset, attends under the
-    position mask, and returns the greedy argmax plus the raw logits
-    (for sampling/beam callers). ``traces["count"]`` increments once per
-    XLA trace — the compile-count==1 contract is asserted in tests.
+    ``(tokens [b] i32, pos [b] i32, caches [(k, v) arrays], samp)`` to
+    ``(next_tokens [b] i32, last_logits [b, V], new_caches,
+    new_keys [b, 2] u32)``: it writes each row's token at that row's
+    cache offset, attends under the position mask, and picks each row's
+    next token through the shared ``serving.decoding`` sampler. ``samp``
+    is the per-row sampling-as-data tuple ``(temperature, top_k, top_p,
+    keys, mask)`` — plain fixed-shape inputs, never compile keys, so
+    greedy, sampled and mask-constrained rows share this one executable
+    in the same batch (``decoding.neutral_samp`` rows reproduce the
+    pre-sampling argmax bit-for-bit). ``traces["count"]`` increments
+    once per XLA trace — the compile-count==1 contract is asserted in
+    tests.
 
     Cached in the unified :func:`step_entry` cache, keyed by the
     flag-plane version so a ``set_flags`` retraces (same contract as
@@ -158,9 +164,10 @@ def decode_step(model):
     ``swap_weights`` takes effect without a retrace.
     """
     from ..observability import compile_tracker as _ct
+    from ..serving.decoding import sample_tokens
 
     def _build():
-        def _step(params, tokens, pos, caches):
+        def _step(params, tokens, pos, caches, samp):
             with no_grad(), _borrowed_params(model, params):
                 tcaches = [(Tensor(k, stop_gradient=True),
                             Tensor(v, stop_gradient=True))
@@ -168,8 +175,9 @@ def decode_step(model):
                 logits, newc = model(_t(tokens[:, None]), cache=tcaches,
                                      cache_pos=pos)
             lg = logits.value[:, -1]
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return nxt, lg, [(c[0].value, c[1].value) for c in newc]
+            nxt, new_keys = sample_tokens(lg, samp)
+            return (nxt, lg, [(c[0].value, c[1].value) for c in newc],
+                    new_keys)
 
         fn = _inject_params(model, _ct.tracked_jit("decode_step", _step))
         return {"fn": fn, "traces": fn.traces}
@@ -187,12 +195,17 @@ def verify_step(model, spec_tokens: int):
     (the one a plain decode step would feed), ``tokens[:, 1:]`` the K
     draft tokens proposed for the positions after it. One forward
     scatter-writes all K+1 rows at ``pos..pos+K`` and scores them
-    under the causal position mask, so ``next_tokens[:, i]`` is the
-    model's true greedy continuation after consuming ``tokens[:, :i+1]``
-    — valid exactly while the drafts match, which is the acceptance
-    test the caller runs on the host. The rejected tail's cache rows
-    are garbage past the accepted prefix; the caller rolls the slot's
-    write offset back and the position mask hides them.
+    under the causal position mask; ``decoding.verify_tokens`` then
+    turns the K+1 per-position logits into ``(chosen, accept)``:
+    greedy rows keep the old prefix match (``chosen = argmax``,
+    ``accept = argmax == draft``, token-identical), sampled rows run
+    rejection sampling so every emitted token is an exact draw from
+    the non-speculative sampled distribution. Entries past a row's
+    first rejection are garbage by construction; the caller commits
+    the accepted prefix on the host, rolls the slot's write offset
+    back, and the position mask hides the stale cache rows. Returns
+    ``(chosen [b, K+1] i32, logits [b, K+1, V], new_caches,
+    accept [b, K] bool, new_keys [b, 2] u32)``.
 
     Compiled once per (model, K) — the fixed K+1 query width is what
     keeps speculative serving on a single XLA executable. Cached in the
@@ -203,7 +216,9 @@ def verify_step(model, spec_tokens: int):
         raise ValueError(f"verify_step needs spec_tokens >= 1, got {k}")
 
     def _build():
-        def _step(params, tokens, pos, caches):
+        from ..serving.decoding import verify_tokens
+
+        def _step(params, tokens, pos, caches, samp):
             with no_grad(), _borrowed_params(model, params):
                 tcaches = [(Tensor(kk, stop_gradient=True),
                             Tensor(vv, stop_gradient=True))
@@ -211,8 +226,9 @@ def verify_step(model, spec_tokens: int):
                 logits, newc = model(_t(tokens), cache=tcaches,
                                      cache_pos=pos)
             lg = logits.value                            # [b, K+1, V]
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return nxt, lg, [(c[0].value, c[1].value) for c in newc]
+            nxt, accept, new_keys = verify_tokens(lg, tokens[:, 1:], samp)
+            return (nxt, lg, [(c[0].value, c[1].value) for c in newc],
+                    accept, new_keys)
 
         from ..observability import compile_tracker as _ct
         fn = _inject_params(
@@ -248,51 +264,77 @@ def _unwrap_pools(newp):
     return pools, qerr
 
 
-def decode_step_paged(model, mesh=None, kv_dtype: str = "f32"):
+def decode_step_paged(model, mesh=None, kv_dtype: str = "f32",
+                      lora_shape=None):
     """The block-paged sibling of :func:`decode_step`.
 
     Returns ``{"fn": jitted, "traces": {"count": n}}`` where ``fn``
     maps ``(tokens [b] i32, pos [b] i32, tables [b, T] i32, pools
-    [per-layer block arrays])`` to ``(next_tokens [b] i32, last_logits
-    [b, V], new_pools, max_qerr)``. Identical semantics to
-    ``decode_step`` — each row's token is written at its own offset,
-    now routed through the row's block table into the shared
-    [num_blocks, h, block_size, d] pools — with the same compile-once
-    contract: pools AND tables are fixed-shape jit inputs, so block
-    remapping (admission, prefix sharing, COW) never retraces. Pools
-    are (k, v) pairs or int8 (k, v, k_scale, v_scale) 4-tuples;
-    ``max_qerr`` is the int8 path's max-abs dequantization error over
-    the rows written this step (0.0 for float pools).
+    [per-layer block arrays], samp)`` to ``(next_tokens [b] i32,
+    last_logits [b, V], new_pools, max_qerr, new_keys [b, 2] u32)``.
+    Identical semantics to ``decode_step`` — each row's token is
+    written at its own offset, now routed through the row's block
+    table into the shared [num_blocks, h, block_size, d] pools — with
+    the same compile-once contract: pools, tables AND the per-row
+    ``samp`` sampling tuple are fixed-shape jit inputs, so block
+    remapping (admission, prefix sharing, COW) and per-request
+    decoding recipes never retrace. Pools are (k, v) pairs or int8
+    (k, v, k_scale, v_scale) 4-tuples; ``max_qerr`` is the int8
+    path's max-abs dequantization error over the rows written this
+    step (0.0 for float pools).
+
+    With ``lora_shape`` = (rank, pages) the step gains one more input:
+    ``lora = (page_ids [b] i32, pool_arrays)`` from a
+    ``serving.lora.LoRAPool`` — per-row adapter pages gathered inside
+    the step (the block-table trick applied to weights). The lora
+    geometry joins the cache key (pool shapes depend on it, exactly
+    like ``kv_dtype``), but page remapping, loads and evictions are
+    pure data: zero retraces.
 
     With ``mesh`` (a ``("data", "model")`` serving mesh) the step runs
     under pjit with explicit in/out shardings: pools keep their heads
-    axis on ``"model"``, tokens/positions/tables stay replicated plain
-    inputs. ``kv_dtype`` only matters under a mesh (it picks the pool
-    tuple width for the sharding pytree); the mesh geometry is part of
-    the cache key so each mesh compiles exactly once.
+    axis on ``"model"``, tokens/positions/tables/samp (and lora pages)
+    stay replicated plain inputs. ``kv_dtype`` only matters under a
+    mesh (it picks the pool tuple width for the sharding pytree); the
+    mesh geometry is part of the cache key so each mesh compiles
+    exactly once.
     """
     from ..distributed.sharding import mesh_cache_key
     from ..observability import compile_tracker as _ct
+    from ..serving.decoding import sample_tokens
     mkey = mesh_cache_key(mesh)
 
     def _build():
-        def _step(params, tokens, pos, tables, pools):
+        def _impl(params, tokens, pos, tables, pools, samp, lora):
             with no_grad(), _borrowed_params(model, params):
                 logits, newp = model(_t(tokens[:, None]),
                                      cache=_wrap_pools(pools),
-                                     cache_pos=pos, block_tables=tables)
+                                     cache_pos=pos, block_tables=tables,
+                                     lora=lora)
             lg = logits.value[:, -1]
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            nxt, new_keys = sample_tokens(lg, samp)
             pools_out, qerr = _unwrap_pools(newp)
-            return nxt, lg, pools_out, qerr
+            return nxt, lg, pools_out, qerr, new_keys
+
+        if lora_shape is None:
+            def _step(params, tokens, pos, tables, pools, samp):
+                return _impl(params, tokens, pos, tables, pools, samp,
+                             None)
+        else:
+            def _step(params, tokens, pos, tables, pools, samp, lora):
+                return _impl(params, tokens, pos, tables, pools, samp,
+                             lora)
 
         jit_kwargs = {}
         if mesh is not None:
             repl, pools_sh = _mesh_step_shardings(model, mesh, kv_dtype)
+            in_sh = (_mesh_param_shardings(model, mesh),
+                     repl, repl, repl, pools_sh, repl)
+            if lora_shape is not None:
+                in_sh = in_sh + (repl,)
             jit_kwargs = dict(
-                in_shardings=(_mesh_param_shardings(model, mesh),
-                              repl, repl, repl, pools_sh),
-                out_shardings=(repl, repl, pools_sh, repl))
+                in_shardings=in_sh,
+                out_shardings=(repl, repl, pools_sh, repl, repl))
         fn = _inject_params(
             model, _ct.tracked_jit("decode_step_paged", _step,
                                    **jit_kwargs))
@@ -300,24 +342,31 @@ def decode_step_paged(model, mesh=None, kv_dtype: str = "f32"):
 
     key = (("decode_paged",) if mkey is None
            else ("decode_paged", mkey, kv_dtype))
+    if lora_shape is not None:
+        key = key + ("lora", tuple(lora_shape))
     return step_entry(model, key, _build)
 
 
 def verify_step_paged(model, spec_tokens: int, mesh=None,
-                      kv_dtype: str = "f32"):
+                      kv_dtype: str = "f32", lora_shape=None):
     """The block-paged sibling of :func:`verify_step`: one fixed-shape
     forward scores the last committed token plus K drafts
-    (``tokens [b, K+1]``) through per-row block tables. Same row
-    layout, acceptance semantics, and rollback contract as the dense
-    verify step — rejected rows are stale pool contents past the
-    row's valid length, hidden by the position mask (blocks stay
-    reserved, so rollback across a block boundary is pure host-side
-    length arithmetic). Compiled once per (model, K, mesh). Returns
-    shaped like :func:`decode_step_paged`: ``(next [b, K+1] i32,
-    logits [b, K+1, V], new_pools, max_qerr)``. ``mesh`` / ``kv_dtype``
+    (``tokens [b, K+1]``) through per-row block tables, then
+    ``decoding.verify_tokens`` picks ``(chosen, accept)`` per row —
+    greedy prefix match on temp==0 rows (token-identical to the old
+    argmax verify), rejection sampling on sampled rows. Same row
+    layout and rollback contract as the dense verify step — rejected
+    rows are stale pool contents past the row's valid length, hidden
+    by the position mask (blocks stay reserved, so rollback across a
+    block boundary is pure host-side length arithmetic). Compiled
+    once per (model, K, mesh[, lora geometry]). Returns shaped like
+    :func:`decode_step_paged`: ``(chosen [b, K+1] i32, logits
+    [b, K+1, V], new_pools, max_qerr, accept [b, K] bool,
+    new_keys [b, 2] u32)``. ``mesh`` / ``kv_dtype`` / ``lora_shape``
     behave exactly as in :func:`decode_step_paged`.
     """
     from ..distributed.sharding import mesh_cache_key
+    from ..serving.decoding import verify_tokens
     k = int(spec_tokens)
     if k < 1:
         raise ValueError(
@@ -325,23 +374,36 @@ def verify_step_paged(model, spec_tokens: int, mesh=None,
     mkey = mesh_cache_key(mesh)
 
     def _build():
-        def _step(params, tokens, pos, tables, pools):
+        def _impl(params, tokens, pos, tables, pools, samp, lora):
             with no_grad(), _borrowed_params(model, params):
                 logits, newp = model(_t(tokens), cache=_wrap_pools(pools),
-                                     cache_pos=pos, block_tables=tables)
+                                     cache_pos=pos, block_tables=tables,
+                                     lora=lora)
             lg = logits.value                            # [b, K+1, V]
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            nxt, accept, new_keys = verify_tokens(lg, tokens[:, 1:], samp)
             pools_out, qerr = _unwrap_pools(newp)
-            return nxt, lg, pools_out, qerr
+            return nxt, lg, pools_out, qerr, accept, new_keys
+
+        if lora_shape is None:
+            def _step(params, tokens, pos, tables, pools, samp):
+                return _impl(params, tokens, pos, tables, pools, samp,
+                             None)
+        else:
+            def _step(params, tokens, pos, tables, pools, samp, lora):
+                return _impl(params, tokens, pos, tables, pools, samp,
+                             lora)
 
         from ..observability import compile_tracker as _ct
         jit_kwargs = {}
         if mesh is not None:
             repl, pools_sh = _mesh_step_shardings(model, mesh, kv_dtype)
+            in_sh = (_mesh_param_shardings(model, mesh),
+                     repl, repl, repl, pools_sh, repl)
+            if lora_shape is not None:
+                in_sh = in_sh + (repl,)
             jit_kwargs = dict(
-                in_shardings=(_mesh_param_shardings(model, mesh),
-                              repl, repl, repl, pools_sh),
-                out_shardings=(repl, repl, pools_sh, repl))
+                in_shardings=in_sh,
+                out_shardings=(repl, repl, pools_sh, repl, repl, repl))
         fn = _inject_params(
             model, _ct.tracked_jit("verify_step_paged", _step,
                                    labels={"k": str(k)}, **jit_kwargs))
@@ -349,6 +411,8 @@ def verify_step_paged(model, spec_tokens: int, mesh=None,
 
     key = (("verify_paged", k) if mkey is None
            else ("verify_paged", k, mkey, kv_dtype))
+    if lora_shape is not None:
+        key = key + ("lora", tuple(lora_shape))
     return step_entry(model, key, _build)
 
 
@@ -415,6 +479,8 @@ def greedy_search(model, input_ids, max_new_tokens: int = 16,
             f"{max_new_tokens}")
     logits, arrays = _prefill(model, ids, cap)
     step = decode_step(model)["fn"]
+    from ..serving.decoding import neutral_samp
+    samp = neutral_samp(b, int(logits.shape[-1]))
     out = [ids]
     done = np.zeros(b, bool)
     cur = np.asarray(jnp.argmax(logits, -1)).reshape(b, 1)
@@ -428,8 +494,8 @@ def greedy_search(model, input_ids, max_new_tokens: int = 16,
             break
         if t == max_new_tokens - 1:
             break
-        nxt, _, arrays = step(jnp.asarray(cur[:, 0], jnp.int32), pos,
-                              arrays)
+        nxt, _, arrays, _ = step(jnp.asarray(cur[:, 0], jnp.int32), pos,
+                                 arrays, samp)
         pos = pos + 1
         cur = np.asarray(nxt).reshape(b, 1)
     return np.concatenate(out, axis=1)
@@ -437,10 +503,18 @@ def greedy_search(model, input_ids, max_new_tokens: int = 16,
 
 @no_grad()
 def sample(model, input_ids, max_new_tokens: int = 16,
-           temperature: float = 1.0, top_k: int = 0, seed: int = 0,
-           cache_len: Optional[int] = None):
-    """Temperature / top-k sampling decode (fixed-capacity cache; the
-    same compiled step as greedy — sampling happens on its logits)."""
+           temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
+           seed: int = 0, cache_len: Optional[int] = None):
+    """Temperature / top-k / top-p sampling decode (fixed-capacity
+    cache; the same compiled step as greedy — the per-row ``samp``
+    tuple carries the params as data, so offline ``sample()`` and the
+    serving engine share one source of sampling math:
+    :func:`paddle_tpu.serving.decoding.sample_tokens`)."""
+    from ..serving.decoding import DecodeParams, sample_tokens
+    # Validate eagerly with the shared param object.
+    params = DecodeParams(temperature=float(temperature),
+                          top_k=int(top_k), top_p=float(top_p),
+                          seed=int(seed))
     ids = np.asarray(input_ids)
     b, s0 = ids.shape
     cap = int(cache_len if cache_len is not None
@@ -451,22 +525,28 @@ def sample(model, input_ids, max_new_tokens: int = 16,
             f"{max_new_tokens}")
     lg, arrays = _prefill(model, ids, cap)
     step = decode_step(model)["fn"]
-    rng = jax.random.PRNGKey(seed)
+    vocab = int(lg.shape[-1])
+    temp = jnp.full((b,), params.temperature, jnp.float32)
+    tk = jnp.full((b,), params.top_k, jnp.int32)
+    tp = jnp.full((b,), params.top_p, jnp.float32)
+    mask = jnp.zeros((b, vocab), jnp.float32)
+    keys = jnp.asarray(
+        jax.random.split(jax.random.PRNGKey(params.seed), b), jnp.uint32)
+    # First token: sample the prefill logits with the same primitive
+    # the jitted step uses.
+    nxt, keys = sample_tokens(lg, (temp, tk, tp, keys, mask))
+    cur = np.asarray(nxt).reshape(b, 1)
     out = [ids]
     pos = jnp.full((b,), s0, jnp.int32)
     for t in range(max_new_tokens):
-        lg = lg / max(temperature, 1e-6)
-        if top_k > 0:
-            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-            lg = jnp.where(lg < kth, jnp.finfo(lg.dtype).min, lg)
-        rng, sub = jax.random.split(rng)
-        cur = np.asarray(jax.random.categorical(sub, lg)).reshape(b, 1)
         out.append(cur.astype(ids.dtype))
         if t == max_new_tokens - 1:
             break
-        _, lg, arrays = step(jnp.asarray(cur[:, 0], jnp.int32), pos,
-                             arrays)
+        nxt, _, arrays, keys = step(
+            jnp.asarray(cur[:, 0], jnp.int32), pos, arrays,
+            (temp, tk, tp, keys, mask))
         pos = pos + 1
+        cur = np.asarray(nxt).reshape(b, 1)
     return np.concatenate(out, axis=1)
 
 
@@ -495,6 +575,8 @@ def beam_search(model, input_ids, beam_size: int = 4,
 
     logits, arrays = _prefill(model, ids, cap)
     step = decode_step(model)["fn"]
+    from ..serving.decoding import neutral_samp
+    samp = neutral_samp(b * k, int(logits.shape[-1]))
     lp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
     vocab = lp.shape[-1]
     # seed beams with the top-k first tokens
@@ -509,8 +591,8 @@ def beam_search(model, input_ids, beam_size: int = 4,
     pos = jnp.full((b * k,), s0, jnp.int32)
 
     for t in range(1, max_new_tokens):
-        _, lg, arrays = step(jnp.asarray(tokens[:, 0], jnp.int32), pos,
-                             arrays)
+        _, lg, arrays, _ = step(jnp.asarray(tokens[:, 0], jnp.int32),
+                                pos, arrays, samp)
         pos = pos + 1
         lg = np.asarray(lg)                                 # [b*k, V]
         lg = lg - lg.max(-1, keepdims=True)

@@ -95,6 +95,20 @@ GPT_CONFIGS = {
 }
 
 
+def _lora_delta(x, ids, A, B):
+    """Per-row paged-LoRA delta: gather each batch row's adapter page
+    from the stacked pool factors (``A [pages, din, r]`` / ``B [pages,
+    r, dout]``) and apply ``x @ A_page @ B_page`` — two thin matmuls
+    (rank << hidden). ``ids`` is the per-row int32 page vector; page 0
+    is the all-zero base page, so base rows add an exact zero and mix
+    freely with adapter rows in one compiled step.  Inference-only by
+    construction (the delta bypasses the tape)."""
+    Ag = jnp.take(A, ids, axis=0)                 # [b, din, r]
+    Bg = jnp.take(B, ids, axis=0)                 # [b, r, dout]
+    d = jnp.einsum("bsi,bir->bsr", x, Ag)
+    return jnp.einsum("bsr,bro->bso", d, Bg)
+
+
 class GPTAttention(Layer):
     """Causal self-attention: fused qkv projection (one [h, 3h] matmul on
     the MXU) + the differentiable fused attention op."""
@@ -112,13 +126,28 @@ class GPTAttention(Layer):
                                weight_attr=wo)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x, cache=None, cache_pos=None, block_tables=None):
+    def forward(self, x, cache=None, cache_pos=None, block_tables=None,
+                lora=None):
         cfg = self.cfg
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)
+        if lora is not None:
+            # lora = (page_ids [b] i32, Aq, Bq, Ao, Bo) — this layer's
+            # slice of the paged adapter pool, a plain jit input
+            qkv = qkv + Tensor(
+                _lora_delta(x.value, lora[0], lora[1], lora[2]),
+                stop_gradient=True)
         qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
         qkv = qkv.transpose([2, 0, 3, 1, 4])  # [3, b, h, s, d]
         q, k, v = qkv[0], qkv[1], qkv[2]
+
+        def _out(o):
+            y = self.out_proj(o)
+            if lora is not None:
+                y = y + Tensor(
+                    _lora_delta(o.value, lora[0], lora[3], lora[4]),
+                    stop_gradient=True)
+            return self.dropout(y)
         if block_tables is not None:
             # block-paged KV cache: `cache` is a (k, v) pool pair of
             # [num_blocks, h, block_size, d] blocks shared by every
@@ -194,7 +223,7 @@ class GPTAttention(Layer):
                              {"causal": False})["Out"][0]
             out = out.transpose([0, 2, 1, 3]).reshape(
                 [b, s, cfg.hidden_size])
-            return self.dropout(self.out_proj(out)), cache
+            return _out(out), cache
         if cache is not None and cache_pos is not None:
             # fixed-capacity (slotted) KV cache: `cache` is a
             # preallocated [b, h, max_len, d] pair and the new keys are
@@ -222,7 +251,7 @@ class GPTAttention(Layer):
                          {"causal": False})["Out"][0]
             out = out.transpose([0, 2, 1, 3]).reshape(
                 [b, s, cfg.hidden_size])
-            return self.dropout(self.out_proj(out)), cache
+            return _out(out), cache
         if cache is not None:
             k = run_op("concat", {"X": [cache[0], k]}, {"axis": 2})["Out"][0]
             v = run_op("concat", {"X": [cache[1], v]}, {"axis": 2})["Out"][0]
@@ -231,7 +260,7 @@ class GPTAttention(Layer):
                      {"Q": [q], "K": [k], "V": [v]},
                      {"causal": True})["Out"][0]
         out = out.transpose([0, 2, 1, 3]).reshape([b, s, cfg.hidden_size])
-        out = self.dropout(self.out_proj(out))
+        out = _out(out)
         return out if cache is None else (out, cache)
 
 
@@ -250,15 +279,33 @@ class GPTBlock(Layer):
                           weight_attr=wo)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x, cache=None, cache_pos=None, block_tables=None):
+    def forward(self, x, cache=None, cache_pos=None, block_tables=None,
+                lora=None):
+        # lora = (page_ids [b] i32, this layer's 8 pool factors
+        # (Aq, Bq, Ao, Bo, A1, B1, A2, B2)); attn consumes the first
+        # four, the MLP pair the rest
+        attn_lora = None
+        if lora is not None:
+            ids, arrs = lora
+            attn_lora = (ids,) + tuple(arrs[:4])
         if cache is None:
-            x = x + self.attn(self.ln1(x))
+            x = x + self.attn(self.ln1(x), lora=attn_lora)
         else:
             a, cache = self.attn(self.ln1(x), cache, cache_pos=cache_pos,
-                                 block_tables=block_tables)
+                                 block_tables=block_tables,
+                                 lora=attn_lora)
             x = x + a
-        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)),
-                                             approximate=True)))
+        h = self.ln2(x)
+        f = self.fc1(h)
+        if lora is not None:
+            f = f + Tensor(_lora_delta(h.value, ids, arrs[4], arrs[5]),
+                           stop_gradient=True)
+        g = F.gelu(f, approximate=True)
+        o = self.fc2(g)
+        if lora is not None:
+            o = o + Tensor(_lora_delta(g.value, ids, arrs[6], arrs[7]),
+                           stop_gradient=True)
+        x = x + self.dropout(o)
         return x if cache is None else (x, cache)
 
 
@@ -279,8 +326,12 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, cache=None, position_offset=0,
-                cache_pos=None, block_tables=None):
+                cache_pos=None, block_tables=None, lora=None):
         s = input_ids.shape[1]
+        if lora is not None:
+            # (page_ids [b] i32, 8-tuple of stacked [layers, pages, ..]
+            # pool factors) — each block slices its own layer below
+            lora = (jnp.asarray(lora[0], jnp.int32), tuple(lora[1]))
         if cache_pos is not None:
             # fixed-capacity cache mode: positions come from each row's
             # cache write offset (int, or a [b] vector for slotted
@@ -333,7 +384,9 @@ class GPTModel(Layer):
                     x = blk(x)
             else:
                 x, c = blk(x, cache[i], cache_pos=cache_pos,
-                           block_tables=block_tables)
+                           block_tables=block_tables,
+                           lora=None if lora is None else
+                           (lora[0], tuple(a[i] for a in lora[1])))
                 new_caches.append(c)
         x = self.ln_f(x)
         return x if cache is None else (x, new_caches)
@@ -384,7 +437,8 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(cfg)
 
     def forward(self, input_ids, labels=None, cache=None,
-                position_offset=0, cache_pos=None, block_tables=None):
+                position_offset=0, cache_pos=None, block_tables=None,
+                lora=None):
         if cache is None:
             # forward the offset: chunked-prefill callers without a cache
             # must get real positions (and the out-of-range guard)
@@ -392,7 +446,7 @@ class GPTForCausalLM(Layer):
         else:
             h, cache = self.gpt(input_ids, cache, position_offset,
                                 cache_pos=cache_pos,
-                                block_tables=block_tables)
+                                block_tables=block_tables, lora=lora)
         # tied LM head: h @ wte.T
         logits = run_op("matmul_v2",
                         {"X": [h], "Y": [self.gpt.wte.weight]},

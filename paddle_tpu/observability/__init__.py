@@ -94,6 +94,12 @@ INSTRUMENT_DOCS = {
         "counters — paged admissions that reused >=1 prefix-cached KV "
         "block vs prefilled from scratch (token-granular rates in "
         "ServingEngine.stats())",
+    "serving_lora_adapters_loaded{engine=...}":
+        "gauge — tenant LoRA adapters currently resident in an "
+        "engine's paged adapter pool (page 0 = base never counts)",
+    "STAT_serving_lora_loads / _evictions":
+        "counters — adapter pool writes: load_adapter / evict_adapter "
+        "calls that landed (both zero-recompile by construction)",
     "STAT_serving_*":
         "counters — admission/token/shed/speculative accounting from "
         "the serving engine (see the Serving section)",
@@ -135,9 +141,15 @@ EVENT_DOCS = {
                            "reset_costs) — the train→serve publish "
                            "step; zero new compiles by construction",
     "serving_request": "one arrival at the serving front door (t, "
-                       "prompt, max_new_tokens, priority) — the "
-                       "replayable record tools/trace_convert.py "
-                       "turns into a loadgen trace",
+                       "prompt, max_new_tokens, priority; + "
+                       "temperature/top_k/top_p/seed/stop/json_mode/"
+                       "tenant when non-default) — the replayable "
+                       "record tools/trace_convert.py turns into a "
+                       "loadgen trace",
+    "serving_lora_load": "tenant LoRA adapter pool write (engine, "
+                         "adapter, page; evicted=true marks an "
+                         "eviction) — data-not-constants, zero new "
+                         "compiles like serving_weight_swap",
     "serving_handoff": "disaggregated KV handoff (stage=export: a "
                        "prefill worker emitted the record; "
                        "stage=adopt: a decode worker spliced/copied "

@@ -40,17 +40,35 @@ holding its longest cached prefix, so hit rates compound across the
 fleet instead of fragmenting per replica. Same compiled steps, zero
 extra XLA compiles, token-identical output.
 
+Decoding is per-request *data* (decoding.py): every request carries a
+:class:`DecodeParams` (temperature / top-k / top-p / stop sequences /
+seed / json_mode) that the compiled steps consume as one fixed-shape
+per-slot ``samp`` input — greedy, sampled, and JSON-grammar-constrained
+rows mix freely in one batch of one executable, temp==0 rows stay
+byte-identical to the pre-sampling engine, and speculative decoding
+verifies sampled rows by rejection sampling. Multi-tenant LoRA
+(lora.py) applies the block-table trick to weights: a paged
+:class:`LoRAPool` of per-tenant low-rank factors rides the steps as
+one more plain input, per-row adapter pages are gathered inside the
+step, and loading/evicting adapters at runtime is a functional pool
+write — zero new compiles for all of it
+(``FLAGS_serving_lora_rank`` / ``FLAGS_serving_lora_max_adapters``).
+
 See engine.py for the scheduler, kv_cache.py for the memory managers,
-router.py for the symmetric replica front end, disagg.py for the
-disaggregated fleet, http.py for the JSON front end.
+decoding.py for sampling-as-data + the JSON grammar, lora.py for the
+paged adapter pool, router.py for the symmetric replica front end,
+disagg.py for the disaggregated fleet, http.py for the JSON front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine
+from .decoding import (DecodeParams, JsonGrammar, json_token_strings,
+                       neutral_samp, request_key)
 from .disagg import (DecodeEngine, DisaggRouter, HandoffQueue,
                      PrefillEngine)
 from .http import ServingHTTPServer
 from .kv_cache import (BlockAllocator, BlockKVCache, BlockPool,
                        SlotKVCache, prefix_chain_keys)
+from .lora import LoRAPool, make_adapter
 from .router import AutoscalePolicy, ReplicaRouter
 
 __all__ = ["ServingEngine", "Request", "QueueFullError",
@@ -58,4 +76,7 @@ __all__ = ["ServingEngine", "Request", "QueueFullError",
            "BlockPool", "prefix_chain_keys",
            "ServingHTTPServer", "ReplicaRouter", "AutoscalePolicy",
            "DisaggRouter", "PrefillEngine", "DecodeEngine",
-           "HandoffQueue"]
+           "HandoffQueue",
+           "DecodeParams", "JsonGrammar", "json_token_strings",
+           "neutral_samp", "request_key",
+           "LoRAPool", "make_adapter"]

@@ -205,6 +205,11 @@ class PrefillEngine(ServingEngine):
             req = self._active.pop(row)
             rec = self.cache.export_row(row)
             req.slot = None          # in flight between roles
+            if req._lora_held:
+                # the adapter pin is engine-local (page ids never
+                # travel); the decode side re-acquires by tenant name
+                self.lora_pool.release(req.tenant)
+                req._lora_held = False
             self._pending.append(_Handoff(req, rec, self))
             staged += 1
             if _runlog.enabled():
@@ -316,6 +321,16 @@ class DecodeEngine(ServingEngine):
                 if row is None:      # no space: keep refs, retry later
                     self._handoff.put_back(item)
                     break
+                if item.req.tenant and self.lora_pool is not None:
+                    # re-pin the tenant's page in THIS engine's pool
+                    # (an adapter evicted mid-handoff sheds here)
+                    try:
+                        self.lora_pool.acquire(item.req.tenant)
+                        item.req._lora_held = True
+                    except ValueError as e:
+                        self.cache.release_row(row)
+                        self._shed(item.req, _Shed(str(e)))
+                        continue
                 item.req.slot = row
                 self._active[row] = item.req
                 self.adopted += 1
@@ -393,6 +408,25 @@ class DisaggRouter:
                     else g["serving_handoff_queue"])
         self._handoff = HandoffQueue(bound)
         self._model = model
+        if "lora_pool" not in engine_kwargs:
+            # one shared adapter pool for the whole fleet: the prefill
+            # side releases its pin on export, the decode side
+            # re-acquires by tenant name on adoption — page ids never
+            # cross the role boundary, pool pages do (they're the same
+            # arrays object)
+            gl = _flags.get_flags(["serving_lora_rank",
+                                   "serving_lora_max_adapters"])
+            rank = engine_kwargs.get("lora_rank")
+            rank = int(rank if rank is not None
+                       else gl["serving_lora_rank"])
+            if rank > 0:
+                from .lora import LoRAPool
+                mx = engine_kwargs.get("lora_max_adapters")
+                engine_kwargs = dict(engine_kwargs)
+                engine_kwargs["lora_pool"] = LoRAPool(
+                    model.gpt.cfg, rank,
+                    int(mx if mx is not None
+                        else gl["serving_lora_max_adapters"]))
         self.prefills: List[PrefillEngine] = [
             PrefillEngine(model, self._handoff, **engine_kwargs)
             for _ in range(n_prefill)]
@@ -496,7 +530,7 @@ class DisaggRouter:
             self._affinity.popitem(last=False)
 
     def _route_attempt(self, prompt, max_new_tokens, eos_token_id,
-                       priority) -> Request:
+                       priority, **decode_kwargs) -> Request:
         kind = fault_point("serving.route")
         if kind == "skip":
             _monitor.stat_add("STAT_serving_route_shed")
@@ -522,7 +556,8 @@ class DisaggRouter:
             try:
                 req = eng.submit(prompt, max_new_tokens=max_new_tokens,
                                  eos_token_id=eos_token_id,
-                                 priority=priority, _log_request=False)
+                                 priority=priority, _log_request=False,
+                                 **decode_kwargs)
             except QueueFullError as e:
                 last_err = e
                 continue
@@ -542,11 +577,16 @@ class DisaggRouter:
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                priority: Optional[int] = None,
-               _log_request: bool = True) -> Request:
+               _log_request: bool = True, **decode_kwargs) -> Request:
         """Route one request to a prefill worker — prefix-affine when
         the fleet index knows the prompt's prefix, least-loaded
         otherwise. Decode capacity is reached through the handoff
-        queue, never directly."""
+        queue, never directly. Per-request decoding fields
+        (``temperature``/``top_k``/``top_p``/``stop``/``seed``/
+        ``json_mode``/``tenant``) pass through to the prefill engine
+        and travel with the handoff — the RNG key, grammar cursor and
+        tenant name live on the Request, so a sampled or constrained
+        stream continues bit-exactly across the role boundary."""
         with self._lock:
             if self._draining:
                 raise QueueFullError("router is draining: submissions "
@@ -554,6 +594,15 @@ class DisaggRouter:
                                      reason="drain")
         if _log_request and _runlog.enabled():
             prompt = [int(t) for t in prompt]
+            extra = {}
+            for k in ("temperature", "top_k", "top_p", "seed",
+                      "json_mode", "tenant"):
+                v = decode_kwargs.get(k)
+                if v:
+                    extra[k] = v
+            if decode_kwargs.get("stop"):
+                extra["stop"] = [list(s)
+                                 for s in decode_kwargs["stop"]]
             _runlog.log_event(
                 "serving_request",
                 t=round(self.prefills[0]._clock(), 6), prompt=prompt,
@@ -561,15 +610,47 @@ class DisaggRouter:
                     max_new_tokens if max_new_tokens is not None
                     else self.prefills[0].default_max_new_tokens),
                 priority=int(priority if priority is not None else 1),
-                router=self._rid)
+                router=self._rid, **extra)
         try:
             return RetryPolicy.from_flags("serving.route").call(
                 self._route_attempt, prompt, max_new_tokens,
-                eos_token_id, priority)
+                eos_token_id, priority, **decode_kwargs)
         except RetryError as e:
             _monitor.stat_add("STAT_serving_route_shed")
             raise QueueFullError(
                 f"routing retries exhausted: {e}", reason="fault") from e
+
+    # ----------------------------------------------------- LoRA adapters
+    def load_adapter(self, name: str, state) -> int:
+        """Load a tenant adapter once per distinct pool (the default
+        fleet shares one). Returns the page id on the last pool."""
+        pools: list = []
+        page = None
+        for eng in self.engines:
+            if eng.lora_pool is None:
+                raise ValueError(
+                    "fleet has no LoRA pool; construct with "
+                    "lora_rank > 0 (FLAGS_serving_lora_rank)")
+            if any(eng.lora_pool is p for p in pools):
+                continue
+            pools.append(eng.lora_pool)
+            page = eng.load_adapter(name, state)
+        return page
+
+    def evict_adapter(self, name: str) -> int:
+        """Evict a tenant adapter from every distinct pool; refuses
+        (ValueError) while in-flight work anywhere pins it."""
+        pools: list = []
+        page = None
+        for eng in self.engines:
+            if eng.lora_pool is None or \
+                    any(eng.lora_pool is p for p in pools):
+                continue
+            pools.append(eng.lora_pool)
+            page = eng.evict_adapter(name)
+        if page is None:
+            raise ValueError("fleet has no LoRA pool")
+        return page
 
     # ---------------------------------------------------------- stepping
     def step(self) -> bool:
@@ -767,7 +848,15 @@ class DisaggRouter:
         misses = sum(p.prefix_misses for p in pools.values())
         adopted = sum(d.adopted for d in self.decodes)
         copies = sum(d.adopted_copies for d in self.decodes)
-        return {
+        tenants: dict = {}
+        for e in engines:
+            with e._lock:
+                for name, (c, el, m) in e._tenant_stats.items():
+                    t = tenants.setdefault(name, [0, 0, 0])
+                    t[0] += c
+                    t[1] += el
+                    t[2] += m
+        out = {
             "prefill_workers": len(self.prefills),
             "decode_workers": len(self.decodes),
             "colocated": self.colocate,
@@ -794,3 +883,14 @@ class DisaggRouter:
             "per_prefill": [e.stats() for e in self.prefills],
             "per_decode": [e.stats() for e in self.decodes],
         }
+        if tenants:
+            # fleet-wide per-tenant goodput: a request completes on
+            # exactly one engine (the decode side), so summing across
+            # roles never double-counts
+            out["tenants"] = {
+                name: {"completed": c,
+                       "slo_met": m,
+                       "slo_attainment": (round(m / e, 4) if e
+                                          else None)}
+                for name, (c, e, m) in sorted(tenants.items())}
+        return out

@@ -120,7 +120,9 @@ from ..models.generation import (decode_step, decode_step_paged,
                                  verify_step_paged)
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
+from .decoding import DecodeParams, request_key, sample_first
 from .kv_cache import BlockKVCache, SlotKVCache
+from .lora import LoRAPool
 
 
 class QueueFullError(RuntimeError):
@@ -166,18 +168,33 @@ class Request:
     replays); default is the wall clock. When the engine runs with a
     TTFT SLO, ``deadline`` is the absolute clock time the first token
     must land by, and a shed request records why in ``shed_reason``.
+
+    ``decode`` is the request's :class:`~paddle_tpu.serving.decoding.
+    DecodeParams` recipe (default = plain greedy, the token-identity
+    oracle) and ``tenant`` names its LoRA adapter in the engine's
+    :class:`~paddle_tpu.serving.lora.LoRAPool` ("" = base weights).
+    ``_key`` is the request-local PRNG key — derived from the seed
+    alone and advanced functionally by the compiled steps, so it
+    travels with the request across restarts and disaggregated
+    handoffs and the sampled stream replays byte-identically.
     """
 
     _ids = itertools.count()
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  eos_token_id: Optional[int], priority: int = 1,
-                 now: Optional[float] = None):
+                 now: Optional[float] = None, decode=None,
+                 tenant: str = ""):
         self.id = next(Request._ids)
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.priority = int(priority)
+        self.decode = decode if decode is not None else DecodeParams()
+        self.tenant = str(tenant)
+        self._key = request_key(self.decode.seed)
+        self._cursor = None        # JsonCursor when json_mode is on
+        self._lora_held = False    # this request pins its tenant page
         self.tokens: List[int] = []
         self.state = "queued"
         self.slot: Optional[int] = None
@@ -283,7 +300,10 @@ class ServingEngine:
                  slo_prefill_ms: Optional[float] = None,
                  slo_tpot_ms: Optional[float] = None,
                  priority_preempt: Optional[bool] = None,
-                 clock=None, kv_pool=None):
+                 clock=None, kv_pool=None,
+                 lora_rank: Optional[int] = None,
+                 lora_max_adapters: Optional[int] = None,
+                 lora_pool=None, grammar=None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -300,7 +320,9 @@ class ServingEngine:
                               "serving_slo_ttft_ms",
                               "serving_slo_prefill_ms",
                               "serving_slo_tpot_ms",
-                              "serving_priority_preempt"])
+                              "serving_priority_preempt",
+                              "serving_lora_rank",
+                              "serving_lora_max_adapters"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -427,6 +449,42 @@ class ServingEngine:
             self.cache = SlotKVCache(cfg.num_layers, cfg.num_heads,
                                      cfg.head_dim, self.max_slots,
                                      self.max_len)
+        # Multi-tenant paged LoRA: a pool of per-tenant adapter pages
+        # fed to the compiled steps as one more fixed-shape input (the
+        # lora geometry joins the step-cache key like kv_dtype, but
+        # page remapping / load / evict are pure data — zero retraces).
+        # An explicit lora_pool= shares one pool across engines (the
+        # router/disagg shape); tenants resolve by NAME per step, so
+        # page ids never travel between engines.
+        rank = int(lora_rank if lora_rank is not None
+                   else g["serving_lora_rank"])
+        if lora_pool is not None:
+            self.lora_pool = lora_pool
+        elif rank > 0:
+            self.lora_pool = LoRAPool(
+                cfg, rank,
+                int(lora_max_adapters if lora_max_adapters is not None
+                    else g["serving_lora_max_adapters"]))
+        else:
+            self.lora_pool = None
+        if self.lora_pool is not None and not self.paged:
+            raise ValueError(
+                "multi-tenant LoRA requires the paged KV cache "
+                "(FLAGS_serving_paged); the dense steps carry no "
+                "adapter-page input")
+        self._lora_shape = (None if self.lora_pool is None
+                            else self.lora_pool.shape_key)
+        # JSON-constrained decoding: a JsonGrammar whose per-request
+        # cursors produce the additive [vocab] mask rows. Constructor
+        # state like the SLO knobs — json_mode submissions without it
+        # are rejected with guidance.
+        self.grammar = grammar
+        if self.grammar is not None and \
+                self.grammar.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"grammar vocab {self.grammar.vocab_size} != model "
+                f"vocab {cfg.vocab_size}")
+        self._vocab = int(cfg.vocab_size)
         if self.mesh is not None:
             self._place_on_mesh()
         self._queue: deque = deque()
@@ -501,6 +559,17 @@ class ServingEngine:
             "mesh size; 1 for a single-device engine)"
             ).labels(engine=eid).set(
                 1 if self.mesh is None else self.mesh.devices.size)
+        # per-tenant outcomes ("" keys base traffic): completed and
+        # SLO-met counts, surfaced in stats()["tenants"] — the
+        # per-tenant attainment the router/loadgen aggregate
+        self._tenant_stats: Dict[str, List[int]] = {}
+        self._lora_gauge = None
+        if self.lora_pool is not None:
+            self._lora_gauge = _obs.gauge(
+                "serving_lora_adapters_loaded",
+                "LoRA adapters resident in this engine's paged "
+                "adapter pool (base page excluded)").labels(engine=eid)
+            self._lora_gauge.set(len(self.lora_pool.loaded))
         self._weight_version = 0
         self._weight_version_g = _obs.gauge(
             "serving_weight_version",
@@ -612,6 +681,43 @@ class ServingEngine:
         """Hot-swaps applied so far (0 = construction weights)."""
         return self._weight_version
 
+    # ------------------------------------------------- LoRA adapters
+    def load_adapter(self, name: str, state) -> int:
+        """Load (or hot-reload) a tenant's LoRA adapter into the pool —
+        the ``swap_weights`` machinery applied to a pool page: the
+        write is a functional update on the pool arrays, which the
+        compiled steps take as plain inputs, so the step cache is
+        untouched and the compile tracker observes zero new compiles.
+        Runs under the step lock for a clean cut between steps.
+        Returns the adapter's page id (engine-local; requests carry
+        the tenant *name*)."""
+        if self.lora_pool is None:
+            raise ValueError(
+                "engine has no LoRA pool; construct with lora_rank > 0 "
+                "(FLAGS_serving_lora_rank) or pass lora_pool=")
+        with self._step_lock:
+            page = self.lora_pool.load(name, state)
+        self._lora_gauge.set(len(self.lora_pool.loaded))
+        _runlog.log_event("serving_lora_load", engine=self._eid,
+                          adapter=name, page=page)
+        _monitor.stat_add("STAT_serving_lora_loads")
+        return page
+
+    def evict_adapter(self, name: str) -> int:
+        """Evict a tenant's adapter, freeing its pool page. Refuses
+        (ValueError) while in-flight requests still pin the page —
+        drain that tenant first, the same discipline that keeps KV
+        blocks leak-free."""
+        if self.lora_pool is None:
+            raise ValueError("engine has no LoRA pool")
+        with self._step_lock:
+            page = self.lora_pool.evict(name)
+        self._lora_gauge.set(len(self.lora_pool.loaded))
+        _runlog.log_event("serving_lora_load", engine=self._eid,
+                          adapter=name, page=page, evicted=True)
+        _monitor.stat_add("STAT_serving_lora_evictions")
+        return page
+
     # --------------------------------------------------- TTFT prediction
     _EWMA_ALPHA = 0.3
 
@@ -716,6 +822,14 @@ class ServingEngine:
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                priority: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               stop: Optional[Sequence[Sequence[int]]] = None,
+               seed: Optional[int] = None,
+               json_mode: Optional[bool] = None,
+               tenant: Optional[str] = None,
+               decode: Optional[DecodeParams] = None,
                _log_request: bool = True) -> Request:
         """Queue a generation request; returns its handle immediately.
 
@@ -726,7 +840,20 @@ class ServingEngine:
         a predicted TTFT beyond budget (the error carries ``reason``
         and a ``retry_after_s`` hint). With preemption enabled, a
         submission that would otherwise be shed may instead shed queued
-        strictly-lower-priority work to make room."""
+        strictly-lower-priority work to make room.
+
+        Per-request decoding rides along as *data*, never as compile
+        keys: ``temperature``/``top_k``/``top_p``/``seed`` select the
+        sampling law (all-defaults = greedy, byte-identical to the
+        pre-sampling engine), ``stop`` is a tuple of token-id stop
+        sequences checked host-side, ``json_mode`` masks decoding to
+        the engine's JSON ``grammar``, and ``tenant`` names a loaded
+        LoRA adapter whose pool page the compiled step gathers for
+        this row. Invalid combinations raise ValueError (HTTP 400):
+        ``json_mode`` without a grammar or with speculative decoding
+        enabled, ``tenant`` without a LoRA pool or naming an adapter
+        that is not loaded. ``decode=`` passes a prebuilt
+        :class:`DecodeParams` instead of the individual fields."""
         mnt = int(max_new_tokens if max_new_tokens is not None
                   else self.default_max_new_tokens)
         eos = (eos_token_id if eos_token_id is not None
@@ -736,6 +863,49 @@ class ServingEngine:
             raise ValueError("empty prompt")
         if mnt < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        if decode is not None:
+            if any(v is not None for v in (temperature, top_k, top_p,
+                                           stop, seed, json_mode)):
+                raise ValueError(
+                    "pass either decode= or individual sampling "
+                    "fields, not both")
+            params = decode
+        else:
+            try:
+                stops = tuple(tuple(int(t) for t in s)
+                              for s in (stop or ()))
+            except TypeError:
+                raise ValueError(
+                    "stop must be a list of token-id sequences, e.g. "
+                    "[[5, 6]], not a flat list of ids")
+            # DecodeParams.__post_init__ validates ranges (negative
+            # temperature/top_k, top_p outside [0, 1], ...)
+            params = DecodeParams(
+                temperature=float(temperature) if temperature is not None
+                else 0.0,
+                top_k=int(top_k) if top_k is not None else 0,
+                top_p=float(top_p) if top_p is not None else 0.0,
+                stop_sequences=stops,
+                seed=int(seed) if seed is not None else 0,
+                json_mode=bool(json_mode) if json_mode is not None
+                else False)
+        tenant = str(tenant) if tenant is not None else ""
+        if params.json_mode:
+            if self.grammar is None:
+                raise ValueError(
+                    "json_mode requires an engine constructed with a "
+                    "grammar= (see serving.decoding.JsonGrammar)")
+            if self.spec_tokens > 0:
+                raise ValueError(
+                    "json_mode cannot combine with speculative decoding "
+                    "(FLAGS_serving_spec_tokens > 0): the delta draft "
+                    "proposes unmasked tokens")
+        if tenant:
+            if self.lora_pool is None:
+                raise ValueError(
+                    "tenant= requires a LoRA pool; construct the engine "
+                    "with lora_rank > 0 (FLAGS_serving_lora_rank)")
+            self.lora_pool.page_of(tenant)  # unknown adapter -> ValueError
         if len(prompt) + mnt + self.spec_tokens > self.max_len:
             # speculative decoding reserves spec_tokens rows of slot
             # headroom: the verify step scatter-writes K+1 rows at the
@@ -761,9 +931,21 @@ class ServingEngine:
             # everything loadgen needs to re-offer this exact request.
             # Routers log one fleet-level event themselves and pass
             # _log_request=False so fan-out doesn't duplicate arrivals.
+            extra = {}
+            if not params.is_default:
+                extra.update(temperature=params.temperature,
+                             top_k=params.top_k, top_p=params.top_p,
+                             seed=params.seed)
+                if params.stop_sequences:
+                    extra["stop"] = [list(s)
+                                     for s in params.stop_sequences]
+                if params.json_mode:
+                    extra["json_mode"] = True
+            if tenant:
+                extra["tenant"] = tenant
             _runlog.log_event("serving_request", t=round(now, 6),
                               prompt=prompt, max_new_tokens=mnt,
-                              priority=pr, engine=self._eid)
+                              priority=pr, engine=self._eid, **extra)
         if self.draining:
             _monitor.stat_add("STAT_serving_rejected")
             self._count_shed("drain", pr)
@@ -779,7 +961,10 @@ class ServingEngine:
             raise QueueFullError("submission shed by injected fault at "
                                  "serving.submit", reason="fault",
                                  retry_after_s=self._retry_after_s(0.0))
-        req = Request(prompt, mnt, eos, priority=pr, now=now)
+        req = Request(prompt, mnt, eos, priority=pr, now=now,
+                      decode=params, tenant=tenant)
+        if params.json_mode:
+            req._cursor = self.grammar.start()
         if self.slo_ttft_ms:
             req.deadline = now + self.slo_ttft_ms / 1e3
         reject = None          # (reason, predicted_ms) when shedding
@@ -952,20 +1137,24 @@ class ServingEngine:
                self.cache.block_size, self.cache.num_blocks,
                self.kv_dtype, self.attn_impl,
                mesh_cache_key(self.mesh))
+        lora_shape = self._lora_shape
+        if lora_shape is not None:
+            key = key + ("lora", tuple(lora_shape))
         model, mesh, kv_dtype = self.model, self.mesh, self.kv_dtype
 
         def _build():
             from ..models.generation import (_borrowed_params,
                                              _inject_params)
 
-            def _prefill(params, ids, last, pos, tables, pools):
+            def _prefill(params, ids, last, pos, tables, pools,
+                         lora=None):
                 from ..models.generation import (_unwrap_pools,
                                                  _wrap_pools)
                 with no_grad(), _borrowed_params(model, params):
                     logits, newp = model(
                         Tensor(ids, stop_gradient=True),
                         cache=_wrap_pools(pools),
-                        cache_pos=pos, block_tables=tables)
+                        cache_pos=pos, block_tables=tables, lora=lora)
                 lg = jnp.take_along_axis(logits.value,
                                          last[:, None, None],
                                          axis=1)[:, 0]
@@ -978,9 +1167,12 @@ class ServingEngine:
                                                  _mesh_step_shardings)
                 repl, pools_sh = _mesh_step_shardings(model, mesh,
                                                       kv_dtype)
+                in_sh = (_mesh_param_shardings(model, mesh),
+                         repl, repl, repl, repl, pools_sh)
+                if lora_shape is not None:
+                    in_sh = in_sh + (repl,)
                 jit_kwargs = dict(
-                    in_shardings=(_mesh_param_shardings(model, mesh),
-                                  repl, repl, repl, repl, pools_sh),
+                    in_shardings=in_sh,
                     out_shardings=(repl, pools_sh, repl))
             fn = _inject_params(
                 model, _ct.tracked_jit("serving_prefill_paged", _prefill,
@@ -1023,16 +1215,22 @@ class ServingEngine:
         pos = np.zeros(self.max_slots, np.int32)
         tables = np.full((self.max_slots, T), BlockKVCache.TRASH,
                          np.int32)
+        pages = np.zeros(self.max_slots, np.int32)
         for i, (req, row, shared) in enumerate(live):
             suffix = req.prompt[shared:]
             ids[i, :len(suffix)] = suffix
             last[i] = len(suffix) - 1
             pos[i] = shared
             tables[i] = self.cache.tables[row]
+            if self.lora_pool is not None and req.tenant:
+                pages[i] = self.lora_pool.page_of(req.tenant)
         fn = self._prefill_entry_paged(bucket)["fn"]
-        return live, shed, fn(jnp.asarray(ids), jnp.asarray(last),
-                              jnp.asarray(pos), jnp.asarray(tables),
-                              self.cache.arrays())
+        args = (jnp.asarray(ids), jnp.asarray(last),
+                jnp.asarray(pos), jnp.asarray(tables),
+                self.cache.arrays())
+        if self.lora_pool is not None:
+            args = args + ((jnp.asarray(pages), self.lora_pool.arrays),)
+        return live, shed, fn(*args)
 
     def _pop_candidates(self, limit: int):
         """Pop up to ``limit`` queued requests in admission order —
@@ -1094,6 +1292,17 @@ class ServingEngine:
             if res is None:
                 back.append(req)   # pool dry: wait for retirements
                 continue
+            if req.tenant and self.lora_pool is not None:
+                # pin the tenant's adapter page for the request's
+                # lifetime (released in _finish/_shed); an adapter
+                # evicted between submit and admit sheds here
+                try:
+                    self.lora_pool.acquire(req.tenant)
+                    req._lora_held = True
+                except ValueError as e:
+                    self.cache.release_row(res[0])
+                    self._shed(req, _Shed(str(e)))
+                    continue
             acquired.append((req, res[0], res[1]))
         if back:
             with self._lock:
@@ -1153,7 +1362,8 @@ class ServingEngine:
                                   bucket=bucket, slot=row,
                                   prompt_tokens=len(req.prompt),
                                   shared_tokens=shared)
-                self._append_token(req, int(first[i]))
+                self._append_token(req,
+                                   self._take_first(req, first, lg, i))
         return expired + len(candidates) - len(back), admitted
 
     def _admit_round(self):
@@ -1206,9 +1416,27 @@ class ServingEngine:
                                   prompt_tokens=len(req.prompt))
                 # the first generated token comes from the prefill
                 # logits (same argmax greedy_search takes after ITS
-                # prefill)
-                self._append_token(req, int(first[i]))
+                # prefill; sampled/masked rows draw from them instead)
+                self._append_token(req,
+                                   self._take_first(req, first, lg, i))
         return expired + len(candidates), admitted
+
+    def _take_first(self, req: Request, first: np.ndarray, lg,
+                    i: int) -> int:
+        """The request's first generated token from its prefill-logits
+        row: the batch argmax for plain greedy rows (the oracle's fast
+        path), a host-side :func:`sample_first` draw for sampled or
+        grammar-masked rows — same law the compiled steps apply, so a
+        restart replays identically."""
+        p = req.decode
+        if p.is_greedy and req._cursor is None:
+            return int(first[i])
+        mask_row = None
+        if req._cursor is not None:
+            mask_row = req._cursor.mask_row(req.max_new_tokens)
+        tok, req._key = sample_first(np.asarray(lg[i]), p, req._key,
+                                     mask_row)
+        return tok
 
     def _admit(self) -> int:
         """Fill free slots from the queue (batched, one prefill
@@ -1223,21 +1451,77 @@ class ServingEngine:
                 return admitted
 
     # ------------------------------------------------------------ decode
+    def _build_samp(self):
+        """The per-slot sampling-as-data tuple for one compiled step,
+        rebuilt from the active requests every iteration: fixed-shape
+        plain inputs ``(temperature [b] f32, top_k [b] i32, top_p [b]
+        f32, keys [b, 2] u32, mask [b, vocab] f32)``. Empty slots stay
+        at the all-zero neutral row (greedy, no mask) so padding rows
+        reproduce the pre-sampling argmax bit-for-bit; grammar-cursored
+        rows get their additive JSON mask for the *next* position,
+        budget-aware so the emitted document always closes in time."""
+        b, V = self.max_slots, self._vocab
+        temp = np.zeros(b, np.float32)
+        tk = np.zeros(b, np.int32)
+        tp = np.zeros(b, np.float32)
+        keys = np.zeros((b, 2), np.uint32)
+        mask = np.zeros((b, V), np.float32)
+        for slot, req in self._active.items():
+            p = req.decode
+            temp[slot] = p.temperature
+            tk[slot] = p.top_k
+            tp[slot] = p.top_p
+            keys[slot] = req._key
+            if req._cursor is not None:
+                remaining = req.max_new_tokens - len(req.tokens)
+                req._cursor.mask_row(remaining, out=mask[slot])
+        return (jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp),
+                jnp.asarray(keys), jnp.asarray(mask))
+
+    def _writeback_keys(self, new_keys):
+        """Persist each active row's advanced RNG key back onto its
+        request — the authoritative key lives host-side on the Request
+        (it travels with disagg handoffs and engine restarts), the
+        device copy is rebuilt per step. Advancement is request-local
+        (a fixed per-row split fan-out), so replaying the same request
+        through any batch composition draws the same stream."""
+        if not self._active:
+            return
+        arr = np.asarray(new_keys)
+        for slot, req in self._active.items():
+            req._key = arr[slot].copy()
+
+    def _lora_args(self):
+        """The per-step LoRA input ``(page_ids [b] i32, pool arrays)``:
+        each active row's tenant resolved by NAME to its current pool
+        page (safe against eviction — in-flight requests pin their
+        page), empty/base rows on the all-zero base page 0."""
+        pages = np.zeros(self.max_slots, np.int32)
+        for slot, req in self._active.items():
+            if req.tenant:
+                pages[slot] = self.lora_pool.page_of(req.tenant)
+        return (jnp.asarray(pages), self.lora_pool.arrays)
+
     def _decode_attempt(self, tokens: np.ndarray):
         kind = fault_point("serving.step")
         if kind == "skip":
             raise _SkipStep("injected skip of one decode iteration")
+        samp = self._build_samp()
         if self.paged:
             fn = decode_step_paged(self.model, self.mesh,
-                                   self.kv_dtype)["fn"]
-            return fn(jnp.asarray(tokens),
-                      jnp.asarray(self.cache.lengths),
-                      jnp.asarray(self.cache.tables),
-                      self.cache.arrays())
+                                   self.kv_dtype,
+                                   self._lora_shape)["fn"]
+            args = (jnp.asarray(tokens),
+                    jnp.asarray(self.cache.lengths),
+                    jnp.asarray(self.cache.tables),
+                    self.cache.arrays(), samp)
+            if self._lora_shape is not None:
+                args = args + (self._lora_args(),)
+            return fn(*args)
         fn = decode_step(self.model)["fn"]
         return fn(jnp.asarray(tokens),
                   jnp.asarray(self.cache.lengths),
-                  self.cache.arrays())
+                  self.cache.arrays(), samp)
 
     def _note_qerr(self, qerr, rows: int):
         """Surface an int8 step's max-abs dequantization error: bump
@@ -1283,11 +1567,12 @@ class ServingEngine:
             return 0
         self._note_tpot_ms((time.perf_counter() - t0) * 1e3)
         if self.paged:
-            nxt, _, arrays, qerr = out
+            nxt, _, arrays, qerr, new_keys = out
             self._note_qerr(qerr, len(self._active))
         else:
-            nxt, _, arrays = out
+            nxt, _, arrays, new_keys = out
         self.cache.set_arrays(arrays)
+        self._writeback_keys(new_keys)
         nxt = np.asarray(nxt)
         produced = 0
         for slot, req in list(self._active.items()):
@@ -1301,17 +1586,22 @@ class ServingEngine:
         kind = fault_point("serving.step")
         if kind == "skip":
             raise _SkipStep("injected skip of one verify iteration")
+        samp = self._build_samp()
         if self.paged:
             fn = verify_step_paged(self.model, self.spec_tokens,
-                                   self.mesh, self.kv_dtype)["fn"]
-            return fn(jnp.asarray(tokens),
-                      jnp.asarray(self.cache.lengths),
-                      jnp.asarray(self.cache.tables),
-                      self.cache.arrays())
+                                   self.mesh, self.kv_dtype,
+                                   self._lora_shape)["fn"]
+            args = (jnp.asarray(tokens),
+                    jnp.asarray(self.cache.lengths),
+                    jnp.asarray(self.cache.tables),
+                    self.cache.arrays(), samp)
+            if self._lora_shape is not None:
+                args = args + (self._lora_args(),)
+            return fn(*args)
         fn = verify_step(self.model, self.spec_tokens)["fn"]
         return fn(jnp.asarray(tokens),
                   jnp.asarray(self.cache.lengths),
-                  self.cache.arrays())
+                  self.cache.arrays(), samp)
 
     def _spec_decode(self) -> int:
         """One speculative draft–verify step over every occupied slot:
@@ -1324,12 +1614,10 @@ class ServingEngine:
             return 0
         K = self.spec_tokens
         tokens = np.zeros((self.max_slots, K + 1), np.int32)
-        drafts = np.zeros((self.max_slots, K), np.int32)
         for slot, req in self._active.items():
             d = draft_ngram(req.prompt + req.tokens, K, self.spec_ngram)
             tokens[slot, 0] = req.tokens[-1]
             tokens[slot, 1:] = d
-            drafts[slot] = d
         n_active = len(self._active)
         t0 = time.perf_counter()
         try:
@@ -1346,12 +1634,14 @@ class ServingEngine:
                 self._shed(req, e)
             return 0
         if self.paged:
-            nxt, _, arrays, qerr = out
+            nxt, _, arrays, qerr, accept, new_keys = out
             self._note_qerr(qerr, (K + 1) * len(self._active))
         else:
-            nxt, _, arrays = out
+            nxt, _, arrays, accept, new_keys = out
         self.cache.set_arrays(arrays)
+        self._writeback_keys(new_keys)
         nxt = np.asarray(nxt)
+        accept = np.asarray(accept)
         produced = 0
         for slot, req in list(self._active.items()):
             # the verify wrote K+1 rows at this slot's offset; commit
@@ -1365,8 +1655,8 @@ class ServingEngine:
                 produced += 1
                 if req.state != "running":
                     break        # finished (EOS / budget) mid-verify
-                if i == K or int(drafts[slot, i]) != tok:
-                    break        # out of drafts / first mismatch
+                if i == K or not bool(accept[slot, i]):
+                    break        # out of drafts / first rejection
                 accepted += 1
             self._spec_proposed += K
             self._spec_accepted += accepted
@@ -1392,16 +1682,38 @@ class ServingEngine:
         if req.first_token_at is None:
             req.first_token_at = self._clock()
         _monitor.stat_add("STAT_serving_tokens")
+        if req._cursor is not None:
+            # advance the grammar pushdown over the committed token;
+            # a structurally-complete document retires the request
+            # (the budget-aware mask guarantees this lands in time)
+            req._cursor.advance(token)
+            if req._cursor.at_end:
+                self._finish(req)
+                return
         if (req.eos_token_id is not None and
                 token == req.eos_token_id) or \
-                len(req.tokens) >= req.max_new_tokens:
+                len(req.tokens) >= req.max_new_tokens or \
+                self._hit_stop(req):
             self._finish(req)
+
+    def _hit_stop(self, req: Request) -> bool:
+        """Host-side stop-sequence check on the generated suffix; the
+        matched stop tokens stay in the output (OpenAI-style truncation
+        is the caller's choice — the engine reports what it committed)."""
+        t = req.tokens
+        for s in req.decode.stop_sequences:
+            if len(t) >= len(s) and t[-len(s):] == list(s):
+                return True
+        return False
 
     def _finish(self, req: Request):
         if req.slot is not None:
             self._active.pop(req.slot, None)
             self.cache.release(req.slot)
             req.slot = None
+        if req._lora_held:
+            self.lora_pool.release(req.tenant)
+            req._lora_held = False
         req.state = "done"
         req.finished_at = self._clock()
         ttft, tpot = req.ttft, req.tpot
@@ -1415,6 +1727,15 @@ class ServingEngine:
             if met:
                 self._slo_met += 1
             completed, slo_met = self._completed, self._slo_met
+            # [completed, slo-eligible, slo-met]: attainment only
+            # counts requests that carried a TTFT deadline
+            ts = self._tenant_stats.setdefault(req.tenant or "base",
+                                               [0, 0, 0])
+            ts[0] += 1
+            if met is not None:
+                ts[1] += 1
+                if met:
+                    ts[2] += 1
         if self._slo_gauge is not None and completed:
             self._slo_gauge.set(slo_met / completed)
         _monitor.stat_add("STAT_serving_completed")
@@ -1427,6 +1748,9 @@ class ServingEngine:
 
     def _shed(self, req: Request, err: BaseException,
               reason: str = "fault"):
+        if req._lora_held:
+            self.lora_pool.release(req.tenant)
+            req._lora_held = False
         req.slot = None
         req.state = "shed"
         req.error = err
@@ -1472,6 +1796,7 @@ class ServingEngine:
             slo_met = self._slo_met
             shed = dict(self._shed_by_reason)
             queued = len(self._queue)
+            tenants = {k: list(v) for k, v in self._tenant_stats.items()}
         out = {
             "ttft_p50_ms": pct(self._ttft_hist, 0.50),
             "ttft_p99_ms": pct(self._ttft_hist, 0.99),
@@ -1507,6 +1832,24 @@ class ServingEngine:
                              else list(self.mesh_shape))
         if self.kv_dtype == "int8":
             out["kv_quant_max_abs_err"] = round(self._qerr_max, 6)
+        if tenants:
+            # per-tenant completion + SLO attainment ("base" = no-LoRA
+            # traffic); the router sums these across replicas
+            out["tenants"] = {
+                name: {"completed": c,
+                       "slo_met": m,
+                       "slo_attainment": (round(m / e, 4) if e
+                                          else None)}
+                for name, (c, e, m) in sorted(tenants.items())}
+        if self.lora_pool is not None:
+            out["lora"] = {
+                "rank": self.lora_pool.rank,
+                "max_adapters": self.lora_pool.max_adapters,
+                "loaded": self.lora_pool.loaded,
+                "leaked_pages": self.lora_pool.leaked(),
+            }
+        if self.grammar is not None:
+            out["json_grammar"] = True
         if self.paged:
             c = self.cache
             hit_t, miss_t = c.prefix_hits, c.prefix_misses

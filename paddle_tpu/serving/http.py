@@ -5,9 +5,23 @@ ThreadingHTTPServer on a daemon thread with start/stop), speaking a
 minimal JSON generation protocol:
 
   POST /v1/generate   {"ids": [...], "max_new_tokens"?, "eos_token_id"?,
-                       "priority"?}
+                       "priority"?, "temperature"?, "top_k"?, "top_p"?,
+                       "stop"?, "seed"?, "tenant"?, "json_mode"?}
                       -> 200 {"id", "output_ids", "generated", "state"}
-                      -> 400 bad request geometry / malformed JSON
+                         (+ "tenant" echoed when one was named)
+                      -> 400 bad request geometry / malformed JSON /
+                             invalid decoding params. The documented
+                             invalid combinations: temperature < 0,
+                             top_k < 0, top_p outside [0, 1],
+                             json_mode on an engine constructed
+                             without a grammar=, json_mode with
+                             speculative decoding enabled
+                             (FLAGS_serving_spec_tokens > 0), tenant
+                             on an engine without a LoRA pool, and
+                             tenant naming an adapter that is not
+                             loaded. All defaults (temperature 0 =
+                             greedy) reproduce the pre-sampling
+                             engine byte-for-byte.
                       -> 429 admission control (queue full / predicted
                              SLO miss / shed at submit — the
                              backpressure signal; Retry-After comes
@@ -21,7 +35,10 @@ minimal JSON generation protocol:
                              engine.stats() (TTFT / TPOT percentiles,
                              speculative acceptance rate, per-reason
                              shed counts, slo_attainment when an SLO
-                             is configured)
+                             is configured, per-tenant goodput under
+                             "tenants" and the loaded-adapter roster
+                             under "lora" once multi-tenant traffic
+                             exists)
   GET  /metrics       -> 200 the whole observability registry in
                              Prometheus text exposition format
                              (serving counters/latency histograms,
@@ -104,7 +121,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
             req = engine.submit(ids,
                                 max_new_tokens=body.get("max_new_tokens"),
                                 eos_token_id=body.get("eos_token_id"),
-                                priority=body.get("priority"))
+                                priority=body.get("priority"),
+                                temperature=body.get("temperature"),
+                                top_k=body.get("top_k"),
+                                top_p=body.get("top_p"),
+                                stop=body.get("stop"),
+                                seed=body.get("seed"),
+                                json_mode=body.get("json_mode"),
+                                tenant=body.get("tenant"))
         except QueueFullError as e:
             # Retry-After: the engine's predicted-TTFT backoff when it
             # attached one (how long the backlog actually needs), else
@@ -126,9 +150,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._json(503, {"error": f"request {req.id} {req.state}: "
                                       f"{req.error}"})
             return
-        self._json(200, {"id": req.id, "output_ids": req.output_ids,
-                         "generated": len(req.tokens),
-                         "state": req.state})
+        payload = {"id": req.id, "output_ids": req.output_ids,
+                   "generated": len(req.tokens), "state": req.state}
+        if req.tenant:
+            payload["tenant"] = req.tenant
+        self._json(200, payload)
 
 
 class ServingHTTPServer:

@@ -156,6 +156,24 @@ class ReplicaRouter:
         self._autoscale: Optional[AutoscalePolicy] = autoscale
         self._model = model
         self._engine_kwargs = dict(engine_kwargs)
+        if model is not None and \
+                "lora_pool" not in self._engine_kwargs:
+            # multi-tenant fleets share ONE adapter pool: tenants load
+            # once and resolve by name on every replica (autoscale
+            # replicas inherit it through the saved kwargs)
+            gl = _flags.get_flags(["serving_lora_rank",
+                                   "serving_lora_max_adapters"])
+            rank = self._engine_kwargs.get("lora_rank")
+            rank = int(rank if rank is not None
+                       else gl["serving_lora_rank"])
+            if rank > 0:
+                from .lora import LoRAPool
+                mx = self._engine_kwargs.get("lora_max_adapters")
+                self._engine_kwargs["lora_pool"] = LoRAPool(
+                    model.gpt.cfg, rank,
+                    int(mx if mx is not None
+                        else gl["serving_lora_max_adapters"]))
+        engine_kwargs = self._engine_kwargs
         if engines is not None:
             if model is not None or engine_kwargs:
                 raise ValueError(
@@ -241,7 +259,8 @@ class ReplicaRouter:
             g.set(0)
 
     def _route_attempt(self, prompt, max_new_tokens, eos_token_id,
-                       priority, _log_request=True) -> Request:
+                       priority, _log_request=True,
+                       **decode_kwargs) -> Request:
         kind = fault_point("serving.route")
         if kind == "skip":
             _monitor.stat_add("STAT_serving_route_shed")
@@ -269,7 +288,8 @@ class ReplicaRouter:
                 req = eng.submit(prompt, max_new_tokens=max_new_tokens,
                                  eos_token_id=eos_token_id,
                                  priority=priority,
-                                 _log_request=_log_request)
+                                 _log_request=_log_request,
+                                 **decode_kwargs)
             except QueueFullError as e:
                 last_err = e
                 continue
@@ -287,12 +307,17 @@ class ReplicaRouter:
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                priority: Optional[int] = None,
-               _log_request: bool = True) -> Request:
+               _log_request: bool = True, **decode_kwargs) -> Request:
         """Route one request to the least-loaded replica; returns its
         :class:`Request` handle. ``priority`` passes through to the
-        chosen engine's admission. Raises :class:`QueueFullError` when
-        every replica sheds (or the router is draining) and ValueError
-        for geometry no replica can hold."""
+        chosen engine's admission, as do the per-request decoding
+        fields (``temperature``/``top_k``/``top_p``/``stop``/``seed``/
+        ``json_mode``/``tenant`` — see :meth:`ServingEngine.submit`);
+        tenants resolve on whichever replica admits, which is why
+        multi-tenant fleets share one ``lora_pool=`` via engine
+        kwargs. Raises :class:`QueueFullError` when every replica
+        sheds (or the router is draining) and ValueError for geometry
+        no replica can hold."""
         with self._lock:
             if self._draining:
                 raise QueueFullError("router is draining: submissions "
@@ -301,11 +326,46 @@ class ReplicaRouter:
         try:
             return RetryPolicy.from_flags("serving.route").call(
                 self._route_attempt, prompt, max_new_tokens,
-                eos_token_id, priority, _log_request)
+                eos_token_id, priority, _log_request, **decode_kwargs)
         except RetryError as e:
             _monitor.stat_add("STAT_serving_route_shed")
             raise QueueFullError(
                 f"routing retries exhausted: {e}", reason="fault") from e
+
+    # ----------------------------------------------------- LoRA adapters
+    def load_adapter(self, name: str, state) -> int:
+        """Load a tenant adapter across the fleet: once per distinct
+        pool, so replicas sharing one ``lora_pool=`` (the recommended
+        multi-tenant shape — pass it via engine kwargs) pay a single
+        load and per-replica pools each get a copy. Returns the page
+        id on the last pool written."""
+        pools: list = []
+        page = None
+        for eng in list(self.engines) + list(self._retiring):
+            if eng.lora_pool is None:
+                raise ValueError(
+                    "replica has no LoRA pool; construct the router "
+                    "with lora_rank > 0 or a shared lora_pool=")
+            if any(eng.lora_pool is p for p in pools):
+                continue
+            pools.append(eng.lora_pool)
+            page = eng.load_adapter(name, state)
+        return page
+
+    def evict_adapter(self, name: str) -> int:
+        """Evict a tenant adapter from every distinct pool; refuses
+        (ValueError) while any replica's in-flight work pins it."""
+        pools: list = []
+        page = None
+        for eng in list(self.engines) + list(self._retiring):
+            if eng.lora_pool is None or \
+                    any(eng.lora_pool is p for p in pools):
+                continue
+            pools.append(eng.lora_pool)
+            page = eng.evict_adapter(name)
+        if page is None:
+            raise ValueError("no replica has a LoRA pool")
+        return page
 
     # -------------------------------------------------------- autoscale
     def _add_replica(self):
@@ -504,12 +564,18 @@ class ReplicaRouter:
         depths = [self._depth(e) for e in self.engines]
         shed: dict = {}
         completed = slo_met = 0
+        tenants: dict = {}
         for e in engines:
             with e._lock:
                 completed += e._completed
                 slo_met += e._slo_met
                 for k, v in e._shed_by_reason.items():
                     shed[k] = shed.get(k, 0) + v
+                for name, (c, el, m) in e._tenant_stats.items():
+                    t = tenants.setdefault(name, [0, 0, 0])
+                    t[0] += c
+                    t[1] += el
+                    t[2] += m
         out = {
             "replicas": len(self.engines),
             "draining": self._draining,
@@ -525,6 +591,15 @@ class ReplicaRouter:
             "shed_total": sum(shed.values()),
             "per_replica": [e.stats() for e in self.engines],
         }
+        if tenants:
+            # fleet-wide per-tenant goodput + SLO attainment, summed
+            # across replicas (tenants resolve by name everywhere)
+            out["tenants"] = {
+                name: {"completed": c,
+                       "slo_met": m,
+                       "slo_attainment": (round(m / e, 4) if e
+                                          else None)}
+                for name, (c, e, m) in sorted(tenants.items())}
         if self._autoscale is not None:
             out["autoscale"] = {
                 "min_replicas": self._autoscale.min_replicas,

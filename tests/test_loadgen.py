@@ -279,3 +279,64 @@ def test_warmup_resets_learned_costs(model):
     assert eng._tpot_ewma is None
     assert eng.idle
     assert eng.predict_ttft_ms(prompt_len=4) == 0.0   # cold: optimistic
+
+
+# ------------------------------------------------- decode-bearing mix
+def test_decode_mix_trace_deterministic_and_greedy_unchanged():
+    """sample_frac/tenant_mix draws are gated: a plain generator's RNG
+    stream (and so its trace bytes) is untouched, while decode-bearing
+    generators are seed-deterministic and round-trip through
+    from_trace with their decode fields intact."""
+    import json as _json
+    plain = LoadGen(mode="bursty", seed=42, **_LG_KW)
+    rows = _json.loads(plain.trace_bytes())["arrivals"]
+    assert all(len(r) == 4 for r in rows)   # no decode fields leak in
+    mix = dict(sample_frac=0.5,
+               tenant_mix={"base": 0.5, "acme": 0.3, "zeta": 0.2})
+    a = LoadGen(mode="bursty", seed=42, **_LG_KW, **mix)
+    b = LoadGen(mode="bursty", seed=42, **_LG_KW, **mix)
+    assert a.trace_bytes() == b.trace_bytes()
+    sched = a.schedule()
+    assert any(x.temperature > 0 for x in sched)
+    assert any(x.tenant for x in sched)
+    assert any(not x.tenant for x in sched)   # "base" maps to no tenant
+    rt = LoadGen.from_trace(_json.loads(a.trace_bytes()))
+    assert rt.schedule() == sched
+    assert rt.trace_bytes() == a.trace_bytes()
+
+
+def test_decode_mix_per_tenant_report_and_zero_leaks(model):
+    """A two-tenant sampled burst on a virtual clock: per-tenant
+    goodput reported, loadgen and engine tenant views agree on
+    completions, zero leaked adapter pages."""
+    from paddle_tpu.serving import make_adapter
+    vc = VirtualClock()
+    eng = _engine(model, vc.now, lora_rank=2, lora_max_adapters=2,
+                  max_queue=16, slo_ttft_ms=200.0)
+    cfg = model.gpt.cfg
+    eng.load_adapter("acme", make_adapter(cfg, 2, seed=1))
+    eng.load_adapter("zeta", make_adapter(cfg, 2, seed=2))
+    lg = LoadGen(mode="poisson", seed=6, sample_frac=0.5,
+                 tenant_mix={"base": 0.4, "acme": 0.3, "zeta": 0.3},
+                 **_LG_KW)
+    warmup(eng)
+    report = lg.run(eng, clock=vc, step_cost_ms=4.0)
+    assert report["exceptions"] == 0, report
+    assert report["leaked_kv_blocks"] == 0
+    assert report["leaked_lora_pages"] == 0
+    pt_rep = report["per_tenant"]
+    assert set(pt_rep) <= {"base", "acme", "zeta"}
+    assert sum(t["offered"] for t in pt_rep.values()) == \
+        report["offered"]
+    assert sum(t["completed"] for t in pt_rep.values()) == \
+        report["completed"]
+    eng_tenants = eng.stats()["tenants"]
+    for name, ts in pt_rep.items():
+        if not ts["completed"]:
+            continue
+        if name == "base":   # engine's base bucket includes warmup
+            assert eng_tenants[name]["completed"] >= ts["completed"], \
+                (name, ts, eng_tenants)
+        else:
+            assert eng_tenants[name]["completed"] == ts["completed"], \
+                (name, ts, eng_tenants)

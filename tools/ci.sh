@@ -215,6 +215,33 @@ print(f\"   disagg: goodput {r['goodput_per_s']}/s, \"
       f\"{d['handoffs_adopted']} handoffs \"
       f\"({d['affinity_hits']} affinity hits), 0 leaks\")
 "
+echo "   multi-tenant decode mix (2 LoRA tenants + sampled rows)"
+# seeded burst mixing greedy/sampled rows across three tenants on one
+# compiled engine: per-tenant goodput reported, zero leaked KV blocks
+# or adapter pages, and — the sampling-as-data / paged-LoRA contract —
+# zero new XLA compiles after warmup
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode bursty --rate "$LG_RATE" --duration "$LG_DURATION" --seed 0 \
+  --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16 \
+  --new-tokens 2:8 --slo-ttft-ms 2000 --json \
+  --sample-frac 0.5 --tenant-mix base:0.5,acme:0.3,zeta:0.2 \
+  --lora-rank 2 \
+  --expect-goodput-min 0.1 --expect-zero-leaks \
+  --expect-zero-new-compiles \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+assert r['exceptions'] == 0, r
+pt = r['per_tenant']
+assert set(pt) == {'base', 'acme', 'zeta'}, pt
+assert sum(t['completed'] for t in pt.values()) == r['completed'], pt
+assert any(t['sampled'] for t in pt.values()), pt
+assert r['leaked_lora_pages'] == 0, r
+assert r['new_compiles_after_warmup'] == 0, r
+print(f\"   tenants: \" + \", \".join(
+    f\"{n} {t['completed']}/{t['offered']}\" for n, t in pt.items())
+      + f\", 0 new compiles, 0 leaks\")
+"
 
 echo "== 10/14 op coverage gate"
 if [[ -d /root/reference ]]; then

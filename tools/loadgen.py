@@ -26,7 +26,16 @@ Each arrival carries a prompt sampled from a mixed length distribution
 (70% "chat-short" uniform on the lower half of ``prompt_tokens``, 30%
 "doc-long" uniform on the upper half), a new-token budget sampled the
 same way from ``new_tokens``, and a priority class drawn from
-``priority_mix`` (lower = more urgent). ``trace_bytes()`` serializes
+``priority_mix`` (lower = more urgent). With ``sample_frac`` /
+``tenant_mix`` (CLI: ``--sample-frac``, ``--tenant-mix
+base:0.5,acme:0.3,zeta:0.2``, ``--lora-rank``) arrivals additionally
+carry seeded per-request decode params (temperature / top-k / top-p /
+seed) and a LoRA tenant name — the mixed-traffic workload behind the
+per-tenant goodput report and the zero-new-compiles gate
+(``--expect-zero-new-compiles``: sampling is data and adapter pages
+are data, so post-warmup traffic must never retrace). Greedy
+generators consume the RNG exactly as before, so old seeds keep old
+traces. ``trace_bytes()`` serializes
 the schedule canonically — the determinism tests assert two same-seed
 generators produce identical bytes AND identical admit/shed decisions.
 
@@ -78,6 +87,13 @@ class Arrival(NamedTuple):
     prompt: Tuple[int, ...]
     max_new_tokens: int
     priority: int
+    # per-request decoding fields (sampling-as-data; the defaults
+    # reproduce the pre-decoding greedy trace byte for byte)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    tenant: str = ""       # "" = base weights (no LoRA adapter)
 
 
 class VirtualClock:
@@ -120,7 +136,9 @@ class LoadGen:
                  burst_fraction: float = 0.25,
                  switch_every: float = 1.0,
                  diurnal_period: Optional[float] = None,
-                 diurnal_amplitude: float = 0.8):
+                 diurnal_amplitude: float = 0.8,
+                 sample_frac: float = 0.0,
+                 tenant_mix: Optional[dict] = None):
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
                              f"got {mode!r}")
@@ -155,6 +173,24 @@ class LoadGen:
         self.diurnal_period = float(diurnal_period if diurnal_period
                                     else duration)
         self.diurnal_amplitude = float(diurnal_amplitude)
+        # Per-request decoding mix. The decode-field draws are gated on
+        # the feature being on at all so a plain greedy generator
+        # consumes the RNG stream exactly as before — old seeds keep
+        # producing old traces byte for byte.
+        if not (0.0 <= float(sample_frac) <= 1.0):
+            raise ValueError("sample_frac must be in [0, 1]")
+        self.sample_frac = float(sample_frac)
+        tmix = dict(tenant_mix) if tenant_mix else {}
+        tt = float(sum(tmix.values()))
+        if tmix and (tt <= 0 or any(w < 0 for w in tmix.values())):
+            raise ValueError("tenant_mix weights must be >= 0 with a "
+                             "positive sum")
+        # "base" / "" both mean the base weights (no adapter page)
+        self._tenant_vals = sorted(
+            "" if n in ("", "base") else str(n) for n in tmix)
+        self._tenant_probs = [float(tmix[n]) / tt for n in sorted(
+            tmix, key=lambda n: "" if n in ("", "base") else str(n))]
+        self._decoded = bool(tmix) or self.sample_frac > 0
         self._schedule: Optional[List[Arrival]] = None
 
     @classmethod
@@ -174,9 +210,16 @@ class LoadGen:
         for k in ("mode", "rate", "duration", "seed"):
             if k not in meta and k in trace:
                 meta[k] = trace[k]
-        arrivals = [Arrival(float(t), tuple(int(x) for x in prompt),
-                            int(mnt), int(pri))
-                    for t, prompt, mnt, pri in trace["arrivals"]]
+        arrivals = []
+        for row in trace["arrivals"]:
+            t, prompt, mnt, pri = row[:4]
+            extra = ()
+            if len(row) > 4:   # decode-bearing rows: 5 more fields
+                extra = (float(row[4]), int(row[5]), float(row[6]),
+                         int(row[7]), str(row[8]))
+            arrivals.append(Arrival(float(t),
+                                    tuple(int(x) for x in prompt),
+                                    int(mnt), int(pri), *extra))
         last_t = max((a.t for a in arrivals), default=0.0)
         duration = float(meta.get("duration") or 0.0)
         if duration <= last_t:
@@ -190,6 +233,8 @@ class LoadGen:
         lg = cls(mode=mode, rate=rate, duration=duration,
                  seed=int(meta.get("seed", 0)))
         lg._schedule = arrivals
+        # decode-bearing traces re-serialize with their decode fields
+        lg._decoded = any(len(r) > 4 for r in trace["arrivals"])
         return lg
 
     # ---------------------------------------------------------- schedule
@@ -259,19 +304,42 @@ class LoadGen:
                            rng.randint(1, self.vocab_size, size=plen))
             pri = int(self._pri_vals[int(
                 rng.choice(len(self._pri_vals), p=self._pri_probs))])
+            extra = ()
+            if self._decoded:
+                # fixed draw count per candidate (kept or thinned,
+                # sampled or greedy) — the same invariant as above
+                u = float(rng.uniform())
+                temp = round(0.5 + 0.5 * float(rng.uniform()), 3)
+                tk = int(rng.choice([0, 8, 16]))
+                tp = float(rng.choice([1.0, 0.95, 0.9]))
+                sd = int(rng.randint(0, 2 ** 31 - 1))
+                if u >= self.sample_frac:
+                    temp, tk, tp, sd = 0.0, 0, 1.0, 0
+                ten = ""
+                if self._tenant_vals:
+                    ten = self._tenant_vals[int(rng.choice(
+                        len(self._tenant_vals), p=self._tenant_probs))]
+                extra = (temp, tk, tp, sd, ten)
             if keep:
-                out.append(Arrival(round(t, 9), prompt, mnt, pri))
+                out.append(Arrival(round(t, 9), prompt, mnt, pri,
+                                   *extra))
         self._schedule = out
         return out
 
     def trace_bytes(self) -> bytes:
         """Canonical JSON of the arrival schedule — the byte-identity
         surface of the determinism contract."""
+        rows = []
+        for a in self.schedule():
+            row = [a.t, list(a.prompt), a.max_new_tokens, a.priority]
+            if self._decoded:   # decode-bearing rows carry 5 more
+                row += [a.temperature, a.top_k, a.top_p, a.seed,
+                        a.tenant]
+            rows.append(row)
         payload = {
             "mode": self.mode, "rate": self.rate,
             "duration": self.duration, "seed": self.seed,
-            "arrivals": [[a.t, list(a.prompt), a.max_new_tokens,
-                          a.priority] for a in self.schedule()],
+            "arrivals": rows,
         }
         return json.dumps(payload, sort_keys=True,
                           separators=(",", ":")).encode()
@@ -306,7 +374,9 @@ class LoadGen:
         arrivals = self.schedule()
         records = [{"i": i, "t": a.t, "prompt_tokens": len(a.prompt),
                     "max_new_tokens": a.max_new_tokens,
-                    "priority": a.priority, "outcome": None,
+                    "priority": a.priority,
+                    "sampled": a.temperature > 0,
+                    "tenant": a.tenant, "outcome": None,
                     "reason": None, "req": None}
                    for i, a in enumerate(arrivals)]
         from paddle_tpu.serving import QueueFullError
@@ -319,10 +389,16 @@ class LoadGen:
 
         def release(rec, arr):
             nonlocal exceptions
+            kw = {}
+            if arr.temperature > 0:   # sampled row: full decode params
+                kw.update(temperature=arr.temperature, top_k=arr.top_k,
+                          top_p=arr.top_p, seed=arr.seed)
+            if arr.tenant:
+                kw["tenant"] = arr.tenant
             try:
                 rec["req"] = target.submit(
                     list(arr.prompt), max_new_tokens=arr.max_new_tokens,
-                    priority=arr.priority)
+                    priority=arr.priority, **kw)
                 rec["outcome"] = "admitted"
             except QueueFullError as e:
                 rec["outcome"] = "rejected"
@@ -369,7 +445,14 @@ class LoadGen:
         decisions: List[List] = []
         ttfts, tpots = [], []
         completed = slo_met = slo_known = 0
+        per_tenant: dict = {}
         for rec in records:
+            tstats = per_tenant.setdefault(
+                rec["tenant"] or "base",
+                {"offered": 0, "completed": 0, "sampled": 0,
+                 "slo_met": 0, "_slo_known": 0})
+            tstats["offered"] += 1
+            tstats["sampled"] += int(rec["sampled"])
             req = rec.pop("req")
             if req is not None:
                 rec["outcome"] = ("done" if req.state == "done"
@@ -385,6 +468,7 @@ class LoadGen:
                 rec["deadline_met"] = met
                 if req.state == "done":
                     completed += 1
+                    tstats["completed"] += 1
                     if req.ttft is not None:
                         ttfts.append(req.ttft * 1e3)
                     if req.tpot is not None:
@@ -392,6 +476,8 @@ class LoadGen:
                     if met is not None:
                         slo_known += 1
                         slo_met += int(met)
+                        tstats["_slo_known"] += 1
+                        tstats["slo_met"] += int(met)
             if rec["outcome"] in ("shed", "rejected"):
                 key = rec["reason"] or "unknown"
                 shed[key] = shed.get(key, 0) + 1
@@ -443,6 +529,25 @@ class LoadGen:
             "leaked_kv_blocks": leaked,
             "decisions": decisions,
         }
+        if self._decoded:
+            # per-tenant goodput: who got served, who met the SLO,
+            # straight from the loadgen's own records (the target's
+            # stats()["tenants"] view must agree — CI cross-checks)
+            for name, ts in per_tenant.items():
+                known = ts.pop("_slo_known")
+                ts["slo_attainment"] = (round(ts["slo_met"] / known, 4)
+                                        if known else None)
+                ts["goodput_per_s"] = (round(ts["slo_met"] / makespan, 4)
+                                       if known else None)
+            report["per_tenant"] = dict(sorted(per_tenant.items()))
+            leaked_pages = 0
+            seen_pools = set()
+            for eng in self._engines(target):
+                pool = getattr(eng, "lora_pool", None)
+                if pool is not None and id(pool) not in seen_pools:
+                    seen_pools.add(id(pool))
+                    leaked_pages += pool.leaked()
+            report["leaked_lora_pages"] = leaked_pages
         stats = getattr(target, "stats", None)
         st = stats() if callable(stats) else {}
         if "prefill_workers" in st:
@@ -501,6 +606,16 @@ def _parse_mix(text: str) -> Optional[dict]:
     return out
 
 
+def _parse_tenant_mix(text: str) -> Optional[dict]:
+    if not text:
+        return None
+    out = {}
+    for part in text.split(","):
+        k, v = part.split(":")
+        out[str(k)] = float(v)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="open-loop load generator for the serving plane")
@@ -525,6 +640,19 @@ def main(argv=None) -> int:
     ap.add_argument("--priority-mix", type=_parse_mix, default=None,
                     metavar="P:W,P:W", help="priority class weights, "
                     "e.g. '0:0.1,1:0.8,2:0.1' (lower = more urgent)")
+    ap.add_argument("--sample-frac", type=float, default=0.0,
+                    help="fraction of arrivals carrying sampled decode "
+                    "params (seeded temperature/top-k/top-p); the rest "
+                    "stay greedy")
+    ap.add_argument("--tenant-mix", type=_parse_tenant_mix,
+                    default=None, metavar="NAME:W,NAME:W",
+                    help="multi-tenant LoRA mix, e.g. "
+                    "'base:0.5,acme:0.3,zeta:0.2' ('base' = no "
+                    "adapter); non-base tenants need --lora-rank")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="> 0 builds the paged LoRA adapter pool "
+                    "(FLAGS_serving_lora_rank) and loads one seeded "
+                    "adapter per non-base tenant in --tenant-mix")
     ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
                     help="> 0 turns on SLO-aware admission; also the "
                     "goodput SLO for reporting")
@@ -566,7 +694,12 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-goodput-min", type=float, default=None,
                     help="exit 1 unless goodput_per_s >= this")
     ap.add_argument("--expect-zero-leaks", action="store_true",
-                    help="exit 1 unless leaked_kv_blocks == 0")
+                    help="exit 1 unless leaked_kv_blocks == 0 (and "
+                    "leaked_lora_pages == 0 when LoRA is on)")
+    ap.add_argument("--expect-zero-new-compiles", action="store_true",
+                    help="exit 1 if any serving/decode/verify step "
+                    "compiled after warmup — the sampling-as-data / "
+                    "paged-LoRA contract under mixed traffic")
     ap.add_argument("--expect-sheds-min", type=int, default=None,
                     help="exit 1 unless shed_total >= this (chaos runs "
                     "must actually shed)")
@@ -595,7 +728,20 @@ def main(argv=None) -> int:
                      vocab_size=cfg.vocab_size,
                      prompt_tokens=args.prompt_tokens,
                      new_tokens=args.new_tokens,
-                     priority_mix=args.priority_mix)
+                     priority_mix=args.priority_mix,
+                     sample_frac=args.sample_frac,
+                     tenant_mix=args.tenant_mix)
+    lora_tenants = sorted(t for t in (args.tenant_mix or {})
+                          if t not in ("", "base"))
+    if lora_tenants and args.lora_rank <= 0:
+        print("FAIL: --tenant-mix names non-base tenants; they need "
+              "--lora-rank > 0", file=sys.stderr)
+        return 1
+    if args.lora_rank > 0:
+        from paddle_tpu import flags as _fl
+        _fl.set_flags({"serving_lora_rank": args.lora_rank,
+                       "serving_lora_max_adapters":
+                           max(len(lora_tenants), 1)})
     vc = (VirtualClock() if args.virtual_step_ms > 0 else None)
     eng_kwargs = dict(
         max_slots=args.slots, max_len=args.max_len,
@@ -624,12 +770,27 @@ def main(argv=None) -> int:
                 **eng_kwargs)
         else:
             target = ServingEngine(model, **eng_kwargs)
+        if lora_tenants:
+            # one seeded adapter per named tenant, loaded before any
+            # traffic — a pure pool write, zero new compiles
+            from paddle_tpu.serving import make_adapter
+            for i, name in enumerate(lora_tenants):
+                target.load_adapter(
+                    name, make_adapter(cfg, args.lora_rank, seed=i + 1))
         if not args.no_warmup:
             warmup(target)
+        from paddle_tpu import observability as _obs
+        _SERVING = ("serving_", "decode_", "verify_")
+        base_compiles = {k: v["count"] for k, v in _obs.compiles().items()
+                        if k.startswith(_SERVING)}
         report = lg.run(target, clock=vc,
                         step_cost_ms=args.virtual_step_ms,
                         slo_ttft_ms=args.slo_ttft_ms or None,
                         include_trace=bool(args.trace))
+        report["new_compiles_after_warmup"] = sum(
+            v["count"] - base_compiles.get(k, 0)
+            for k, v in _obs.compiles().items()
+            if k.startswith(_SERVING))
     trace = report.pop("trace", None)
     if args.trace:
         with open(args.trace, "w") as f:
@@ -651,6 +812,15 @@ def main(argv=None) -> int:
     if args.expect_zero_leaks and report["leaked_kv_blocks"] != 0:
         print(f"FAIL: leaked_kv_blocks = "
               f"{report['leaked_kv_blocks']}", file=sys.stderr)
+        ok = False
+    if args.expect_zero_leaks and report.get("leaked_lora_pages"):
+        print(f"FAIL: leaked_lora_pages = "
+              f"{report['leaked_lora_pages']}", file=sys.stderr)
+        ok = False
+    if args.expect_zero_new_compiles and \
+            report["new_compiles_after_warmup"] != 0:
+        print(f"FAIL: new_compiles_after_warmup = "
+              f"{report['new_compiles_after_warmup']}", file=sys.stderr)
         ok = False
     if args.expect_sheds_min is not None and \
             report["shed_total"] < args.expect_sheds_min:

@@ -34,6 +34,14 @@ Asserts, end to end through the observability plane:
     loadgen engine adds zero compiles, decodes the new weights'
     greedy tokens, and matches the predictor's ``weight_swaps``
     no-op claim;
+  - mixed greedy / sampled / JSON-constrained / two-tenant-LoRA
+    traffic on one engine (pool geometry via set_flags = one fresh
+    phase like pallas+int8): the json_mode row decodes to valid JSON,
+    tenants diverge from base, a mid-flight ``load_adapter`` and the
+    whole second wave add ZERO compiles, the per-phase compile delta
+    equals the predictor's claim (``sampling`` recipes are validated
+    no-ops, ``lora`` geometry is one retrace), and neither KV blocks
+    nor adapter pages leak;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
     int8-quantization and SLO-admission metrics;
@@ -378,6 +386,86 @@ def main() -> int:
     print(f"   hot swap: v{version} live, tokens match the new "
           f"weights, 0 new compiles (predicted == observed)")
 
+    # -- decoding phase: sampling-as-data + multi-tenant paged LoRA ---
+    # set_flags bumps the flags version (like the pallas phase) and the
+    # adapter pool joins the step cache key, so the lora-shaped steps
+    # retrace exactly once; after that first wave, mixed greedy /
+    # sampled / json-constrained / multi-tenant traffic — including a
+    # mid-flight load_adapter — must never move the tracker again, and
+    # the predictor must agree sampling recipes are no-ops while the
+    # lora geometry is one fresh phase.
+    from paddle_tpu.serving import (JsonGrammar, json_token_strings,
+                                    make_adapter)
+    grammar = JsonGrammar(json_token_strings(97))
+    # fresh baseline: the hot-swap phase's offline greedy reference
+    # traced the dense decode_step after its own snapshot
+    base8 = {site: c["count"]
+             for site, c in observability.compiles().items()
+             if site.startswith(("serving_", "decode_", "verify_"))}
+    pt.set_flags({"serving_lora_rank": 2,
+                  "serving_lora_max_adapters": 2})
+    try:
+        eng8 = ServingEngine(model, max_slots=3, max_len=32,
+                             buckets=[8, 16], max_queue=16,
+                             block_size=4, grammar=grammar)
+        eng8.load_adapter("acme", make_adapter(cfg, 2, seed=1,
+                                               scale=0.5))
+        r_base = eng8.submit(prompts[2], max_new_tokens=4)
+        r_samp = eng8.submit(prompts[1], max_new_tokens=4,
+                             temperature=0.9, top_k=8, seed=11)
+        r_acme = eng8.submit(prompts[2], max_new_tokens=4,
+                             tenant="acme")
+        eng8.run_until_idle()
+        assert r_acme.output_ids != r_base.output_ids, (
+            "tenant adapter did not change the decode")
+        wave1 = {site: c["count"]
+                 for site, c in observability.compiles().items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+        eng8.load_adapter("zeta", make_adapter(cfg, 2, seed=2,
+                                               scale=0.5))
+        r_json = eng8.submit(prompts[0], max_new_tokens=8,
+                             json_mode=True)
+        r_zeta = eng8.submit(prompts[2], max_new_tokens=4,
+                             tenant="zeta")
+        eng8.run_until_idle()
+        doc = grammar.decode(r_json.tokens)
+        json.loads(doc)   # valid JSON by construction
+        assert r_zeta.output_ids != r_acme.output_ids, (
+            "tenants decoded identically")
+        wave2 = {site: c["count"]
+                 for site, c in observability.compiles().items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+        assert wave2 == wave1, (
+            f"mixed decode traffic + adapter load must add ZERO "
+            f"compiles:\n  before {wave1}\n  after  {wave2}")
+        delta8 = {site: n - base8.get(site, 0)
+                  for site, n in wave2.items()
+                  if n - base8.get(site, 0)}
+        workload8 = [[(prompts[2], 4), (prompts[1], 4),
+                      (prompts[2], 4)],
+                     [(prompts[0], 8), (prompts[2], 4)]]
+        predicted8 = predict_serving_compiles(
+            workload8, buckets=[8, 16], max_len=32, block_size=4,
+            sampling=[(0.9, 8, 1.0)], lora=(2, 2))
+        assert delta8 == predicted8, (
+            f"decoding-phase recompile prediction drifted:\n"
+            f"  predicted {predicted8}\n  observed  {delta8}")
+        st8 = eng8.stats()
+        assert set(st8["lora"]["loaded"]) == {"acme", "zeta"}, \
+            st8["lora"]
+        assert st8["lora"]["leaked_pages"] == 0, st8["lora"]
+        assert st8["json_grammar"] is True, st8
+        assert set(st8["tenants"]) == {"base", "acme", "zeta"}, (
+            st8["tenants"])
+        assert eng8.lora_pool.leaked() == 0
+        eng8.cache.flush_prefix_cache()
+        assert eng8.cache.allocator.leaked() == 1   # trash block only
+        print(f"   decoding: sampled/json/2-tenant mix on one engine, "
+              f"json doc {doc!r} valid, 0 new compiles after the lora "
+              f"phase ({delta8} == predicted)")
+    finally:
+        pt.set_flags({"serving_lora_rank": 0})
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -401,7 +489,9 @@ def main() -> int:
                    "serving_weight_version",
                    "serving_prefix_affinity_hits",
                    "serving_handoff_queue_depth",
-                   "serving_disagg_workers"):
+                   "serving_disagg_workers",
+                   "serving_lora_adapters_loaded",
+                   "STAT_serving_lora_loads"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 
@@ -414,7 +504,8 @@ def main() -> int:
             kinds.add(json.loads(line)["kind"])
     for k in ("train_step", "guardian_skip", "fault_injected",
               "serving_admit", "serving_finish", "serving_weight_swap",
-              "serving_request", "serving_handoff"):
+              "serving_request", "serving_handoff",
+              "serving_lora_load"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])

@@ -439,6 +439,53 @@ def render_serving_block():
         "against a symmetric router at equal worker count (TTFT p95 +",
         "goodput; the win is gated on real TPU hardware).",
         "",
+        "Decoding is per-request *data* on the same compiled engine.",
+        "Every `submit()` (and `POST /v1/generate`) accepts",
+        "`temperature` / `top_k` / `top_p` / `stop` / `seed` /",
+        "`json_mode` — a `serving.DecodeParams` per request — and the",
+        "engine batches them into fixed-shape per-slot tensors fed to",
+        "the jitted steps as plain inputs, so greedy, sampled and",
+        "constrained rows mix freely in one batch of one executable:",
+        "zero new compiles, an invariant",
+        "`predict_serving_compiles(sampling=...)` encodes and CI",
+        "asserts. Per-request `jax.random` keys derive from the seed",
+        "alone and advance functionally inside the step (fixed fan-out",
+        "per row per step), so sampled output is a pure function of",
+        "the request — engine restarts, replica routing and the",
+        "disaggregated fleet replay the same bytes, and `temperature",
+        "0` rows stay bit-identical to the pre-sampling engine.",
+        "Speculative decoding verifies sampled rows by rejection",
+        "sampling: the committed-token law matches non-speculative",
+        "sampling exactly (greedy rows keep the prefix-match rule,",
+        "token-identical). `json_mode` is constrained decoding:",
+        "construct the engine with a `serving.JsonGrammar` (a",
+        "char-level pushdown over an explicit id -> string token",
+        "table; `json_token_strings(vocab)` is the canonical one) and",
+        "masked rows emit syntactically valid JSON by construction —",
+        "the budget-aware mask only opens transitions completable",
+        "within the request's remaining tokens. Multi-tenant LoRA",
+        "applies the block-table trick to weights:",
+        "`FLAGS_serving_lora_rank` > 0 builds a paged",
+        "`serving.LoRAPool` of per-tenant low-rank adapter factors",
+        "(page 0 = base, all-zero), requests name a `tenant`, and the",
+        "per-slot page ids plus the pool arrays ride the compiled",
+        "steps as two more plain inputs — per-row adapter deltas are",
+        "gathered inside the step, so tenants share one engine, one",
+        "KV pool and one executable. `load_adapter()` /",
+        "`evict_adapter()` are functional pool writes at runtime",
+        "(eviction refuses while a tenant has in-flight requests;",
+        "`leaked()` must be zero after drain, chaos included);",
+        "routers auto-create one shared pool across replicas and",
+        "roles, resolving tenants by name so page ids never travel.",
+        "`engine.stats()` reports per-tenant goodput under `tenants`",
+        "and the adapter roster under `lora`; `GET /metrics` grows",
+        "the `serving_lora_adapters_loaded` gauge; the run log",
+        "records `serving_lora_load` events; and",
+        "`tools/loadgen.py --tenant-mix base:0.5,acme:0.3,zeta:0.2",
+        "--sample-frac 0.5 --lora-rank 2` drives the mixed-tenant",
+        "sampled workload with per-tenant goodput in the report and a",
+        "`--expect-zero-new-compiles` gate.",
+        "",
         "Flags:",
         "",
     ]
