@@ -131,6 +131,9 @@ def predict_serving_compiles(
         priority_classes: Optional[Sequence[int]] = None,
         autoscale: Optional[Tuple[int, int]] = None,
         weight_swaps: int = 0,
+        replica_kills: int = 0,
+        restarts: int = 0,
+        rehomed: int = 0,
         disagg: Optional[Tuple[int, int]] = None,
         sampling: Optional[Sequence[Tuple[float, int, float]]] = None,
         lora: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
@@ -196,6 +199,22 @@ def predict_serving_compiles(
     the weights as explicit jit inputs with an unchanged abstract
     shape/dtype/sharding signature, so N live hot-swaps trace nothing —
     the train→serve loop's zero-new-compiles contract, statically.
+
+    ``replica_kills`` / ``restarts`` / ``rehomed`` (the fault-
+    tolerance plane: ``ReplicaRouter.kill_replica`` /
+    ``restart_replica`` calls and requests re-homed off dead
+    replicas/workers anywhere in the workload) are validated no-ops
+    for three distinct reasons, all load-bearing: a *kill* is pure
+    host-side teardown (rows released, queue re-routed — nothing
+    traces); a *restart* builds the replacement engine against the
+    same model at the same geometry, so every step it will ever run
+    is already in the unified per-model step cache; and a *re-homed*
+    request re-prefills its committed context on the survivor — the
+    adoption path refuses any context longer than the largest bucket
+    (the router sheds it instead), so re-homing can only ever hit
+    buckets ``warmup()`` already compiled, never widen the surface.
+    N kill/restart/re-home cycles therefore predict the same counts
+    as zero — the soak harness's degradation contract, statically.
 
     ``disagg`` (``FLAGS_serving_disagg``: a ``(n_prefill, n_decode)``
     disaggregated fleet behind a ``DisaggRouter``) is the newest
@@ -272,6 +291,10 @@ def predict_serving_compiles(
     if int(weight_swaps) < 0:
         raise ValueError(
             f"weight_swaps must be >= 0, got {weight_swaps}")
+    for val, name in ((replica_kills, "replica_kills"),
+                      (restarts, "restarts"), (rehomed, "rehomed")):
+        if int(val) < 0:
+            raise ValueError(f"{name} must be >= 0, got {val}")
     if disagg is not None:
         p, d = (int(n) for n in disagg)
         if p < 1 or d < 1:

@@ -376,6 +376,23 @@ define_flag("serving_lora_max_adapters", 4,
             "an adapter is evicted; eviction refuses while in-flight "
             "requests still pin the page (the KV-block refcount "
             "discipline applied to weights).")
+define_flag("serving_replica_strikes", 3,
+            "ReplicaRouter failure detection: consecutive unproductive "
+            "steps (a step() that raised, or did no work while the "
+            "replica held queued/active requests) before a replica is "
+            "declared dead. One strike marks it suspect (deprioritized "
+            "in routing); reaching the limit marks it dead — excluded "
+            "from routing and, under serving_auto_restart, replaced. "
+            "A productive step clears the strikes.")
+define_flag("serving_auto_restart", True,
+            "ReplicaRouter recovery policy: when a replica is declared "
+            "dead (strike watchdog or a serving.replica `error`/`drop` "
+            "fault), spawn a same-geometry replacement before tearing "
+            "the dead one down — queued work re-homes onto live peers, "
+            "in-flight decodes re-prefill from their committed tokens, "
+            "and the replacement reuses the compiled steps (zero new "
+            "XLA compiles). False leaves the fleet one replica "
+            "smaller (kill without restart).")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

@@ -82,6 +82,16 @@ INSTRUMENT_DOCS = {
     "serving_disagg_workers{router=..., role=...}":
         "gauge — single-role workers in a disaggregated fleet, by "
         "role (prefill | decode)",
+    "serving_replica_state{router=..., replica=..., state=...}":
+        "gauge — 1 on a replica's current health-state series "
+        "(healthy | suspect | dead | recovering), 0 on the others; "
+        "driven by the per-replica strike watchdog "
+        "(FLAGS_serving_replica_strikes)",
+    "serving_rehomed_total{router=...}":
+        "counter — requests recovered off a killed replica/worker "
+        "onto a live peer (queued re-routes + in-flight re-prefills "
+        "and block-table splices); the third term of the accounting "
+        "identity completed + shed + rehomed == offered",
     "zero_param_bytes_per_device{stage=...} / "
     "zero_opt_bytes_per_device{stage=...}":
         "gauges — max over devices of resident parameter / "
@@ -161,6 +171,17 @@ EVENT_DOCS = {
     "serving_worker_kill": "DisaggRouter tore a worker down (role, "
                            "worker, shed, rerouted) — the chaos "
                            "teardown path, leak-free by contract",
+    "serving_replica_kill": "ReplicaRouter lost a replica (replica, t, "
+                            "rehomed, shed, replicas_left, cause: "
+                            "kill | strikes | fault) — queued work "
+                            "re-homed, in-flight decodes re-prefill "
+                            "from committed tokens on a survivor; the "
+                            "replayable half of a chaos schedule",
+    "serving_replica_recover": "ReplicaRouter brought a replacement "
+                               "replica up (replica, t, restarts) — "
+                               "same geometry, so recovery reuses the "
+                               "compiled steps (zero new XLA "
+                               "compiles)",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

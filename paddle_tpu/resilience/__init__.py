@@ -22,7 +22,7 @@ survival.
 from .injector import (FAULT_SITE_DOCS, FAULT_SITES, FaultInjector,
                        InjectedDrop, InjectedFault, InjectedIOError,
                        InjectedPreemption, fault_point, fault_scope,
-                       injector_active)
+                       injector_active, set_time_source)
 from .retry import RetryError, RetryPolicy
 from .guardian import TrainGuardian
 
@@ -30,5 +30,5 @@ __all__ = [
     "FAULT_SITE_DOCS", "FAULT_SITES", "FaultInjector", "InjectedDrop",
     "InjectedFault", "InjectedIOError", "InjectedPreemption", "RetryError",
     "RetryPolicy", "TrainGuardian", "fault_point", "fault_scope",
-    "injector_active",
+    "injector_active", "set_time_source",
 ]

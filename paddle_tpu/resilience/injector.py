@@ -23,6 +23,15 @@ Triggers (all deterministic):
 - ``@p`` (float in (0, 1), written with a dot) — fire with probability
   p from a PRNG seeded by (``FLAGS_fault_seed``, site, rule index):
   the same spec + seed always drops the same calls in the same order.
+- ``@t>Ns`` — fire exactly once, on the first evaluation after N
+  seconds of injector time have elapsed (``@t>Ns+`` fires on every
+  evaluation after). Injector time is read from the clock installed
+  via :func:`set_time_source` — ``tools/soak.py`` installs its
+  ``VirtualClock`` so a kill schedule like
+  ``serving.replica:error@t>2400s`` replays byte-identically from a
+  seed, hours of simulated fleet time in seconds. The epoch is
+  snapshotted when the injector is built (``fault_scope`` entry), so
+  triggers measure time *into the scenario*, not process uptime.
 
 Kinds:
 
@@ -46,7 +55,8 @@ from __future__ import annotations
 import os
 import random
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import flags as _flags
 from .. import monitor as _monitor
@@ -97,6 +107,14 @@ FAULT_SITE_DOCS: Dict[str, str] = {
                        "`skip` and retry exhaustion shed that request "
                        "with every block reference released (the "
                        "leak-free teardown the chaos suite asserts)",
+    "serving.replica": "ReplicaRouter fleet supervisor, once per "
+                       "router step — `error`/`drop` crash one replica "
+                       "(round-robin victim) and restart it through "
+                       "kill_replica/restart_replica with in-flight "
+                       "work re-homed; `skip` kills without the "
+                       "restart (permanent capacity loss). Pair with "
+                       "@t>Ns virtual-time triggers for seeded soak "
+                       "kill schedules",
 }
 FAULT_SITES: Tuple[str, ...] = tuple(FAULT_SITE_DOCS)
 
@@ -131,19 +149,21 @@ class InjectedPreemption(SystemExit):
 
 
 class _Rule:
-    __slots__ = ("site", "kind", "trigger", "count", "rng")
+    __slots__ = ("site", "kind", "trigger", "count", "rng", "time_fired")
 
     def __init__(self, site: str, kind: str, trigger, index: int,
                  seed: int):
         self.site = site
         self.kind = kind
-        self.trigger = trigger  # None | int | (int, "+") | float
+        # None | int | (int, "+") | float | ("t>", seconds, ""|"+")
+        self.trigger = trigger
         self.count = 0
+        self.time_fired = False
         # per-rule stream: determinism survives rule reordering of
         # OTHER sites and doesn't couple unrelated probability draws
         self.rng = random.Random(f"{seed}:{site}:{index}:{kind}")
 
-    def fires(self) -> bool:
+    def fires(self, elapsed: float = 0.0) -> bool:
         n = self.count
         self.count += 1
         t = self.trigger
@@ -152,11 +172,35 @@ class _Rule:
         if isinstance(t, float):
             return self.rng.random() < t
         if isinstance(t, tuple):
+            if t[0] == "t>":
+                if elapsed <= t[1]:
+                    return False
+                if t[2] == "+":
+                    return True
+                if self.time_fired:
+                    return False
+                self.time_fired = True
+                return True
             return n >= t[0]
         return n == t
 
 
 def _parse_trigger(text: str):
+    if text.startswith("t>"):
+        body = text[2:]
+        plus = body.endswith("+")
+        if plus:
+            body = body[:-1]
+        if not body.endswith("s") or len(body) < 2:
+            raise ValueError(
+                f"virtual-time trigger must look like t>300s or "
+                f"t>300s+, got {text!r}")
+        secs = float(body[:-1])
+        if secs < 0:
+            raise ValueError(
+                f"virtual-time trigger must be >= 0 seconds, got "
+                f"{text!r}")
+        return ("t>", secs, "+" if plus else "")
     if text.endswith("+"):
         return (int(text[:-1]), "+")
     if "." in text:
@@ -200,6 +244,20 @@ def parse_spec(spec: str, seed: int = 0) -> Dict[str, List[_Rule]]:
     return rules
 
 
+# Clock behind @t>Ns triggers. Module-level (not per-injector) so a
+# virtual clock installed by a harness survives the flag-version
+# rebuilds of the process-wide injector. None = time.monotonic.
+_time_source: Optional[Callable[[], float]] = None
+
+
+def set_time_source(fn: Optional[Callable[[], float]]):
+    """Install the clock @t>Ns triggers read (None restores
+    time.monotonic). Install *before* entering fault_scope / calling
+    reset() — the epoch is snapshotted when the injector is built."""
+    global _time_source
+    _time_source = fn
+
+
 class FaultInjector:
     """Holds the parsed spec + per-site call counters. One process-wide
     instance behind :func:`fault_point`; tests construct their own or
@@ -209,6 +267,8 @@ class FaultInjector:
         self.spec = spec
         self.rules = parse_spec(spec, seed)
         self._lock = threading.Lock()
+        self._now = _time_source or time.monotonic
+        self._t0 = self._now()
 
     @property
     def active(self) -> bool:
@@ -220,8 +280,9 @@ class FaultInjector:
         site_rules = self.rules.get(site)
         if not site_rules:
             return None
+        elapsed = self._now() - self._t0
         with self._lock:
-            fired = [r.kind for r in site_rules if r.fires()]
+            fired = [r.kind for r in site_rules if r.fires(elapsed)]
         from ..observability import runlog as _runlog
         for k in fired:
             _monitor.stat_add(f"STAT_fault_{site}")
@@ -293,17 +354,23 @@ def reset():
 class fault_scope:
     """``with fault_scope("exec.step:nan@3", seed=7): ...`` — install a
     spec for the duration of a test, restoring (and resetting counters)
-    on exit."""
+    on exit. ``time_source`` optionally installs the clock @t>Ns
+    triggers read for the scope (a soak passes its VirtualClock.now),
+    restored alongside the spec."""
 
-    def __init__(self, spec: str, seed: int = 0):
+    def __init__(self, spec: str, seed: int = 0, time_source=None):
         self.spec = spec
         self.seed = seed
+        self.time_source = time_source
 
     def __enter__(self):
         self._saved = {
             "fault_spec": _flags.get_flag("fault_spec"),
             "fault_seed": _flags.get_flag("fault_seed"),
         }
+        self._saved_source = _time_source
+        if self.time_source is not None:
+            set_time_source(self.time_source)
         _flags.set_flags({"fault_spec": self.spec,
                           "fault_seed": self.seed})
         reset()
@@ -311,5 +378,6 @@ class fault_scope:
 
     def __exit__(self, *exc):
         _flags.set_flags(self._saved)
+        set_time_source(self._saved_source)
         reset()
         return False

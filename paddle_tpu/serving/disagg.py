@@ -50,8 +50,15 @@ seeded workload (greedy argmax does not care which chip ran it).
 Chaos: ``kill_prefill_worker`` tears a prefill worker down mid-flight
 — queued requests re-route to surviving workers, in-flight prefills
 and undelivered handoff records shed with every block reference
-released — and the ``serving.handoff`` fault site injects drops at
-adoption time, retried via ``RetryPolicy.from_flags``.
+released — and ``kill_decode_worker`` does the same for the decode
+role: every in-flight decode's block table is exported off the dead
+worker and re-homed onto a survivor (``import_row`` splice when they
+share a pool, ``adopt_row`` copy + source-ref release otherwise), so
+generation continues token-identically where capacity allows. The
+``serving.handoff`` fault site injects drops at adoption time,
+retried via ``RetryPolicy.from_flags``, and handoff records that
+outlive their TTFT deadline in the queue are shed with their block
+references released instead of silently adopted.
 """
 
 from __future__ import annotations
@@ -309,6 +316,20 @@ class DecodeEngine(ServingEngine):
                 item = self._handoff.take(match)
                 if item is None:
                     break
+                if item.req.deadline is not None and \
+                        self._clock() > item.req.deadline:
+                    # a record that outlived its TTFT deadline in the
+                    # queue used to be adopted anyway — decode cycles
+                    # spent on a request the SLO already gave up on,
+                    # and its blocks pinned the whole time. Shed it
+                    # with the exported references released (the
+                    # record owns them until adoption; the LoRA pin
+                    # was already dropped at export)
+                    item.rec["pool"].release_blocks(item.rec["blocks"])
+                    self._shed(item.req, _Shed(
+                        "handoff outlived its TTFT deadline in the "
+                        "queue"), reason="deadline")
+                    continue
                 try:
                     row = RetryPolicy.from_flags(
                         "serving.handoff").call(
@@ -439,7 +460,8 @@ class DisaggRouter:
             self.decodes.append(
                 DecodeEngine(model, self._handoff, **kw))
         self.colocate = bool(colocate)
-        self._killed: List[PrefillEngine] = []
+        self._killed: List[ServingEngine] = []
+        self._rehomed = 0
         self._draining = False
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
@@ -465,6 +487,10 @@ class DisaggRouter:
             "their KV blocks (bounded; full = prefill backpressure)"
             ).labels(router=rid)
         self._handoff_gauge.set(0)
+        self._rehomed_counter = _obs.counter(
+            "serving_rehomed_total",
+            "requests recovered off a killed replica/worker onto a "
+            "live peer").labels(router=rid)
         _obs.gauge(
             "serving_disagg_workers",
             "single-role workers in this disaggregated fleet, by role"
@@ -764,9 +790,107 @@ class DisaggRouter:
         _monitor.stat_add("STAT_serving_worker_killed")
         _runlog.log_event("serving_worker_kill", role="prefill",
                           worker=index, shed=shed, rerouted=rerouted,
+                          t=round(eng._clock(), 6),
                           prefills_left=len(self.prefills))
         return {"shed": shed, "rerouted": rerouted,
                 "prefills_left": len(self.prefills)}
+
+    def kill_decode_worker(self, index: int) -> dict:
+        """Tear one decode worker down mid-decode (chaos): every
+        in-flight request's row leaves the dead worker as an
+        ownership-transfer record (``export_row``) and re-homes onto
+        a surviving decode worker — a free block-table splice when
+        they share a :class:`BlockPool` (co-located fleets), a block
+        copy through the survivor's allocator otherwise, after which
+        the source references drop. The request then continues
+        decoding token-identically (its RNG key, grammar cursor and
+        committed tokens travel on the Request). A row no survivor
+        has room for sheds with every reference released; LoRA pins
+        move with the request (released on the dead worker,
+        re-acquired by tenant name on the survivor). Refuses to kill
+        the last decode worker — the handoff queue would never drain
+        again. Returns the cleanup accounting."""
+        with self._lock:
+            if not 0 <= index < len(self.decodes):
+                raise IndexError(
+                    f"decode worker {index} out of range "
+                    f"(have {len(self.decodes)})")
+            if len(self.decodes) == 1:
+                raise ValueError(
+                    "cannot kill the last decode worker; the handoff "
+                    "queue would never drain")
+            eng = self.decodes.pop(index)
+            eng.draining = True
+            eng._health = "dead"
+            self._killed.append(eng)
+        rehomed = shed = 0
+        with eng._step_lock:
+            for row in sorted(eng._active,
+                              key=lambda r: eng._active[r].id):
+                req = eng._active.pop(row)
+                if req._lora_held:
+                    eng.lora_pool.release(req.tenant)
+                    req._lora_held = False
+                rec = eng.cache.export_row(row)
+                req.slot = None
+                # same-pool survivors first: those re-homes are free
+                # splices; within a class, least-loaded
+                order = sorted(
+                    self.decodes,
+                    key=lambda p: (
+                        0 if rec["pool"] is p.cache.pool else 1,
+                        self._depth(p), -self._blocks_free(p)))
+                handled = False
+                for peer in order:
+                    same_pool = rec["pool"] is peer.cache.pool
+                    row2 = (peer.cache.import_row(rec) if same_pool
+                            else peer.cache.adopt_row(rec))
+                    if row2 is None:
+                        continue
+                    if not same_pool:
+                        # the copy is done; drop the source references
+                        rec["pool"].release_blocks(rec["blocks"])
+                    handled = True
+                    if req.tenant and peer.lora_pool is not None:
+                        try:
+                            peer.lora_pool.acquire(req.tenant)
+                            req._lora_held = True
+                        except ValueError as e:
+                            peer.cache.release_row(row2)
+                            eng._shed(req, _Shed(str(e)))
+                            shed += 1
+                            break
+                    req.slot = row2
+                    peer._active[row2] = req
+                    req.rehomed = True
+                    rehomed += 1
+                    _monitor.stat_add("STAT_serving_rehomed")
+                    self._rehomed_counter.inc()
+                    if _runlog.enabled():
+                        _runlog.log_event(
+                            "serving_handoff", request=req.id,
+                            stage="adopt", engine=peer._eid,
+                            slot=row2, copied=not same_pool)
+                    break
+                if not handled:
+                    rec["pool"].release_blocks(rec["blocks"])
+                    eng._shed(req, QueueFullError(
+                        "no surviving decode worker could adopt the "
+                        "row", reason="drain"), reason="drain")
+                    shed += 1
+        # the dead worker's prefix-cache refs would read as leaks
+        # unless a live engine still shares (and thus owns) the pool
+        if not any(e.cache.pool is eng.cache.pool
+                   for e in self.prefills + self.decodes):
+            eng.cache.flush_prefix_cache()
+        self._rehomed += rehomed
+        _monitor.stat_add("STAT_serving_worker_killed")
+        _runlog.log_event("serving_worker_kill", role="decode",
+                          worker=index, shed=shed, rerouted=rehomed,
+                          t=round(eng._clock(), 6),
+                          decodes_left=len(self.decodes))
+        return {"rehomed": rehomed, "shed": shed,
+                "decodes_left": len(self.decodes)}
 
     # ---------------------------------------------------------- plumbing
     def swap_weights(self, state, *, reset_costs: bool = True
@@ -846,8 +970,11 @@ class DisaggRouter:
             pools[id(e.cache.pool)] = e.cache.pool
         hits = sum(p.prefix_hits for p in pools.values())
         misses = sum(p.prefix_misses for p in pools.values())
-        adopted = sum(d.adopted for d in self.decodes)
-        copies = sum(d.adopted_copies for d in self.decodes)
+        dead_decodes = [e for e in self._killed
+                        if isinstance(e, DecodeEngine)]
+        adopted = sum(d.adopted for d in self.decodes + dead_decodes)
+        copies = sum(d.adopted_copies
+                     for d in self.decodes + dead_decodes)
         tenants: dict = {}
         for e in engines:
             with e._lock:
@@ -875,6 +1002,7 @@ class DisaggRouter:
                 round(hits / (hits + misses), 4)
                 if hits + misses else None),
             "completed": completed,
+            "rehomed": self._rehomed,
             "shed": shed,
             "shed_total": sum(shed.values()),
             "queue_depths": [self._depth(e) for e in self.prefills],

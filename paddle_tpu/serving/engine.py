@@ -195,6 +195,7 @@ class Request:
         self._key = request_key(self.decode.seed)
         self._cursor = None        # JsonCursor when json_mode is on
         self._lora_held = False    # this request pins its tenant page
+        self.rehomed = False       # recovered from a killed replica
         self.tokens: List[int] = []
         self.state = "queued"
         self.slot: Optional[int] = None
@@ -209,6 +210,17 @@ class Request:
 
     @property
     def output_ids(self) -> List[int]:
+        return self.prompt + self.tokens
+
+    @property
+    def context(self) -> List[int]:
+        """The committed context — prompt plus generated-so-far. The
+        admit paths prefill over THIS (not the bare prompt), so a
+        request re-homed mid-decode from a killed replica resumes by
+        re-prefilling its committed tokens on the survivor: the next
+        argmax/sample is exactly what the dead replica's decode would
+        have produced. Fresh requests have no tokens, making this the
+        plain prompt (zero behavior change)."""
         return self.prompt + self.tokens
 
     @property
@@ -1037,6 +1049,12 @@ class ServingEngine:
         if len(req.prompt) + req.max_new_tokens + self.spec_tokens > \
                 self.max_len:
             return False  # peer geometry differs; not adoptable here
+        if req.tokens and len(req.context) > self.buckets[-1]:
+            # a re-homed mid-decode request re-prefills its committed
+            # context; one that outgrew the largest bucket would force
+            # a fresh compile, so it is not adoptable (the router sheds
+            # it) — re-homing never widens the compiled surface
+            return False
         with self._lock:
             if len(self._queue) >= self.max_queue:
                 return False
@@ -1112,8 +1130,9 @@ class ServingEngine:
         ids = np.zeros((self.max_slots, bucket), np.int32)
         last = np.zeros(self.max_slots, np.int32)
         for i, req in enumerate(live):
-            ids[i, :len(req.prompt)] = req.prompt
-            last[i] = len(req.prompt) - 1
+            ctx = req.context
+            ids[i, :len(ctx)] = ctx
+            last[i] = len(ctx) - 1
         fn = self._prefill_entry(bucket)["fn"]
         return live, shed, fn(jnp.asarray(ids), jnp.asarray(last))
 
@@ -1192,7 +1211,7 @@ class ServingEngine:
         if kind == "skip":
             raise _Shed("injected allocator failure for request "
                         f"{req.id}")
-        return self.cache.acquire(req.prompt, need)
+        return self.cache.acquire(req.context, need)
 
     def _prefill_group_attempt_paged(self, bucket: int, group):
         """One batched paged-prefill attempt for every same-bucket
@@ -1217,7 +1236,7 @@ class ServingEngine:
                          np.int32)
         pages = np.zeros(self.max_slots, np.int32)
         for i, (req, row, shared) in enumerate(live):
-            suffix = req.prompt[shared:]
+            suffix = req.context[shared:]
             ids[i, :len(suffix)] = suffix
             last[i] = len(suffix) - 1
             pos[i] = shared
@@ -1313,7 +1332,7 @@ class ServingEngine:
         for rec in acquired:
             req, row, shared = rec
             groups.setdefault(
-                self._bucket_for(len(req.prompt) - shared),
+                self._bucket_for(len(req.context) - shared),
                 []).append(rec)
         admitted = 0
         for bucket in sorted(groups):
@@ -1341,12 +1360,13 @@ class ServingEngine:
                 continue
             lg, pools, qerr = out
             self.cache.set_arrays(pools)
-            self._note_qerr(qerr, sum(len(req.prompt) - shared
+            self._note_qerr(qerr, sum(len(req.context) - shared
                                       for req, _, shared in live))
             first = np.asarray(jnp.argmax(lg, axis=-1))
             for i, (req, row, shared) in enumerate(live):
-                self.cache.commit_prefill(row, len(req.prompt))
-                self.cache.insert_prefix(row, req.prompt)
+                ctx = req.context
+                self.cache.commit_prefill(row, len(ctx))
+                self.cache.insert_prefix(row, ctx)
                 req.slot = row
                 req.state = "running"
                 self._active[row] = req
@@ -1377,7 +1397,7 @@ class ServingEngine:
             return expired, 0
         groups: Dict[int, List[Request]] = {}
         for req in candidates:
-            groups.setdefault(self._bucket_for(len(req.prompt)),
+            groups.setdefault(self._bucket_for(len(req.context)),
                               []).append(req)
         admitted = 0
         for bucket in sorted(groups):
@@ -1403,7 +1423,7 @@ class ServingEngine:
             lg, rows = out
             slots = [self.cache.alloc() for _ in live]
             self.cache.write_prefill_batch(
-                slots, rows, [len(r.prompt) for r in live])
+                slots, rows, [len(r.context) for r in live])
             first = np.asarray(jnp.argmax(lg, axis=-1))
             for i, (req, slot) in enumerate(zip(live, slots)):
                 req.slot = slot
@@ -1433,7 +1453,8 @@ class ServingEngine:
             return int(first[i])
         mask_row = None
         if req._cursor is not None:
-            mask_row = req._cursor.mask_row(req.max_new_tokens)
+            mask_row = req._cursor.mask_row(
+                req.max_new_tokens - len(req.tokens))
         tok, req._key = sample_first(np.asarray(lg[i]), p, req._key,
                                      mask_row)
         return tok

@@ -49,9 +49,16 @@ from typing import List, Optional, Sequence
 from .. import monitor as _monitor
 from .. import observability as _obs
 from ..observability import runlog as _runlog
-from ..resilience.injector import fault_point
+from ..resilience.injector import InjectedFault, fault_point
 from ..resilience.retry import RetryError, RetryPolicy
 from .engine import QueueFullError, Request, ServingEngine
+
+#: per-replica health states (the serving_replica_state gauge family)
+HEALTH_STATES = ("healthy", "suspect", "dead", "recovering")
+
+#: routing preference per state: healthy/recovering route normally,
+#: suspect only when nothing healthier has room, dead never
+_HEALTH_RANK = {"healthy": 0, "recovering": 0, "suspect": 1, "dead": 2}
 
 
 def _parse_autoscale(text: str):
@@ -147,7 +154,11 @@ class ReplicaRouter:
                  engines: Optional[Sequence[ServingEngine]] = None,
                  autoscale=None, **engine_kwargs):
         from .. import flags as _flags
-        g = _flags.get_flags(["serving_replicas", "serving_autoscale"])
+        g = _flags.get_flags(["serving_replicas", "serving_autoscale",
+                              "serving_replica_strikes",
+                              "serving_auto_restart"])
+        self._strike_limit = max(1, int(g["serving_replica_strikes"]))
+        self._auto_restart = bool(g["serving_auto_restart"])
         if autoscale is None:
             bounds = _parse_autoscale(g["serving_autoscale"])
             if bounds is not None:
@@ -205,8 +216,18 @@ class ReplicaRouter:
         self._scale_ups = 0
         self._scale_downs = 0
         self._steps_since_scale = 0
+        self._kills = 0
+        self._restarts = 0
+        self._rehomed = 0
+        self._victim_rr = 0   # serving.replica round-robin victim
         rid = str(next(ReplicaRouter._router_ids))
         self._rid = rid
+        for eng in self.engines:
+            self._init_health(eng)
+        self._rehomed_counter = _obs.counter(
+            "serving_rehomed_total",
+            "requests recovered off a killed replica onto a live peer"
+            ).labels(router=rid)
         self._replicas_gauge = _obs.gauge(
             "serving_replicas",
             "data-parallel engine replicas behind this ReplicaRouter"
@@ -219,6 +240,89 @@ class ReplicaRouter:
                 ).labels(router=rid, replica=str(i))
             for i in range(len(self.engines))]
         self._update_depth_gauges()
+        self._update_state_gauges()
+
+    # ------------------------------------------------------------ health
+    @staticmethod
+    def _init_health(eng: ServingEngine):
+        eng._health = "healthy"
+        eng._strikes = 0
+
+    def _update_state_gauges(self):
+        for i, eng in enumerate(self.engines):
+            for state in HEALTH_STATES:
+                _obs.gauge(
+                    "serving_replica_state",
+                    "1 on a replica's current health-state series "
+                    "(healthy | suspect | dead | recovering)"
+                    ).labels(router=self._rid, replica=str(i),
+                             state=state).set(
+                        1 if eng._health == state else 0)
+
+    def _step_replica(self, eng: ServingEngine) -> bool:
+        """One supervised step: an exception, or no progress while the
+        replica holds work, is a strike; strikes mark it suspect and —
+        at FLAGS_serving_replica_strikes — dead. A productive step
+        clears the strikes (and graduates a recovering replacement to
+        healthy)."""
+        try:
+            worked = eng.step()
+        except Exception:
+            worked = False
+            eng._strikes += 1
+        else:
+            if worked:
+                eng._strikes = 0
+                if eng._health in ("suspect", "recovering"):
+                    eng._health = "healthy"
+            elif self._depth(eng) > 0:
+                eng._strikes += 1
+        if eng._strikes >= self._strike_limit:
+            eng._health = "dead"
+        elif eng._strikes >= 1 and eng._health == "healthy":
+            eng._health = "suspect"
+        return worked
+
+    def _reap_dead(self):
+        """Tear down replicas the watchdog declared dead: restart them
+        under FLAGS_serving_auto_restart (model= construction), kill
+        them outright otherwise. The last replica is never torn down
+        without a replacement — a fleet of zero serves nobody."""
+        for eng in [e for e in list(self.engines)
+                    if e._health == "dead"]:
+            if eng not in self.engines:
+                continue
+            idx = self.engines.index(eng)
+            if self._auto_restart and self._model is not None:
+                self.restart_replica(idx, cause="strikes")
+            elif len(self.engines) > 1:
+                self.kill_replica(idx, cause="strikes")
+            else:
+                # can't restart (prebuilt engines) and can't lose the
+                # last replica: put it back on probation
+                eng._strikes = 0
+                eng._health = "suspect"
+
+    def _check_replica_fault(self):
+        """The serving.replica fault site, once per router step:
+        `error`/`drop` crash one replica (round-robin victim) and
+        recover it per the auto-restart policy; `skip` kills without
+        restart (permanent capacity loss, bounded at one replica)."""
+        action = None
+        try:
+            if fault_point("serving.replica") == "skip":
+                action = "kill"
+        except InjectedFault:
+            action = "crash"
+        if action is None:
+            return
+        victim = self._victim_rr % len(self.engines)
+        self._victim_rr += 1
+        if action == "crash" and self._auto_restart and \
+                self._model is not None:
+            self.restart_replica(victim, cause="fault")
+        elif len(self.engines) > 1:
+            self.kill_replica(victim, cause="fault")
 
     # ----------------------------------------------------------- routing
     def _depth(self, eng: ServingEngine) -> int:
@@ -267,16 +371,23 @@ class ReplicaRouter:
             raise QueueFullError(
                 "submission shed by injected fault at serving.route",
                 reason="fault")
-        # least-loaded: queue depth first (each queued request is a
-        # prefill ahead of yours -> the dominant TTFT term), free KV
-        # blocks as the tiebreak, lowest index last for determinism
+        # least-loaded among the healthiest: health rank first (suspect
+        # replicas only catch overflow, dead ones are skipped below),
+        # then queue depth (each queued request is a prefill ahead of
+        # yours -> the dominant TTFT term), free KV blocks as the
+        # tiebreak, lowest index last for determinism
         order = sorted(
             range(len(self.engines)),
-            key=lambda i: (self._depth(self.engines[i]),
+            key=lambda i: (_HEALTH_RANK[self.engines[i]._health],
+                           self._depth(self.engines[i]),
                            -self._blocks_free(self.engines[i]), i))
         last_err: Optional[QueueFullError] = None
         for i in order:
             eng = self.engines[i]
+            if eng._health == "dead":
+                last_err = QueueFullError(
+                    f"replica {i} is dead", reason="fault")
+                continue
             if getattr(eng, "draining", False):
                 # a draining replica sheds everything it's offered;
                 # skipping it here is what re-routes the request to a
@@ -370,6 +481,7 @@ class ReplicaRouter:
     # -------------------------------------------------------- autoscale
     def _add_replica(self):
         eng = ServingEngine(self._model, **self._engine_kwargs)
+        self._init_health(eng)
         self.engines.append(eng)
 
     def _maybe_autoscale(self):
@@ -407,15 +519,23 @@ class ReplicaRouter:
     # ---------------------------------------------------------- stepping
     def step(self) -> bool:
         """One scheduler iteration on every replica — retiring ones
-        included, so scale-down drains rather than sheds — then one
-        autoscale decision (deterministic test/benchmark path).
-        Returns whether any replica worked."""
+        included, so scale-down drains rather than sheds — under the
+        strike watchdog (an unproductive replica turns suspect, then
+        dead and torn down/replaced), then one autoscale decision
+        (deterministic test/benchmark path). Returns whether any
+        replica worked."""
+        self._check_replica_fault()
         worked = False
-        for eng in list(self.engines) + list(self._retiring):
+        for eng in list(self.engines):
+            if eng in self.engines:     # not torn down this iteration
+                worked = self._step_replica(eng) or worked
+        self._reap_dead()
+        for eng in list(self._retiring):
             worked = eng.step() or worked
         if self._autoscale is not None:
             self._maybe_autoscale()
         self._update_depth_gauges()
+        self._update_state_gauges()
         return worked
 
     @property
@@ -511,6 +631,121 @@ class ReplicaRouter:
                           replicas_left=len(self.engines))
         return moved
 
+    def kill_replica(self, index: int, cause: str = "kill") -> dict:
+        """Crash ONE replica (chaos / failure handling): unlike
+        :meth:`drain_replica` it does not get to finish in-flight
+        work. Its KV rows and LoRA pins are released on the spot (zero
+        leaks), queued requests re-home onto live peers through the
+        ``drain_replica`` adoption path, and in-flight decodes are
+        requeued *with their committed tokens*: the adopting survivor
+        re-prefills ``request.context`` and continues token-identically
+        (greedy) / law-identically (sampled — the per-request RNG key
+        travels with the request). Requests no live peer can adopt are
+        shed. Every recovered request is marked ``rehomed`` — the third
+        term of ``completed + shed + rehomed == offered``. Returns
+        ``{"rehomed", "shed", "replicas_left"}``."""
+        with self._lock:
+            if not 0 <= index < len(self.engines):
+                raise IndexError(
+                    f"replica index {index} out of range "
+                    f"(have {len(self.engines)})")
+            if len(self.engines) == 1:
+                raise ValueError(
+                    "cannot kill the last replica; restart_replica "
+                    "replaces one in place")
+            eng = self.engines.pop(index)
+            eng.draining = True
+            eng._health = "dead"
+            self._retiring.append(eng)
+        # strip in-flight work off the dead scheduler under its step
+        # lock: release its rows and adapter pins, requeue each request
+        # with tokens intact for re-prefill on a survivor
+        displaced: List[Request] = []
+        with eng._step_lock:
+            for row, req in sorted(eng._active.items(),
+                                   key=lambda kv: kv[1].id):
+                del eng._active[row]
+                eng.cache.release(row)
+                if req._lora_held:
+                    if eng.lora_pool is not None:
+                        eng.lora_pool.release(req.tenant)
+                    req._lora_held = False
+                req.state = "queued"
+                req.slot = None
+                displaced.append(req)
+        rehomed = shed = 0
+        for req in sorted(displaced + eng.take_queued(),
+                          key=lambda r: r.id):
+            placed = False
+            for peer in sorted(
+                    (p for p in self.engines
+                     if not getattr(p, "draining", False)
+                     and p._health != "dead"),
+                    key=lambda p: (self._depth(p),
+                                   -self._blocks_free(p))):
+                if peer.adopt_request(req):
+                    placed = True
+                    break
+            if placed:
+                req.rehomed = True
+                rehomed += 1
+                _monitor.stat_add("STAT_serving_rehomed")
+                self._rehomed_counter.inc()
+            else:
+                eng._shed(req, QueueFullError(
+                    "no live replica could adopt the request after "
+                    f"replica {index} was killed", reason="drain"),
+                    reason="drain")
+                shed += 1
+        # the dead replica's prefix cache holds block refs on its pool;
+        # drop them unless a live engine shares that pool (prebuilt
+        # engines on one kv_pool)
+        if eng.paged and not any(p.cache.pool is eng.cache.pool
+                                 for p in self.engines):
+            eng.cache.flush_prefix_cache()
+        self._kills += 1
+        self._rehomed += rehomed
+        _monitor.stat_add("STAT_serving_replica_killed")
+        self._replicas_gauge.set(len(self.engines))
+        self._update_depth_gauges()
+        self._update_state_gauges()
+        _runlog.log_event("serving_replica_kill", replica=index,
+                          cause=cause, t=round(eng._clock(), 6),
+                          rehomed=rehomed, shed=shed,
+                          replicas_left=len(self.engines))
+        return {"rehomed": rehomed, "shed": shed,
+                "replicas_left": len(self.engines)}
+
+    def restart_replica(self, index: int, cause: str = "restart"
+                        ) -> dict:
+        """Replace replica ``index`` with a fresh same-geometry engine:
+        the replacement (state ``recovering``, healthy on its first
+        productive step) joins the set *before* the old replica is
+        killed, so re-homed work can land on it immediately and even a
+        sole replica can be restarted. Same geometry + the per-model
+        unified step cache means the replacement compiles NOTHING new.
+        Returns :meth:`kill_replica`'s accounting dict."""
+        if self._model is None:
+            raise ValueError(
+                "restart_replica needs model= construction (prebuilt "
+                "engines= routers cannot build replacements)")
+        replacement = ServingEngine(self._model, **self._engine_kwargs)
+        self._init_health(replacement)
+        replacement._health = "recovering"
+        with self._lock:
+            if not 0 <= index < len(self.engines):
+                raise IndexError(
+                    f"replica index {index} out of range "
+                    f"(have {len(self.engines)})")
+            self.engines.insert(index + 1, replacement)
+        info = self.kill_replica(index, cause=cause)
+        self._restarts += 1
+        _monitor.stat_add("STAT_serving_replica_restarted")
+        _runlog.log_event("serving_replica_recover", replica=index,
+                          t=round(replacement._clock(), 6),
+                          restarts=self._restarts)
+        return info
+
     def swap_weights(self, state, *, reset_costs: bool = True
                      ) -> List[int]:
         """Rolling weight hot-swap across the fleet: every replica —
@@ -584,6 +819,10 @@ class ReplicaRouter:
             "queue_depths": depths,
             "kv_blocks_free": [self._blocks_free(e)
                                for e in self.engines],
+            "health": [e._health for e in self.engines],
+            "kills": self._kills,
+            "restarts": self._restarts,
+            "rehomed": self._rehomed,
             "completed": completed,
             "slo_met": slo_met,
             "slo_attainment": self._slo_attainment(),

@@ -340,3 +340,136 @@ def test_decode_mix_per_tenant_report_and_zero_leaks(model):
         else:
             assert eng_tenants[name]["completed"] == ts["completed"], \
                 (name, ts, eng_tenants)
+
+
+# ----------------------------------------------- closed loop + chaos
+def test_closed_loop_params_leave_open_loop_trace_untouched():
+    """Closed-loop knobs draw from a SEPARATE RandomState: the
+    arrival schedule and its trace bytes are byte-identical to the
+    plain open-loop generator's — old seeds replay unchanged."""
+    a = LoadGen(mode="poisson", seed=42, **_LG_KW)
+    b = LoadGen(mode="poisson", seed=42, closed_loop=3,
+                think_time_ms=(5.0, 20.0), **_LG_KW)
+    assert a.trace_bytes() == b.trace_bytes()
+    assert a.schedule() == b.schedule()
+    with pytest.raises(ValueError):
+        LoadGen(closed_loop=-1, **_LG_KW)
+    with pytest.raises(ValueError):
+        LoadGen(think_time_ms=(10.0, 5.0), **_LG_KW)
+
+
+def test_closed_loop_run_deterministic_and_bounded(model):
+    """N closed-loop clients: two identical runs make identical
+    decisions, the report carries the client count, and offered
+    never exceeds the open-loop schedule (clients skip arrivals
+    they are still busy for)."""
+    def run_once():
+        vc = VirtualClock()
+        lg = LoadGen(mode="poisson", seed=11, closed_loop=2,
+                     think_time_ms=(2.0, 8.0), **_LG_KW)
+        return lg.run(_engine(model, vc.now, max_queue=8), clock=vc,
+                      step_cost_ms=4.0)
+
+    r1, r2 = run_once(), run_once()
+    assert r1["closed_loop"] == 2
+    assert r1["offered"] == r2["offered"]
+    assert r1["decisions"] == r2["decisions"]
+    assert r1["makespan_s"] == r2["makespan_s"]
+    assert r1["exceptions"] == 0 and r1["leaked_kv_blocks"] == 0
+
+
+@pytest.mark.chaos
+def test_chaos_replay_trace_roundtrip(model):
+    """Chaos rows ride the trace: a generator with a kill/restart
+    schedule round-trips through trace_bytes/from_trace, the run
+    applies each event at its virtual instant, and the accounting
+    identity completed + rehomed + shed == offered survives the
+    crashes. A chaos-free generator's trace stays byte-identical."""
+    import json
+    plain = LoadGen(mode="poisson", seed=42, **_LG_KW)
+    lg = LoadGen(mode="poisson", seed=42, **_LG_KW)
+    assert lg.trace_bytes() == plain.trace_bytes()
+    lg.chaos = [{"t": 0.2, "kind": "restart", "index": 0},
+                {"t": 0.4, "kind": "kill", "index": 1}]
+    assert lg.trace_bytes() != plain.trace_bytes()
+    rt_trace = json.loads(lg.trace_bytes())
+    assert rt_trace["chaos"] == [[0.2, "restart", 0],
+                                 [0.4, "kill", 1]]
+    lg2 = LoadGen.from_trace(rt_trace)
+    assert lg2.chaos == lg.chaos
+    assert lg2.trace_bytes() == lg.trace_bytes()
+
+    vc = VirtualClock()
+    rt = ReplicaRouter(model, n_replicas=2, max_slots=2, max_len=32,
+                       buckets=[8, 16], max_queue=16, block_size=4,
+                       clock=vc.now)
+    warmup(rt)
+    rep = lg2.run(rt, clock=vc, step_cost_ms=4.0)
+    assert rep["chaos_applied"] == 2
+    st = rt.stats()
+    assert st["restarts"] == 1 and st["kills"] == 2
+    errored = sum(1 for d in rep["decisions"]
+                  if d[0] in ("invalid", "error"))
+    assert rep["completed"] + rep["rehomed"] + rep["shed_total"] + \
+        errored == rep["offered"]
+    assert rep["exceptions"] == 0 and rep["leaked_kv_blocks"] == 0
+
+
+def test_trace_convert_folds_kill_recover_into_restart():
+    """events_to_trace carries chaos events on the arrivals' clock:
+    a serving_replica_kill immediately recovered at the same instant
+    folds into one restart row; a bare kill and a worker kill map to
+    their own kinds."""
+    from tools.trace_convert import events_to_trace
+    events = [
+        {"kind": "serving_request", "t": 10.0, "seq": 0,
+         "prompt": [1, 2, 3], "max_new_tokens": 2, "priority": 1},
+        {"kind": "serving_replica_kill", "t": 10.5, "seq": 1,
+         "replica": 0, "rehomed": 1, "shed": 0},
+        {"kind": "serving_replica_recover", "t": 10.5, "seq": 2,
+         "replica": 0},
+        {"kind": "serving_replica_kill", "t": 11.0, "seq": 3,
+         "replica": 1, "rehomed": 0, "shed": 0},
+        {"kind": "serving_worker_kill", "t": 11.5, "seq": 4,
+         "role": "decode", "worker": 0},
+    ]
+    trace = events_to_trace(events)
+    assert trace["chaos"] == [[0.5, "restart", 0],
+                              [1.0, "kill", 1],
+                              [1.5, "kill_decode", 0]]
+
+
+def test_predictor_fault_tolerance_params_are_noops():
+    """replica_kills/restarts/rehomed join the validated no-op family:
+    kill is host-side teardown, restart reuses the per-model step
+    cache at the same geometry, re-home is a bucket-bounded
+    re-prefill — none may change the predicted compile set."""
+    rounds = [[(list(range(1, 9)), 4), (list(range(1, 5)), 1)]]
+    kw = dict(buckets=[8, 16], max_len=32, block_size=4,
+              n_replicas=2)
+    plain = predict_serving_compiles(rounds, **kw)
+    chaotic = predict_serving_compiles(
+        rounds, replica_kills=3, restarts=3, rehomed=7, **kw)
+    assert chaotic == plain
+    for bad in ("replica_kills", "restarts", "rehomed"):
+        with pytest.raises(ValueError, match=bad):
+            predict_serving_compiles(rounds, **{bad: -1}, **kw)
+
+
+def test_soak_kill_spec_and_windows_units():
+    """tools/soak.py pure units: the generated kill schedule spreads
+    N one-shot virtual-time triggers evenly, and the window splitter
+    buckets offered/completed by arrival/done instants."""
+    from tools.soak import _windows, kill_spec
+    assert kill_spec(7200.0, 2) == \
+        "serving.replica:error@t>2400s;serving.replica:error@t>4800s"
+    assert kill_spec(100.0, 0) == ""
+    report = {"makespan_s": 10.0, "trace": [
+        {"t": 1.0, "outcome": "done", "done_t": 2.0},
+        {"t": 6.0, "outcome": "done", "done_t": 9.5},
+        {"t": 6.2, "outcome": "shed", "done_t": None},
+    ]}
+    w = _windows(report, 2)
+    assert [x["offered"] for x in w] == [1, 2]
+    assert [x["completed"] for x in w] == [1, 1]
+    assert w[1]["goodput_per_s"] == 0.2

@@ -85,6 +85,64 @@ def test_spec_malformed_fails_loudly():
         FaultInjector("a.site:drop@1.5")
 
 
+def test_virtual_time_triggers_once_and_repeating():
+    """@t>Ns fires once after N elapsed seconds on the installed
+    time source; @t>Ns+ fires on every call past N; the injector's
+    epoch is its construction instant, so elapsed starts at 0."""
+    from paddle_tpu.resilience import set_time_source
+    t = [100.0]                 # nonzero epoch: elapsed is relative
+    set_time_source(lambda: t[0])
+    try:
+        inj = FaultInjector("a:skip@t>10s;b:skip@t>5s+")
+        assert inj.check("a") is None and inj.check("b") is None
+        t[0] = 107.0            # 7s elapsed: only b's 5s passed
+        assert inj.check("a") is None
+        assert [inj.check("b") for _ in range(2)] == ["skip", "skip"]
+        t[0] = 150.0
+        assert inj.check("a") == "skip"     # one-shot: fires once...
+        assert inj.check("a") is None       # ...then never again
+        assert inj.check("b") == "skip"     # repeating keeps firing
+    finally:
+        set_time_source(None)
+
+
+def test_virtual_time_trigger_multiple_rules_per_site():
+    """A kill schedule is one spec with several @t>Ns clauses on the
+    SAME site (tools/soak.py builds these); each fires independently
+    at its own virtual instant."""
+    from paddle_tpu.resilience import set_time_source
+    t = [0.0]
+    set_time_source(lambda: t[0])
+    try:
+        inj = FaultInjector("s:skip@t>10s;s:skip@t>20s")
+        t[0] = 11.0
+        assert inj.check("s") == "skip"
+        assert inj.check("s") is None
+        t[0] = 21.0
+        assert inj.check("s") == "skip"
+        assert inj.check("s") is None
+    finally:
+        set_time_source(None)
+
+
+def test_fault_scope_installs_time_source():
+    """fault_scope(time_source=...) installs the clock for the scope
+    and restores the previous source on exit."""
+    t = [0.0]
+    with fault_scope("s:skip@t>5s", time_source=lambda: t[0]):
+        assert fault_point("s") is None
+        t[0] = 6.0
+        assert fault_point("s") == "skip"
+    assert injector_mod._time_source is None
+
+
+def test_virtual_time_trigger_malformed_fails_loudly():
+    with pytest.raises(ValueError):
+        FaultInjector("a:skip@t>xs")
+    with pytest.raises(ValueError):
+        FaultInjector("a:skip@t>-3s")
+
+
 def test_probabilistic_trigger_deterministic_per_seed():
     def firing_pattern(seed):
         inj = FaultInjector("s:skip@0.4", seed=seed)

@@ -358,3 +358,73 @@ def test_chaos_handoff_drop_is_retried_transparently(model):
     assert monitor.stat_get("STAT_fault_serving.handoff") > 0
     assert monitor.stat_get("STAT_retry_serving.handoff") > 0
     assert all(lk == 1 for lk in _leaked_per_pool(rt))
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("colocate", [True, False],
+                         ids=["colocated", "split-pools"])
+def test_chaos_kill_decode_worker_rehomes_inflight(model, colocate):
+    """Kill a decode worker holding adopted in-flight rows: every row
+    re-homes onto the surviving worker — a free same-pool splice when
+    co-located, an export_row/adopt_row copy (with the source refs
+    released) across pools — and finishes token-identical to the
+    unkilled run. Zero leaks on every pool, the dead worker's
+    included."""
+    monitor.reset()
+    prompts = _prompts((3, 7), seed=40)
+    rt = _fleet(model, p=1, d=2, colocate=colocate,
+                prefix_cache=False)
+    reqs = [rt.submit(p, max_new_tokens=6) for p in prompts]
+    rt.step()          # prefill + export
+    rt.step()          # decode worker 0 adopts both (drains first)
+    assert len(rt.decodes[0]._active) == len(prompts)
+    info = rt.kill_decode_worker(0)
+    assert info["rehomed"] == len(prompts) and info["shed"] == 0
+    assert info["decodes_left"] == 1
+    rt.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        assert r.state == "done" and r.rehomed is True
+        assert r.output_ids == _ref(model, p, 6), \
+            f"request {r.id} diverged after re-home"
+    assert all(lk == 1 for lk in _leaked_per_pool(rt))
+    st = rt.stats()
+    assert st["rehomed"] == len(prompts)
+    if not colocate:   # cross-pool re-home is an adopt_row copy
+        assert st["handoffs_copied"] >= len(prompts)
+    ids = [r.id for r in rt.results()]
+    assert len(ids) == len(set(ids)) == len(prompts)
+    assert monitor.stat_get("STAT_serving_rehomed") == len(prompts)
+
+
+def test_kill_decode_worker_validates(model):
+    rt = _fleet(model, p=1, d=2)
+    with pytest.raises(IndexError):
+        rt.kill_decode_worker(7)
+    rt.kill_decode_worker(1)
+    with pytest.raises(ValueError):   # the queue would never drain
+        rt.kill_decode_worker(0)
+    rt.run_until_idle()
+
+
+def test_handoff_expired_deadline_shed_not_adopted(model):
+    """Regression: a handoff record that outlives the request's TTFT
+    deadline in the queue used to be adopted anyway. It must shed at
+    adoption time (reason="deadline") with its exported block refs
+    released — zero leaks, no decode cycles on a request the SLO
+    already gave up on."""
+    from tools.loadgen import VirtualClock
+    monitor.reset()
+    vc = VirtualClock()
+    rt = _fleet(model, p=1, d=1, colocate=False, clock=vc.now,
+                prefix_cache=False, slo_ttft_ms=50.0,
+                slo_prefill_ms=1.0, slo_tpot_ms=1.0)
+    req = rt.submit(_prompts((5,), seed=41)[0], max_new_tokens=4)
+    for _ in range(20):                 # prefill + export only
+        if rt.prefills[0].step() and len(rt._handoff) > 0:
+            break
+    assert len(rt._handoff) == 1, "handoff never exported"
+    vc.advance(1.0)                     # 1s >> the 50ms TTFT deadline
+    rt.run_until_idle()
+    assert req.state == "shed" and req.shed_reason == "deadline"
+    assert rt.stats()["shed"].get("deadline") == 1
+    assert all(lk == 1 for lk in _leaked_per_pool(rt))

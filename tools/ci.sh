@@ -43,13 +43,18 @@
 #      submit/alloc faults injected, degradation must stay graceful —
 #      then the same traffic through a --disagg 1x2 fleet: goodput
 #      still > 0, handoffs actually happened, still zero leaks)
-#  10. op coverage gate (>= 80% of the reference forward-op surface)
-#  11. API-freeze check (public signature snapshot diff)
-#  12. multi-chip dry-run (GSPMD train step on N virtual devices)
-#  13. train->serve loop gate (ZeRO parity on 1x1 + virtual dp=2 with
+#  10. chaos soak gate (hours of seeded diurnal traffic on the virtual
+#      clock with replica kills injected at virtual instants and
+#      auto-restart healing the fleet: goodput > 0 in every window,
+#      completed + rehomed + shed == offered, zero leaks, zero new
+#      compiles after warmup — kill/restart/re-home proven no-ops)
+#  11. op coverage gate (>= 80% of the reference forward-op surface)
+#  12. API-freeze check (public signature snapshot diff)
+#  13. multi-chip dry-run (GSPMD train step on N virtual devices)
+#  14. train->serve loop gate (ZeRO parity on 1x1 + virtual dp=2 with
 #      per-device optimizer bytes ~1/dp, then checkpoint publish ->
 #      live hot-swap into a running engine with zero new compiles)
-#  14. README generated fragments vs their registries (no drift)
+#  15. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -57,7 +62,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/14 import smoke"
+echo "== 1/15 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -66,11 +71,11 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/14 lint (program verifier + shape inference + op-desc compat)"
+echo "== 2/15 lint (program verifier + shape inference + op-desc compat)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --books --shapes
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
-echo "== 3/14 sharding-rule lint (GSPMD pre-flight)"
+echo "== 3/15 sharding-rule lint (GSPMD pre-flight)"
 # the GPT TP table, the ZeRO-style fully-sharded merge, and the serving
 # TP table (the mesh-sharded engine's placement rules on its
 # ("data","model") mesh) against the GPT benchmark model: no unknown
@@ -84,26 +89,26 @@ JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset serving_tp --mesh data=
 JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp+fully_sharded --mesh dp=2,mp=2 --json > /dev/null
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/14 test suite (virtual 8-device CPU mesh)"
+  echo "== 4/15 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 4/14 test suite: SKIPPED (quick mode)"
+  echo "== 4/15 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/14 chaos suite (deterministic fault injection)"
+  echo "== 5/15 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 5/14 chaos suite: reduced subset (quick mode)"
+  echo "== 5/15 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 6/14 serving plane (incl. paged-KV equivalence)"
+  echo "== 6/15 serving plane (incl. paged-KV equivalence)"
   # the full file carries the paged oracle: engine output token-identical
   # to sequential greedy with the prefix cache on AND off, plus the
   # dense paged=False baseline and the paged compile-count pins
@@ -121,7 +126,7 @@ if [[ "${1:-}" != "quick" ]]; then
   # prefixes; killing a prefill worker mid-handoff leaks nothing
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving_disagg.py -q
 else
-  echo "== 6/14 serving plane: reduced subset (quick mode)"
+  echo "== 6/15 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv or block_allocator \
 or paged_engine_matches or dense_engine_still or prefix_reuse"
@@ -139,7 +144,7 @@ or head_sharded or drain or chaos_skip"
 or flag_parsing"
 fi
 
-echo "== 7/14 speculative decoding gate"
+echo "== 7/15 speculative decoding gate"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -k "spec"
 if [[ "${1:-}" != "quick" ]]; then
   echo "   bench: spec vs non-spec on the repetitive-suffix workload"
@@ -148,7 +153,7 @@ if [[ "${1:-}" != "quick" ]]; then
     BENCH_SERVING_COMPARE=0 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== 8/14 observability gate"
+echo "== 8/15 observability gate"
 # tiny train + serving smoke under the run log: /metrics parses as
 # Prometheus text (incl. KV block-pool gauges), compile tracker pins
 # decode_step_paged==1 compile and one batched prefill dispatch, a
@@ -156,7 +161,7 @@ echo "== 8/14 observability gate"
 # trace_summary
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
-echo "== 9/14 loadgen SLO gate (goodput under real traffic)"
+echo "== 9/15 loadgen SLO gate (goodput under real traffic)"
 # seeded open-loop traffic through the gpt2-tiny engine with SLO-aware
 # admission: goodput > 0 with attainment reported, zero leaked KV
 # blocks, zero unhandled exceptions — then the chaos crossover: the
@@ -243,14 +248,41 @@ print(f\"   tenants: \" + \", \".join(
       + f\", 0 new compiles, 0 leaks\")
 "
 
-echo "== 10/14 op coverage gate"
+echo "== 10/15 chaos soak gate (virtual-clock fleet fault tolerance)"
+# hours of seeded diurnal traffic compressed into seconds on the
+# virtual clock, with replica kills injected at virtual instants
+# (serving.replica:error@t>Ns, one FLAGS_fault_spec string — the
+# schedule replays byte-identically from the seed) and auto-restart
+# healing the fleet: goodput > 0 in every traffic window that offered
+# load, completed + rehomed + shed == offered, zero leaked KV blocks,
+# zero unhandled exceptions, zero new compiles after warmup — and the
+# recompile predictor proving kill/restart/re-home add none
+if [[ "${1:-}" != "quick" ]]; then SOAK_HOURS=2; else SOAK_HOURS=1; fi
+JAX_PLATFORMS=cpu python tools/soak.py --model gpt2-tiny \
+  --hours "$SOAK_HOURS" --rate 0.02 --kills 2 --replicas 2 --seed 0 \
+  --windows 8 --json \
+  --expect-kills-min 2 --expect-goodput-every-window \
+  --expect-zero-leaks --expect-zero-new-compiles --expect-identity \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+rep = r['report']
+assert rep['kills'] >= 2 and rep['restarts'] >= 2, rep
+assert r['identity_ok'] and r['predictor_noop'], r
+print(f\"   soak: {r['simulated_hours']}h simulated, \"
+      f\"{rep['kills']} kills/{rep['restarts']} restarts, \"
+      f\"{rep['rehomed']} re-homed, goodput {rep['goodput_per_s']}/s, \"
+      f\"0 leaks, 0 new compiles\")
+"
+
+echo "== 11/15 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 11/14 API freeze"
+echo "== 12/15 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -269,7 +301,7 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 12/14 multi-chip dry run"
+echo "== 13/15 multi-chip dry run"
 # needs the jax_num_cpu_devices config option to carve out virtual CPU
 # devices; older jax builds (0.4.x) don't have it
 if JAX_PLATFORMS=cpu python -c "
@@ -285,7 +317,7 @@ else
   echo "   installed jax has no jax_num_cpu_devices — skipped"
 fi
 
-echo "== 13/14 train->serve loop gate (ZeRO + live hot-swap)"
+echo "== 14/15 train->serve loop gate (ZeRO + live hot-swap)"
 # 2-step ZeRO train runs match the unsharded baseline loss-for-loss on
 # a 1x1 mesh and again on a subprocess-carved dp=2 mesh (per-device
 # optimizer bytes asserted ~1/2 of total from live shards), then the
@@ -294,7 +326,7 @@ echo "== 13/14 train->serve loop gate (ZeRO + live hot-swap)"
 # zero new compiles
 JAX_PLATFORMS=cpu python tools/zero_smoke.py
 
-echo "== 14/14 README generated-fragment sync"
+echo "== 15/15 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

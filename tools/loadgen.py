@@ -51,6 +51,26 @@ Two execution modes:
   and the obs_smoke loadgen phase (where it also proves admission
   adds zero XLA compiles).
 
+Two release disciplines:
+
+- **open loop** (default): arrivals are released at their scheduled
+  times no matter how the target is doing — the overload-honest
+  model (a slow server does not slow the offered load);
+- **closed loop** (``closed_loop=N`` / ``--closed-loop N --think-time
+  -ms A:B``): N clients each wait for their previous request to
+  finish, think for a seeded uniform A..B ms, then release the next
+  scheduled arrival's content. Think times come from a *separate*
+  RandomState, so open-loop seeds keep producing byte-identical
+  schedules.
+
+Chaos replay: a trace may carry a ``chaos`` schedule (rows of
+``[t, kind, index]``, kind in kill | restart | kill_decode —
+``tools/trace_convert.py`` extracts them from a live run's
+``serving_replica_kill`` / ``serving_replica_recover`` /
+``serving_worker_kill`` events). ``run()`` fires each event when the
+clock passes its ``t``, so a recorded kill/restart schedule replays
+deterministically alongside the arrivals.
+
 Per-request trace rows record arrival time, admit/shed decision (with
 the shed reason), TTFT, TPOT and whether the deadline was met; the
 report aggregates offered load, goodput (SLO-met completions/s),
@@ -138,7 +158,9 @@ class LoadGen:
                  diurnal_period: Optional[float] = None,
                  diurnal_amplitude: float = 0.8,
                  sample_frac: float = 0.0,
-                 tenant_mix: Optional[dict] = None):
+                 tenant_mix: Optional[dict] = None,
+                 closed_loop: int = 0,
+                 think_time_ms: Tuple[float, float] = (0.0, 0.0)):
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
                              f"got {mode!r}")
@@ -191,6 +213,17 @@ class LoadGen:
         self._tenant_probs = [float(tmix[n]) / tt for n in sorted(
             tmix, key=lambda n: "" if n in ("", "base") else str(n))]
         self._decoded = bool(tmix) or self.sample_frac > 0
+        if closed_loop < 0:
+            raise ValueError("closed_loop must be >= 0 "
+                             "(0 = open loop)")
+        lo, hi = (float(think_time_ms[0]), float(think_time_ms[1]))
+        if lo < 0 or hi < lo:
+            raise ValueError("think_time_ms must satisfy 0 <= lo <= hi")
+        self.closed_loop = int(closed_loop)
+        self.think_time_ms = (lo, hi)
+        #: chaos schedule replayed alongside the arrivals: dicts of
+        #: {"t", "kind", "index"}; populated by from_trace or by hand
+        self.chaos: List[dict] = []
         self._schedule: Optional[List[Arrival]] = None
 
     @classmethod
@@ -235,6 +268,10 @@ class LoadGen:
         lg._schedule = arrivals
         # decode-bearing traces re-serialize with their decode fields
         lg._decoded = any(len(r) > 4 for r in trace["arrivals"])
+        # chaos rows ([t, kind, index]) replay kill/restart schedules
+        lg.chaos = [{"t": float(r[0]), "kind": str(r[1]),
+                     "index": int(r[2])}
+                    for r in trace.get("chaos", [])]
         return lg
 
     # ---------------------------------------------------------- schedule
@@ -341,6 +378,11 @@ class LoadGen:
             "duration": self.duration, "seed": self.seed,
             "arrivals": rows,
         }
+        if self.chaos:   # only chaos-bearing traces grow the key, so
+            # chaos-free seeds keep their byte-identical traces
+            payload["chaos"] = [
+                [e["t"], e["kind"], e["index"]]
+                for e in sorted(self.chaos, key=lambda e: e["t"])]
         return json.dumps(payload, sort_keys=True,
                           separators=(",", ":")).encode()
 
@@ -411,40 +453,141 @@ class LoadGen:
                 rec["outcome"] = "error"
                 rec["reason"] = f"{type(e).__name__}: {e}"
 
+        chaos = sorted(self.chaos, key=lambda e: (e["t"], e["kind"]))
+        ci = 0
+        chaos_applied = 0
+
+        def fire_chaos():
+            nonlocal ci, chaos_applied
+            while ci < len(chaos) and chaos[ci]["t"] <= now_s():
+                chaos_applied += int(
+                    self._apply_chaos(target, chaos[ci]))
+                ci += 1
+
         i, steps = 0, 0
-        while i < len(arrivals) or not target.idle:
-            while i < len(arrivals) and arrivals[i].t <= now_s():
-                release(records[i], arrivals[i])
-                i += 1
-            if target.idle:
-                if i >= len(arrivals):
+        if self.closed_loop:
+            # N clients, each: wait for completion, think (a separate
+            # RandomState — the open-loop schedule stream is untouched,
+            # so open-loop seeds stay byte-identical), release the next
+            # scheduled arrival's content at the loop's own pace
+            think_rng = np.random.RandomState(
+                (self.seed * 2654435761 + 97) % (2 ** 32))
+            lo, hi = self.think_time_ms
+
+            def think_s() -> float:
+                return (lo + (hi - lo) *
+                        float(think_rng.uniform())) / 1e3
+
+            free_at = [0.0] * self.closed_loop
+            pending: List[Optional[dict]] = [None] * self.closed_loop
+            while True:
+                fire_chaos()
+                now = now_s()
+                for c in range(self.closed_loop):
+                    rec = pending[c]
+                    if rec is not None:
+                        req = rec["req"]
+                        if req is not None and \
+                                req.state not in ("done", "shed"):
+                            continue
+                        done_at = now
+                        if req is not None and \
+                                req.finished_at is not None:
+                            done_at = max(0.0, req.finished_at - t0)
+                        free_at[c] = done_at + think_s()
+                        pending[c] = None
+                    if i < len(arrivals) and free_at[c] <= now:
+                        rec = records[i]
+                        rec["t"] = round(now, 9)  # actual release time
+                        release(rec, arrivals[i])
+                        i += 1
+                        if rec["outcome"] == "admitted":
+                            pending[c] = rec
+                        else:
+                            free_at[c] = now + think_s()
+                if i >= len(arrivals) and target.idle and \
+                        all(p is None for p in pending):
                     break
-                gap = arrivals[i].t - now_s()
+                if target.idle:
+                    nxt = min((free_at[c]
+                               for c in range(self.closed_loop)
+                               if pending[c] is None), default=now)
+                    gap = nxt - now
+                    if gap > 0:
+                        if clock is not None:
+                            clock.advance(gap)
+                        else:
+                            time.sleep(min(gap, 0.05))
+                    continue
+                target.step()
+                if on_step is not None:
+                    on_step(steps)
                 if clock is not None:
-                    clock.advance(max(0.0, gap))
-                else:
-                    time.sleep(min(max(gap, 0.0), 0.05))
-                continue
-            target.step()
-            if on_step is not None:
-                on_step(steps)
-            if clock is not None:
-                clock.advance(step_cost_ms / 1e3)
-            steps += 1
-            if steps >= max_steps:
-                raise RuntimeError(
-                    f"loadgen target not drained after {max_steps} "
-                    "steps")
+                    clock.advance(step_cost_ms / 1e3)
+                steps += 1
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"loadgen target not drained after "
+                        f"{max_steps} steps")
+        else:
+            while i < len(arrivals) or not target.idle:
+                fire_chaos()
+                while i < len(arrivals) and arrivals[i].t <= now_s():
+                    release(records[i], arrivals[i])
+                    i += 1
+                if target.idle:
+                    if i >= len(arrivals):
+                        break
+                    gap = arrivals[i].t - now_s()
+                    if clock is not None:
+                        clock.advance(max(0.0, gap))
+                    else:
+                        time.sleep(min(max(gap, 0.0), 0.05))
+                    continue
+                target.step()
+                if on_step is not None:
+                    on_step(steps)
+                if clock is not None:
+                    clock.advance(step_cost_ms / 1e3)
+                steps += 1
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"loadgen target not drained after "
+                        f"{max_steps} steps")
         makespan = max(now_s(), 1e-9)
         return self._report(records, makespan, steps, slo_ttft_ms,
-                            target, exceptions, include_trace)
+                            target, exceptions, include_trace,
+                            t0=t0, chaos_applied=chaos_applied)
+
+    @staticmethod
+    def _apply_chaos(target, ev: dict) -> bool:
+        """Fire one recorded chaos event against the target; returns
+        whether it applied. A fleet whose shape diverged from the
+        recording (fewer replicas, different roles) skips events it
+        cannot map rather than crashing the replay."""
+        kind, idx = ev["kind"], int(ev["index"])
+        try:
+            if kind == "restart":
+                target.restart_replica(idx)
+            elif kind == "kill":
+                target.kill_replica(idx)
+            elif kind == "kill_decode":
+                target.kill_decode_worker(idx)
+            elif kind == "kill_prefill":
+                target.kill_prefill_worker(idx)
+            else:
+                return False
+        except (AttributeError, IndexError, ValueError):
+            return False
+        return True
 
     def _report(self, records, makespan, steps, slo_ttft_ms, target,
-                exceptions, include_trace) -> dict:
+                exceptions, include_trace, t0: float = 0.0,
+                chaos_applied: int = 0) -> dict:
         shed: dict = {}
         decisions: List[List] = []
         ttfts, tpots = [], []
-        completed = slo_met = slo_known = 0
+        completed = rehomed_done = slo_met = slo_known = 0
         per_tenant: dict = {}
         for rec in records:
             tstats = per_tenant.setdefault(
@@ -462,12 +605,24 @@ class LoadGen:
                                   else round(req.ttft * 1e3, 3))
                 rec["tpot_ms"] = (None if req.tpot is None
                                   else round(req.tpot * 1e3, 3))
+                rec["rehomed"] = bool(getattr(req, "rehomed", False))
+                rec["done_t"] = (
+                    None if req.finished_at is None
+                    else round(max(0.0, req.finished_at - t0), 6))
                 met = req.deadline_met
                 if met is None and slo_ttft_ms and req.ttft is not None:
                     met = req.ttft * 1e3 <= slo_ttft_ms
                 rec["deadline_met"] = met
                 if req.state == "done":
-                    completed += 1
+                    # a re-homed completion lands in its own bucket so
+                    # completed + shed + rehomed == offered (modulo
+                    # rejects/errors) survives a kill; its latency and
+                    # SLO verdict still count below — recovered work
+                    # is goodput
+                    if rec["rehomed"]:
+                        rehomed_done += 1
+                    else:
+                        completed += 1
                     tstats["completed"] += 1
                     if req.ttft is not None:
                         ttfts.append(req.ttft * 1e3)
@@ -511,6 +666,9 @@ class LoadGen:
             "admitted": sum(1 for d in decisions
                             if d[0] in ("done", "shed")),
             "completed": completed,
+            "rehomed": rehomed_done,
+            "closed_loop": self.closed_loop,
+            "chaos_applied": chaos_applied,
             "shed": shed,
             "shed_total": sum(shed.values()),
             "exceptions": exceptions,
@@ -520,7 +678,8 @@ class LoadGen:
                                if slo_known else None),
             "goodput_per_s": (round(slo_met / makespan, 4)
                               if slo_known else None),
-            "throughput_per_s": round(completed / makespan, 4),
+            "throughput_per_s": round(
+                (completed + rehomed_done) / makespan, 4),
             "ttft_ms_p50": pct(ttfts, 50),
             "ttft_ms_p95": pct(ttfts, 95),
             "ttft_ms_p99": pct(ttfts, 99),
@@ -596,6 +755,11 @@ def _parse_range(text: str) -> Tuple[int, int]:
     return lo, hi
 
 
+def _parse_frange(text: str) -> Tuple[float, float]:
+    lo, hi = (float(p) for p in str(text).split(":"))
+    return lo, hi
+
+
 def _parse_mix(text: str) -> Optional[dict]:
     if not text:
         return None
@@ -637,6 +801,14 @@ def main(argv=None) -> int:
                     metavar="LO:HI")
     ap.add_argument("--new-tokens", type=_parse_range, default=(2, 16),
                     metavar="LO:HI")
+    ap.add_argument("--closed-loop", type=int, default=0,
+                    metavar="N", help="> 0 runs N closed-loop clients "
+                    "(each waits for completion + think time before "
+                    "re-submitting) instead of open-loop release")
+    ap.add_argument("--think-time-ms", type=_parse_frange,
+                    default=(0.0, 0.0), metavar="A:B",
+                    help="closed-loop per-client think time, uniform "
+                    "on [A, B] ms from a dedicated seeded stream")
     ap.add_argument("--priority-mix", type=_parse_mix, default=None,
                     metavar="P:W,P:W", help="priority class weights, "
                     "e.g. '0:0.1,1:0.8,2:0.1' (lower = more urgent)")
@@ -722,6 +894,9 @@ def main(argv=None) -> int:
     model.eval()
     if args.replay:
         lg = LoadGen.from_trace(args.replay)
+        if args.closed_loop:
+            lg.closed_loop = int(args.closed_loop)
+            lg.think_time_ms = args.think_time_ms
     else:
         lg = LoadGen(mode=args.mode, rate=args.rate,
                      duration=args.duration, seed=args.seed,
@@ -730,7 +905,9 @@ def main(argv=None) -> int:
                      new_tokens=args.new_tokens,
                      priority_mix=args.priority_mix,
                      sample_frac=args.sample_frac,
-                     tenant_mix=args.tenant_mix)
+                     tenant_mix=args.tenant_mix,
+                     closed_loop=args.closed_loop,
+                     think_time_ms=args.think_time_ms)
     lora_tenants = sorted(t for t in (args.tenant_mix or {})
                           if t not in ("", "base"))
     if lora_tenants and args.lora_rank <= 0:

@@ -30,6 +30,11 @@ Asserts, end to end through the observability plane:
     engines share the symmetric engines' step cache), scores a
     prefix-affinity routing hit on the repeated prompt, leaks no KV
     blocks, and matches the predictor's ``disagg`` no-op claim;
+  - a kill -> re-home -> restart episode on a 2-replica router: the
+    killed replica's work finishes token-identically on the survivor,
+    health states and re-home counters publish to /metrics and the
+    run log, the tracker does not move, and the predictor agrees
+    replica_kills/restarts/rehomed are no-ops;
   - a live weight hot-swap (``swap_weights``) into the still-warm
     loadgen engine adds zero compiles, decodes the new weights'
     greedy tokens, and matches the predictor's ``weight_swaps``
@@ -352,6 +357,56 @@ def main() -> int:
           f"({st7['affinity_hits']} affinity hits), 0 new compiles, "
           f"0 leaked blocks")
 
+    # -- fault-tolerance phase: kill -> re-home -> restart ------------
+    # (Still before the hot-swap phase: the reference outputs hold
+    # only while the shared model carries the old weights.) Load every
+    # request onto replica 0, kill it: the queued work re-homes onto
+    # the survivor and finishes token-identical. Then restart the
+    # survivor in place. Kill + restart + re-home are host-side row
+    # surgery over already-compiled buckets, so the tracker must not
+    # move — and the predictor must agree the counts are no-ops.
+    router9 = ReplicaRouter(model, n_replicas=2, max_slots=3,
+                            max_len=32, buckets=[8, 16], max_queue=16,
+                            block_size=4)
+    reqs9 = [router9.engines[0].submit(p, max_new_tokens=4)
+             for p in prompts]
+    info9 = router9.kill_replica(0)
+    assert info9["rehomed"] == len(prompts) and info9["shed"] == 0, \
+        info9
+    router9.run_until_idle()
+    for a, b in zip(reqs, reqs9):
+        assert a.output_ids == b.output_ids, (
+            f"re-homed request {b.id} diverged: "
+            f"{a.output_ids} vs {b.output_ids}")
+    assert all(r.rehomed for r in reqs9)
+    router9.restart_replica(0)
+    router9.run_until_idle()
+    st9 = router9.stats()
+    assert st9["kills"] == 2 and st9["restarts"] == 1, st9
+    assert st9["rehomed"] == len(prompts), st9
+    assert st9["replicas"] == 1, st9   # restart replaces in place
+    assert all(h in ("healthy", "recovering")
+               for h in st9["health"]), st9
+    ids9 = [r.id for r in router9.results()]
+    assert len(ids9) == len(set(ids9)) == len(prompts)
+    for e in router9.engines + router9._retiring:
+        e.cache.flush_prefix_cache()
+        assert e.cache.allocator.leaked() == 1   # trash block only
+    comp9 = observability.compiles()
+    observed9 = {site: c["count"] for site, c in comp9.items()
+                 if site.startswith(("serving_", "decode_", "verify_"))}
+    assert observed9 == observed7, (
+        f"kill/re-home/restart must add ZERO compiles:\n"
+        f"  before {observed7}\n  after  {observed9}")
+    ft_pred = predict_serving_compiles(
+        workload, buckets=[8, 16], max_len=32, block_size=4,
+        n_replicas=2, replica_kills=2, restarts=1,
+        rehomed=len(prompts))
+    assert ft_pred == predicted3, (ft_pred, predicted3)
+    print(f"   fault tolerance: kill -> {info9['rehomed']} re-homed "
+          f"token-identical -> restart, health {st9['health']}, "
+          f"0 new compiles (predicted == observed)")
+
     # -- hot-swap phase: live weight swap adds ZERO compiles ----------
     # Publish fresh weights into the still-warm loadgen engine: the
     # compiled steps take weights as explicit jit inputs, so the
@@ -371,9 +426,9 @@ def main() -> int:
     comp6 = observability.compiles()
     observed6 = {site: c["count"] for site, c in comp6.items()
                  if site.startswith(("serving_", "decode_", "verify_"))}
-    assert observed6 == observed7, (
+    assert observed6 == observed9, (
         f"live weight swap must add ZERO compiles:\n"
-        f"  before {observed7}\n  after  {observed6}")
+        f"  before {observed9}\n  after  {observed6}")
     ref_swap = greedy_search(swap_model, np.asarray([p_swap]),
                              max_new_tokens=4,
                              cache_len=32)[0].tolist()
@@ -491,7 +546,10 @@ def main() -> int:
                    "serving_handoff_queue_depth",
                    "serving_disagg_workers",
                    "serving_lora_adapters_loaded",
-                   "STAT_serving_lora_loads"):
+                   "STAT_serving_lora_loads",
+                   "serving_replica_state",
+                   "serving_rehomed_total",
+                   "STAT_serving_rehomed"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 
@@ -505,7 +563,8 @@ def main() -> int:
     for k in ("train_step", "guardian_skip", "fault_injected",
               "serving_admit", "serving_finish", "serving_weight_swap",
               "serving_request", "serving_handoff",
-              "serving_lora_load"):
+              "serving_lora_load", "serving_replica_kill",
+              "serving_replica_recover"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])

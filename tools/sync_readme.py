@@ -40,10 +40,16 @@ def latest_bench():
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
     if not paths:
         raise SystemExit("no BENCH_r*.json artifact found")
-    with open(paths[-1]) as f:
-        data = json.load(f)
-    return paths[-1], data.get("parsed") or json.loads(
-        data["tail"].strip().splitlines()[-1])
+    # newest artifact that actually carries a perf record — serving/soak
+    # records (e.g. the chaos-soak frontier) have neither "parsed" nor
+    # "tail" and don't feed the MFU headline
+    for path in reversed(paths):
+        with open(path) as f:
+            data = json.load(f)
+        if "parsed" in data or "tail" in data:
+            return path, data.get("parsed") or json.loads(
+                data["tail"].strip().splitlines()[-1])
+    raise SystemExit("no BENCH_r*.json artifact with a perf record found")
 
 
 _FLAGSHIP_NAMES = {
@@ -196,7 +202,15 @@ def render_fault_block():
         "`@N` = exactly the N-th call (0-based), `@N+` = from the N-th",
         "on, `@p` (float with a dot) = probability p from a PRNG seeded",
         "by (`FLAGS_fault_seed`, site, rule index) — the same spec +",
-        "seed always injects the same faults. Kinds: `drop` (connection",
+        "seed always injects the same faults — and the virtual-time",
+        "pair `@t>Ns` / `@t>Ns+`: fire once (or on every call) after N",
+        "seconds have elapsed on the injector's clock. The clock",
+        "defaults to `time.monotonic`; `resilience.set_time_source` (or",
+        "`fault_scope(..., time_source=...)`) points it at a virtual",
+        "clock, so a kill schedule like",
+        "`serving.replica:error@t>1800s;serving.replica:error@t>3600s`",
+        "replays byte-identically inside a simulated soak",
+        "(tools/soak.py). Kinds: `drop` (connection",
         "loss), `error` (OSError), `preempt` (SystemExit, the in-process",
         "preemption analog), `kill` (hard `os._exit`), and the",
         "caller-interpreted `nan` / `corrupt` / `skip`.",

@@ -10,7 +10,16 @@ first arrival lands at t=0, and emits the trace format
 ``tools/loadgen.py --replay`` / ``LoadGen.from_trace`` consume::
 
     {"meta": {"source": ..., "duration": ..., "rate": ...},
-     "arrivals": [[t, prompt, max_new_tokens, priority], ...]}
+     "arrivals": [[t, prompt, max_new_tokens, priority], ...],
+     "chaos": [[t, kind, index], ...]}       # when the run had any
+
+Chaos events ride along: ``serving_replica_kill`` /
+``serving_replica_recover`` / ``serving_worker_kill`` events become
+``chaos`` rows (kind kill | restart | kill_decode | kill_prefill) on
+the same re-based clock — a kill+recover pair at one instant collapses
+into a single ``restart`` — so a live soak's kill/restart schedule
+replays deterministically alongside its arrivals
+(``LoadGen.run`` fires each row when the clock passes its ``t``).
 
 So a production incident captured in the run log replays — same
 prompts, same spacing — against any engine/fleet configuration::
@@ -42,9 +51,15 @@ def events_to_trace(events: Iterable[dict],
     (t, seq) so interleaved producers land in arrival order, and
     re-bases ``t`` to the first kept arrival.
     """
-    kept = []
+    _CHAOS_KINDS = ("serving_replica_kill", "serving_replica_recover",
+                    "serving_worker_kill")
+    kept, chaos_evs = [], []
     for ev in events:
-        if ev.get("kind") != "serving_request":
+        kind = ev.get("kind")
+        if kind in _CHAOS_KINDS and "t" in ev:
+            chaos_evs.append(ev)
+            continue
+        if kind != "serving_request":
             continue
         if engine is not None and \
                 ev.get("engine", ev.get("router")) != engine:
@@ -58,6 +73,26 @@ def events_to_trace(events: Iterable[dict],
                          [int(x) for x in ev["prompt"]],
                          int(ev["max_new_tokens"]),
                          int(ev.get("priority", 1))])
+    # chaos rows share the arrivals' clock; a kill immediately
+    # followed by a recover of the same replica is one restart
+    chaos_evs.sort(key=lambda ev: (float(ev["t"]),
+                                   int(ev.get("seq", 0))))
+    recovered = {(int(ev["replica"]), round(float(ev["t"]), 6))
+                 for ev in chaos_evs
+                 if ev["kind"] == "serving_replica_recover"}
+    chaos: List[list] = []
+    for ev in chaos_evs:
+        t = round(float(ev["t"]) - t0, 6)
+        if ev["kind"] == "serving_replica_recover":
+            chaos.append([t, "restart", int(ev["replica"])])
+        elif ev["kind"] == "serving_replica_kill":
+            if (int(ev["replica"]),
+                    round(float(ev["t"]), 6)) in recovered:
+                continue   # folded into the restart row
+            chaos.append([t, "kill", int(ev["replica"])])
+        else:   # serving_worker_kill
+            role = ev.get("role", "decode")
+            chaos.append([t, f"kill_{role}", int(ev["worker"])])
     duration = arrivals[-1][0] if arrivals else 0.0
     meta: Dict = {"events": len(arrivals), "duration": duration}
     if duration > 0:
@@ -66,7 +101,10 @@ def events_to_trace(events: Iterable[dict],
         meta["source"] = source
     if engine:
         meta["engine"] = engine
-    return {"meta": meta, "arrivals": arrivals}
+    out = {"meta": meta, "arrivals": arrivals}
+    if chaos:
+        out["chaos"] = chaos
+    return out
 
 
 def load_events(paths: Iterable[str]) -> List[dict]:
