@@ -136,7 +136,8 @@ def predict_serving_compiles(
         rehomed: int = 0,
         disagg: Optional[Tuple[int, int]] = None,
         sampling: Optional[Sequence[Tuple[float, int, float]]] = None,
-        lora: Optional[Tuple[int, int]] = None) -> Dict[str, int]:
+        lora: Optional[Tuple[int, int]] = None,
+        tracing: Optional[float] = None) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -250,6 +251,15 @@ def predict_serving_compiles(
     adapter loads, evictions and any per-tenant traffic mix trace
     nothing. Requires ``paged=True`` (the pool reuses the block
     allocator's discipline).
+
+    ``tracing`` (``FLAGS_serving_trace``: the per-request distributed-
+    tracing sampling fraction in [0, 1], or True for fully sampled) is
+    the purest no-op of the family: a trace is an ordered list of
+    host-side ``(kind, t, track)`` marks appended around the compiled
+    dispatches — timestamps read from the engine clock, never passed
+    into any jitted function, no shape, dtype or donation anywhere
+    near the step cache. Tracing every request predicts the same
+    counts as tracing none.
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -321,6 +331,12 @@ def predict_serving_compiles(
             raise ValueError(
                 "lora requires paged=True (the adapter pool is paged "
                 "like the KV cache)")
+    if tracing is not None:
+        frac = 1.0 if tracing is True else float(tracing)
+        if not (0.0 <= frac <= 1.0):
+            raise ValueError(
+                f"tracing must be a sampling fraction in [0, 1] (or "
+                f"True = 1.0), got {tracing!r}")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

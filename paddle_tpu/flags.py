@@ -410,3 +410,21 @@ define_flag("runlog_max_mb", 64.0,
             "Size cap in MB for the active run-log file; on overflow "
             "it rotates to <name>.1 (replacing the previous one), so a "
             "process writes at most two caps of disk.")
+define_flag("serving_trace", 1.0,
+            "Per-request distributed tracing sampling fraction "
+            "(observability/tracing.py): each admitted request is "
+            "sampled in/out by a deterministic hash of its request id "
+            "— 1.0 (default) traces everything, 0 disables. Traced "
+            "requests record host-side span marks (submit/admit/"
+            "first_token/export/adopt/kill/finish) on the engine "
+            "clock; blame attribution, Perfetto export and the "
+            "/v1/requests/<id> endpoint read them. Pure host "
+            "bookkeeping: zero compiled surface either way "
+            "(predict_serving_compiles(tracing=...) is a validated "
+            "no-op).")
+define_flag("serving_trace_keep", 512,
+            "Finished-trace retention ring (like the runlog's "
+            "rotation): the most recent N completed/shed traces stay "
+            "queryable via GET /v1/requests/<id> and the exporters; "
+            "older ids 404. Active (in-flight) traces are never "
+            "evicted.")

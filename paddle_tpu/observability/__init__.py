@@ -7,6 +7,8 @@ grown into a production-style plane):
 - :mod:`.compile_tracker`  ``tracked_jit`` XLA compile accounting
 - :mod:`.runlog`           structured JSONL run-log emitter
 - :mod:`.export`           Prometheus text + JSON snapshot exporters
+- :mod:`.tracing`          per-request span traces, blame attribution,
+  Perfetto chrome-trace export and windowed SLO burn rate
 
 ``paddle_tpu.monitor`` (the STAT_* counter API) is a thin shim over the
 registry here, so every existing ``stat_add``/``stat_time`` call site
@@ -15,7 +17,7 @@ reports into the same plane that ``GET /metrics`` scrapes.
 
 from __future__ import annotations
 
-from . import compile_tracker, export, metrics, runlog
+from . import compile_tracker, export, metrics, runlog, tracing
 from .compile_tracker import (RecompileWarning, compiles, reset_compiles,
                               tracked_jit)
 from .export import prometheus_text, snapshot, validate_prometheus_text
@@ -92,6 +94,18 @@ INSTRUMENT_DOCS = {
         "onto a live peer (queued re-routes + in-flight re-prefills "
         "and block-table splices); the third term of the accounting "
         "identity completed + shed + rehomed == offered",
+    "serving_traced_total":
+        "counter — requests that carried a per-request trace (sampled "
+        "in by FLAGS_serving_trace; the trace is host-side marks on "
+        "the engine clock whose spans decompose TTFT/E2E into "
+        "queue | prefill | decode | handoff | rehome components — an "
+        "accounting identity, see observability/tracing.py)",
+    "serving_slo_burn_rate{window=...}":
+        "gauge — per-window SLO error-budget burn rate from "
+        "tracing.window_snapshots: (1 - window attainment) / "
+        "(1 - SLO target); 1.0 burns the budget exactly at the "
+        "allowed rate, >1 eats into it, 0 is a clean window (the "
+        "tools/soak.py per-window report)",
     "zero_param_bytes_per_device{stage=...} / "
     "zero_opt_bytes_per_device{stage=...}":
         "gauges — max over devices of resident parameter / "
@@ -204,7 +218,7 @@ def histogram(name: str, help_str: str = "", buckets=None) -> Histogram:
 
 
 __all__ = [
-    "metrics", "compile_tracker", "runlog", "export",
+    "metrics", "compile_tracker", "runlog", "export", "tracing",
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
     "tracked_jit", "compiles", "reset_compiles", "RecompileWarning",
     "log_event", "recent",

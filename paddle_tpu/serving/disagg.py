@@ -71,6 +71,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from .. import monitor as _monitor
 from .. import observability as _obs
 from ..observability import runlog as _runlog
+from ..observability import tracing as _tracing
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
 from .engine import QueueFullError, Request, ServingEngine, _Shed
@@ -184,6 +185,8 @@ class PrefillEngine(ServingEngine):
     or earlier exports are still waiting to enqueue (``_pending``).
     """
 
+    trace_role = "prefill"
+
     def __init__(self, model, handoff: HandoffQueue, **kwargs):
         if kwargs.get("paged") is False:
             raise ValueError(
@@ -218,6 +221,8 @@ class PrefillEngine(ServingEngine):
                 self.lora_pool.release(req.tenant)
                 req._lora_held = False
             self._pending.append(_Handoff(req, rec, self))
+            _tracing.mark(req.id, "export", self._clock(),
+                          self.trace_track)
             staged += 1
             if _runlog.enabled():
                 _runlog.log_event(
@@ -271,6 +276,8 @@ class DecodeEngine(ServingEngine):
     record that doesn't fit right now (no free row / dry pool) stays
     queued with its references intact — that *is* the backpressure.
     """
+
+    trace_role = "decode"
 
     def __init__(self, model, handoff: HandoffQueue, **kwargs):
         if kwargs.get("paged") is False:
@@ -356,6 +363,8 @@ class DecodeEngine(ServingEngine):
                 self._active[row] = item.req
                 self.adopted += 1
                 adopted += 1
+                _tracing.mark(item.req.id, "adopt", self._clock(),
+                              self.trace_track)
                 _monitor.stat_add("STAT_serving_handoffs")
                 if _runlog.enabled():
                     _runlog.log_event(
@@ -768,7 +777,9 @@ class DisaggRouter:
                 shed += 1
         # still-queued requests re-home onto survivors
         rerouted = 0
+        t_kill = eng._clock()
         for req in eng.take_queued():
+            _tracing.mark(req.id, "kill", t_kill, eng.trace_track)
             placed = False
             for i in self._least_loaded():
                 if self.prefills[i].adopt_request(req):
@@ -828,6 +839,8 @@ class DisaggRouter:
             for row in sorted(eng._active,
                               key=lambda r: eng._active[r].id):
                 req = eng._active.pop(row)
+                _tracing.mark(req.id, "kill", eng._clock(),
+                              eng.trace_track)
                 if req._lora_held:
                     eng.lora_pool.release(req.tenant)
                     req._lora_held = False
@@ -863,6 +876,8 @@ class DisaggRouter:
                     req.slot = row2
                     peer._active[row2] = req
                     req.rehomed = True
+                    _tracing.mark(req.id, "adopt", peer._clock(),
+                                  peer.trace_track)
                     rehomed += 1
                     _monitor.stat_add("STAT_serving_rehomed")
                     self._rehomed_counter.inc()

@@ -110,6 +110,7 @@ from .. import observability as _obs
 from .. import profiler as _profiler
 from ..observability import compile_tracker as _ct
 from ..observability import runlog as _runlog
+from ..observability import tracing as _tracing
 from ..dygraph.tape import no_grad
 from ..dygraph.tensor import Tensor
 from ..distributed.sharding import (SERVING_TP_RULES, kv_pool_shardings,
@@ -295,6 +296,15 @@ class ServingEngine:
     """
 
     _engine_ids = itertools.count()
+
+    #: track-label prefix in exported traces; the disaggregated roles
+    #: override with "prefill"/"decode" so Perfetto shows one named
+    #: track per replica/role
+    trace_role = "engine"
+
+    @property
+    def trace_track(self) -> str:
+        return f"{self.trace_role}{self._eid}"
 
     def __init__(self, model, max_slots: Optional[int] = None,
                  max_len: Optional[int] = None,
@@ -1025,6 +1035,10 @@ class ServingEngine:
             raise QueueFullError(msg, reason=reason,
                                  retry_after_s=self._retry_after_s(pred))
         _monitor.stat_add("STAT_serving_submitted")
+        _tracing.begin(req.id, req.submitted_at, self.trace_track,
+                       prompt_tokens=len(req.prompt),
+                       max_new_tokens=req.max_new_tokens,
+                       priority=req.priority, tenant=req.tenant)
         self._wake.set()
         return req
 
@@ -1337,6 +1351,10 @@ class ServingEngine:
         admitted = 0
         for bucket in sorted(groups):
             group = groups[bucket]
+            t_adm = self._clock()
+            for g_req, _row, _shared in group:
+                _tracing.mark(g_req.id, "admit", t_adm,
+                              self.trace_track)
             t0 = time.perf_counter()
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
@@ -1382,6 +1400,12 @@ class ServingEngine:
                                   bucket=bucket, slot=row,
                                   prompt_tokens=len(req.prompt),
                                   shared_tokens=shared)
+                if req.first_token_at is not None:
+                    # a re-homed request re-prefilled its committed
+                    # context: the original trace resumes decoding
+                    # here instead of re-stamping a first token
+                    _tracing.mark(req.id, "resume", self._clock(),
+                                  self.trace_track)
                 self._append_token(req,
                                    self._take_first(req, first, lg, i))
         return expired + len(candidates) - len(back), admitted
@@ -1402,6 +1426,10 @@ class ServingEngine:
         admitted = 0
         for bucket in sorted(groups):
             group = groups[bucket]
+            t_adm = self._clock()
+            for g_req in group:
+                _tracing.mark(g_req.id, "admit", t_adm,
+                              self.trace_track)
             t0 = time.perf_counter()
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
@@ -1434,6 +1462,9 @@ class ServingEngine:
                 _runlog.log_event("serving_admit", request=req.id,
                                   bucket=bucket, slot=slot,
                                   prompt_tokens=len(req.prompt))
+                if req.first_token_at is not None:
+                    _tracing.mark(req.id, "resume", self._clock(),
+                                  self.trace_track)
                 # the first generated token comes from the prefill
                 # logits (same argmax greedy_search takes after ITS
                 # prefill; sampled/masked rows draw from them instead)
@@ -1702,6 +1733,10 @@ class ServingEngine:
         req.tokens.append(token)
         if req.first_token_at is None:
             req.first_token_at = self._clock()
+            # the mark reuses the stamp so the blame prefix up to
+            # first_token equals the measured TTFT exactly
+            _tracing.mark(req.id, "first_token", req.first_token_at,
+                          self.trace_track)
         _monitor.stat_add("STAT_serving_tokens")
         if req._cursor is not None:
             # advance the grammar pushdown over the committed token;
@@ -1765,6 +1800,8 @@ class ServingEngine:
             ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
             tpot_ms=None if tpot is None else round(tpot * 1e3, 3),
             deadline_met=met)
+        _tracing.finish(req.id, req.finished_at, self.trace_track,
+                        "done")
         req._done.set()
 
     def _shed(self, req: Request, err: BaseException,
@@ -1782,6 +1819,8 @@ class ServingEngine:
         _runlog.log_event("serving_shed", request=req.id,
                           reason=reason, priority=req.priority,
                           error=str(err))
+        _tracing.finish(req.id, req.finished_at, self.trace_track,
+                        "shed", reason=reason)
         req._done.set()
 
     # --------------------------------------------------------- stepping

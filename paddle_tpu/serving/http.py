@@ -45,6 +45,16 @@ minimal JSON generation protocol:
                              fault counters, XLA compile tracking)
   GET  /health        -> 200 {"ok": true, "slots_free": n, "queued": n}
                              (+ kv_blocks_free/used with paged KV)
+  GET  /v1/requests/<id>
+                      -> 200 the request's span timeline + blame
+                             breakdown from the tracing store (marks
+                             on the engine clock, per-component
+                             milliseconds whose sum reconciles with
+                             the measured E2E — see
+                             observability/tracing.py)
+                      -> 404 unknown id, unsampled request, or one
+                             evicted from the bounded finished ring
+                             (FLAGS_serving_trace_keep)
 
 Like the KV rendezvous server, this is unauthenticated cluster-private
 HTTP; bind 127.0.0.1 (the default here) unless the network is trusted.
@@ -60,6 +70,7 @@ from typing import Optional
 
 from .. import monitor as _monitor
 from .. import observability as _obs
+from ..observability import tracing as _tracing
 from .engine import QueueFullError, ServingEngine
 
 
@@ -102,6 +113,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/v1/requests/"):
+            tail = self.path[len("/v1/requests/"):]
+            try:
+                rid = int(tail)
+            except ValueError:
+                self._json(400, {"error": f"bad request id {tail!r}"})
+                return
+            info = _tracing.get(rid)
+            if info is None:
+                self._json(404, {"error": f"no trace for request {rid} "
+                                          "(unknown, unsampled, or "
+                                          "evicted from the ring)"})
+            else:
+                self._json(200, info)
         else:
             self._json(404, {"error": f"unknown path {self.path!r}"})
 

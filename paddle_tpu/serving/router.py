@@ -49,6 +49,7 @@ from typing import List, Optional, Sequence
 from .. import monitor as _monitor
 from .. import observability as _obs
 from ..observability import runlog as _runlog
+from ..observability import tracing as _tracing
 from ..resilience.injector import InjectedFault, fault_point
 from ..resilience.retry import RetryError, RetryPolicy
 from .engine import QueueFullError, Request, ServingEngine
@@ -585,7 +586,9 @@ class ReplicaRouter:
         shed (reason="drain") through ``src`` so the accounting
         identity holds. Returns how many were re-homed."""
         moved = 0
+        t_kill = src._clock()
         for req in src.take_queued():
+            _tracing.mark(req.id, "kill", t_kill, src.trace_track)
             placed = False
             for peer in sorted(
                     (p for p in peers
@@ -674,8 +677,12 @@ class ReplicaRouter:
                 req.slot = None
                 displaced.append(req)
         rehomed = shed = 0
+        t_kill = eng._clock()
         for req in sorted(displaced + eng.take_queued(),
                           key=lambda r: r.id):
+            # the kill mark opens the re-home span on the dead
+            # replica's track; the adopting peer's admit closes it
+            _tracing.mark(req.id, "kill", t_kill, eng.trace_track)
             placed = False
             for peer in sorted(
                     (p for p in self.engines

@@ -1,0 +1,412 @@
+"""Per-request distributed tracing (observability/tracing.py).
+
+The contracts under test:
+
+- **blame is an accounting identity**: every finished request's
+  component decomposition (queue | prefill | decode | handoff |
+  rehome) sums *exactly* to its measured E2E, and the prefix up to
+  the ``first_token`` mark is exactly the engine's own TTFT — on the
+  plain engine, through a ReplicaRouter kill/re-home, and through a
+  DisaggRouter handoff + decode-worker kill (the PR 14 chaos paths
+  stitch the survivor's marks onto the *original* trace, so a
+  re-homed request is ONE timeline with a ``rehome`` component, never
+  two half-traces);
+- **exports are byte-identical on replay**: two same-seed virtual-
+  clock runs write identical chrome-trace and spans-JSONL bytes
+  (request ids and track names are normalized at export time — the
+  process-unique counters never leak), the flake guard behind the
+  soak harness's trace artifact;
+- the chrome trace is Perfetto-loadable (track metadata, ``X`` spans,
+  one ``s``/``t``/``f`` flow per request) and both export formats
+  round-trip through ``tools/trace_summary.py --blame``;
+- sampling (``FLAGS_serving_trace``) is deterministic per request id,
+  the finished ring (``FLAGS_serving_trace_keep``) evicts oldest-
+  first, ``GET /v1/requests/<id>`` serves the timeline (404 unknown /
+  evicted, 400 malformed), and ``window_snapshots`` turns finished
+  traces into per-window attainment + SLO burn rate;
+- ``predict_serving_compiles(tracing=...)`` is a *validated* no-op:
+  tracing is host-side marks, never a compiled-surface change.
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability
+from paddle_tpu.analysis import predict_serving_compiles
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.tracing import COMPONENTS, TraceStore
+from paddle_tpu.serving import (DisaggRouter, ReplicaRouter, ServingEngine,
+                                ServingHTTPServer)
+from tools import trace_summary
+from tools.loadgen import LoadGen, VirtualClock
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=97, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 97, size=n).tolist() for n in sizes]
+
+
+_GEOM = dict(max_slots=3, max_len=32, buckets=[8, 16], max_queue=16,
+             block_size=4)
+
+
+def _identity(info):
+    """The accounting identity on one debug-endpoint payload."""
+    assert sum(info["blame_ms"].values()) == \
+        pytest.approx(info["e2e_ms"], abs=1e-6), info
+    assert set(info["blame_ms"]) <= set(COMPONENTS), info
+
+
+# ------------------------------------------------- blame identity
+def test_blame_identity_plain_engine(model):
+    tracing.reset()
+    eng = ServingEngine(model, **_GEOM)
+    reqs = [eng.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 5, 7), seed=1)]
+    eng.run_until_idle()
+    for r in reqs:
+        info = tracing.get(r.id)
+        assert info is not None and info["outcome"] == "done"
+        _identity(info)
+        kinds = [m["kind"] for m in info["marks"]]
+        assert kinds[0] == "submit" and kinds[-1] == "finish"
+        assert "first_token" in kinds
+        # the blame prefix up to first_token IS the engine's own TTFT
+        assert info["ttft_ms"] == pytest.approx(r.ttft * 1e3, abs=1e-3)
+    summ = tracing.blame_summary()
+    assert summ["requests"] == len(reqs)
+    assert summ["tail_dominant"] in COMPONENTS
+    shares = [c["share"] for c in summ["components"].values()]
+    assert sum(shares) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_store_shed_and_inflight_outcomes():
+    st = TraceStore()
+    assert st.begin(5, 0.0, "engine0")
+    assert st.get(5)["outcome"] == "in_flight"
+    assert st.finish(5, 1.0, "engine0", "shed", reason="queue_full")
+    info = st.get(5)
+    assert info["outcome"] == "shed" and info["reason"] == "queue_full"
+    assert info["ttft_ms"] is None          # shed before a first token
+    _identity(info)
+    # shed traces never pollute the done-only blame aggregate
+    assert st.blame_summary()["requests"] == 0
+
+
+@pytest.mark.chaos
+def test_kill_rehome_stitches_one_trace_router(model):
+    """Kill a replica holding admitted work: the survivor's marks land
+    on the ORIGINAL trace — one timeline across two tracks, with the
+    re-home penalty as its own blame component."""
+    tracing.reset()
+    rt = ReplicaRouter(model, n_replicas=2, **_GEOM)
+    prompts = _prompts((3, 5, 7), seed=2)
+    reqs = [rt.engines[0].submit(p, max_new_tokens=4) for p in prompts]
+    rt.engines[0].step()
+    rt.engines[0].step()
+    info_k = rt.kill_replica(0)
+    assert info_k["rehomed"] == len(prompts)
+    rt.run_until_idle()
+    for r in reqs:
+        assert r.state == "done" and r.rehomed
+        info = tracing.get(r.id)
+        assert info is not None and info["outcome"] == "done"
+        kinds = [m["kind"] for m in info["marks"]]
+        assert "kill" in kinds, kinds
+        assert "rehome" in info["blame_ms"], info["blame_ms"]
+        assert info["blame_ms"]["rehome"] > 0.0
+        # dead replica's track AND the survivor's on one trace
+        assert len({m["track"] for m in info["marks"]}) >= 2
+        _identity(info)
+
+
+@pytest.mark.chaos
+def test_kill_decode_worker_keeps_one_trace_disagg(model):
+    """Disagg in-flight kill: export/adopt handoff marks plus the kill
+    -> re-adopt re-home, all on one trace with handoff AND rehome
+    blame components."""
+    tracing.reset()
+    rt = DisaggRouter(model, n_prefill=1, n_decode=2,
+                      prefix_cache=False, **_GEOM)
+    prompts = _prompts((3, 7), seed=3)
+    reqs = [rt.submit(p, max_new_tokens=6) for p in prompts]
+    rt.step()          # prefill + export
+    rt.step()          # decode worker 0 adopts (drains first)
+    assert len(rt.decodes[0]._active) == len(prompts)
+    info_k = rt.kill_decode_worker(0)
+    assert info_k["rehomed"] == len(prompts)
+    rt.run_until_idle()
+    for r in reqs:
+        assert r.state == "done"
+        info = tracing.get(r.id)
+        assert info is not None and info["outcome"] == "done"
+        kinds = [m["kind"] for m in info["marks"]]
+        for k in ("export", "adopt", "kill"):
+            assert k in kinds, kinds
+        assert {"handoff", "rehome"} <= set(info["blame_ms"]), \
+            info["blame_ms"]
+        _identity(info)
+
+
+# ------------------------------------------------- export formats
+def _traced_burst(model, seed=11):
+    """Seeded loadgen burst on a virtual clock; store holds the run."""
+    tracing.reset()
+    vc = VirtualClock()
+    eng = ServingEngine(model, clock=vc.now, slo_ttft_ms=60.0,
+                        slo_prefill_ms=4.0, slo_tpot_ms=1.5, **_GEOM)
+    lg = LoadGen(mode="bursty", rate=30.0, duration=0.5, seed=seed,
+                 vocab_size=97, prompt_tokens=(3, 7), new_tokens=(2, 4))
+    report = lg.run(eng, clock=vc, step_cost_ms=4.0)
+    assert report["completed"] > 0
+    return report
+
+
+def test_seeded_virtual_clock_exports_byte_identical(model, tmp_path):
+    """The flake guard: same seed + virtual clock => byte-identical
+    chrome trace, spans JSONL, and window snapshots across two
+    independent runs (process-unique request/engine ids are
+    normalized away at export time)."""
+    artifacts = []
+    for run in ("a", "b"):
+        _traced_burst(model)
+        chrome = tmp_path / f"trace_{run}.json"
+        spans = tmp_path / f"spans_{run}.jsonl"
+        tracing.export_chrome_trace(str(chrome))
+        tracing.export_spans_jsonl(str(spans))
+        snaps = tracing.window_snapshots(4, 1.0, slo_ttft_ms=40.0,
+                                         slo_target=0.99)
+        artifacts.append((chrome.read_bytes(), spans.read_bytes(),
+                          snaps))
+    assert artifacts[0][0] == artifacts[1][0]
+    assert artifacts[0][1] == artifacts[1][1]
+    assert artifacts[0][2] == artifacts[1][2]
+
+
+def test_chrome_trace_structure(model):
+    """Perfetto-loadable: process/thread metadata with NORMALIZED
+    track names, X spans with normalized request indices, one
+    s/t/f flow per request."""
+    tracing.reset()
+    eng = ServingEngine(model, **_GEOM)
+    reqs = [eng.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 5, 7), seed=5)]
+    eng.run_until_idle()
+    doc = tracing.export_chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert "process_name" in {e["name"] for e in meta}
+    tnames = [e["args"]["name"] for e in meta
+              if e["name"] == "thread_name"]
+    assert tnames == ["engine0"], tnames   # engine id never leaks
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all(e["name"] in COMPONENTS for e in xs)
+    assert all(isinstance(e["ts"], int) and e["dur"] >= 0 for e in xs)
+    assert {e["args"]["request"] for e in xs} == set(range(len(reqs)))
+    for idx in range(len(reqs)):
+        flow = [e["ph"] for e in evs
+                if e.get("id") == idx and e["ph"] in ("s", "t", "f")]
+        assert flow[0] == "s" and flow[-1] == "f", flow
+
+
+def test_trace_summary_blame_roundtrip(model, tmp_path, capsys):
+    """Both export formats feed tools/trace_summary.py --blame and
+    agree on the request population."""
+    tracing.reset()
+    eng = ServingEngine(model, **_GEOM)
+    reqs = [eng.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 5, 7), seed=6)]
+    eng.run_until_idle()
+    chrome = tmp_path / "trace.json"
+    spans = tmp_path / "spans.jsonl"
+    tracing.export_chrome_trace(str(chrome))
+    tracing.export_spans_jsonl(str(spans))
+    outs = []
+    for path in (chrome, spans):
+        assert trace_summary.main([str(path), "--blame"]) == 0
+        out = capsys.readouterr().out
+        assert "tail blame:" in out and "E2E p95" in out
+        outs.append(out)
+    want = f"{len(reqs)} requests"
+    assert all(o.startswith(want) for o in outs), outs
+    # a runlog has no per-request serving spans: --blame reports so
+    runlog = tmp_path / "runlog-1.jsonl"
+    runlog.write_text("".join(
+        json.dumps({"kind": "train_step", "mono": float(i)}) + "\n"
+        for i in range(2)))
+    assert trace_summary.main([str(runlog), "--blame"]) == 1
+    assert "no per-request serving spans" in capsys.readouterr().out
+
+
+def test_trace_summary_runlog_new_event_kinds(tmp_path, capsys):
+    """The summarizer digests the PR 12-14 fleet event kinds (kills,
+    autoscale, LoRA loads) with their numeric fields averaged."""
+    path = tmp_path / "runlog-1.jsonl"
+    events = [
+        {"kind": "serving_replica_kill", "mono": 1.0, "replica": 0,
+         "rehomed": 3, "shed": 0, "t": 10.0},
+        {"kind": "serving_replica_kill", "mono": 2.0, "replica": 1,
+         "rehomed": 1, "shed": 1, "t": 20.0},
+        {"kind": "serving_worker_kill", "mono": 3.0, "worker": 0,
+         "shed": 0, "rerouted": 2},
+        {"kind": "serving_autoscale", "mono": 4.0, "replicas_from": 1,
+         "replicas_to": 2},
+        {"kind": "serving_lora_load", "mono": 5.0, "page": 1},
+    ]
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    assert trace_summary.main([str(path), "--top", "10"]) == 0
+    out = capsys.readouterr().out
+    for kind in ("serving_replica_kill", "serving_worker_kill",
+                 "serving_autoscale", "serving_lora_load"):
+        assert kind in out, out
+    assert "rehomed=2" in out      # mean of 3 and 1
+
+
+# ------------------------------------------------- debug endpoint
+def test_http_requests_endpoint(model):
+    """GET /v1/requests/<id>: 200 with timeline + blame for a traced
+    request, 404 for unknown ids, 400 for malformed ones."""
+    tracing.reset()
+    eng = ServingEngine(model, **_GEOM)
+    r = eng.submit(_prompts((5,), seed=4)[0], max_new_tokens=4)
+    eng.run_until_idle()
+    srv = ServingHTTPServer(eng, port=0)
+    srv.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                       timeout=60)
+        c.request("GET", f"/v1/requests/{r.id}")
+        resp = c.getresponse()
+        assert resp.status == 200
+        info = json.loads(resp.read())
+        assert info["id"] == r.id and info["outcome"] == "done"
+        assert [m["kind"] for m in info["marks"]][0] == "submit"
+        _identity(info)
+        c.request("GET", "/v1/requests/999999999")
+        resp = c.getresponse()
+        assert resp.status == 404
+        assert "no trace" in json.loads(resp.read())["error"]
+        c.request("GET", "/v1/requests/abc")
+        resp = c.getresponse()
+        assert resp.status == 400
+        resp.read()
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_finished_ring_retention_and_eviction():
+    """FLAGS_serving_trace_keep bounds the finished ring: oldest
+    traces evict first and their ids 404 (get() -> None)."""
+    st = TraceStore()
+    pt.set_flags({"serving_trace_keep": 3})
+    try:
+        for rid in range(6):
+            st.begin(rid, float(rid), "engine0")
+            st.finish(rid, rid + 1.0, "engine0", "done")
+        assert st.dropped == 3
+        for rid in (0, 1, 2):
+            assert st.get(rid) is None
+        for rid in (3, 4, 5):
+            assert st.get(rid) is not None
+    finally:
+        pt.set_flags({"serving_trace_keep": 512})
+
+
+# ------------------------------------------------- sampling
+def test_sampling_deterministic_and_proportional():
+    st = TraceStore()
+    assert all(st.sampled(i, 1.0) for i in range(50))
+    assert not any(st.sampled(i, 0.0) for i in range(50))
+    picks = [st.sampled(i, 0.25) for i in range(2000)]
+    # same id -> same decision, no RNG stream consumed
+    assert picks == [st.sampled(i, 0.25) for i in range(2000)]
+    frac = sum(picks) / len(picks)
+    assert 0.18 < frac < 0.32, frac
+
+
+def test_flag_sampling_off_means_no_trace(model):
+    tracing.reset()
+    pt.set_flags({"serving_trace": 0.0})
+    try:
+        eng = ServingEngine(model, **_GEOM)
+        r = eng.submit(_prompts((5,), seed=8)[0], max_new_tokens=4)
+        eng.run_until_idle()
+        assert r.state == "done"
+        assert tracing.get(r.id) is None
+        assert tracing.blame_summary()["requests"] == 0
+    finally:
+        pt.set_flags({"serving_trace": 1.0})
+
+
+# ------------------------------------------------- predictor no-op
+def test_predictor_tracing_is_validated_noop():
+    wl = [[([1, 2, 3], 4), ([5, 6, 7, 8, 9], 3)]]
+    kw = dict(buckets=[8, 16], max_len=32, block_size=4)
+    plain = predict_serving_compiles(wl, **kw)
+    assert predict_serving_compiles(wl, tracing=True, **kw) == plain
+    assert predict_serving_compiles(wl, tracing=0.25, **kw) == plain
+    with pytest.raises(ValueError, match="tracing"):
+        predict_serving_compiles(wl, tracing=1.5, **kw)
+    with pytest.raises(ValueError, match="tracing"):
+        predict_serving_compiles(wl, tracing=-0.1, **kw)
+
+
+# ------------------------------------------------- windows / burn rate
+def test_window_snapshots_burn_rate_math():
+    """Synthetic traces with hand-placed TTFTs: attainment and burn
+    rate come out exactly, windows bucket on submit time, and the
+    gauge publishes per window."""
+    st = TraceStore()
+
+    def req(rid, sub, ft, fin, outcome="done"):
+        st.begin(rid, sub, "engine0")
+        if ft is not None:
+            st.mark(rid, "admit", sub, "engine0")
+            st.mark(rid, "first_token", ft, "engine0")
+        st.finish(rid, fin, "engine0", outcome)
+
+    req(0, 0.0, 0.01, 0.2)              # ttft 10 ms  (meets 50 ms)
+    req(1, 0.1, 0.13, 0.3)              # ttft 30 ms  (meets)
+    req(2, 1.0, 1.1, 1.4)               # ttft 100 ms (misses)
+    req(3, 1.2, 1.24, 1.5)              # ttft 40 ms  (meets)
+    req(4, 1.3, None, 1.35, "shed")
+    rows = st.window_snapshots(2, 2.0, slo_ttft_ms=50.0,
+                               slo_target=0.9)
+    assert [r["done"] for r in rows] == [2, 2]
+    assert [r["shed"] for r in rows] == [0, 1]
+    assert rows[0]["attainment"] == 1.0 and rows[0]["burn_rate"] == 0.0
+    assert rows[1]["attainment"] == 0.5
+    assert rows[1]["burn_rate"] == pytest.approx(5.0)   # (1-.5)/(1-.9)
+    assert rows[0]["ttft_ms_p50"] == pytest.approx(10.0)
+    assert rows[1]["ttft_ms_p95"] == pytest.approx(100.0)
+    text = observability.prometheus_text()
+    assert "serving_slo_burn_rate" in text
+    # validation
+    with pytest.raises(ValueError):
+        st.window_snapshots(0, 1.0)
+    with pytest.raises(ValueError):
+        st.window_snapshots(2, 0.0)
+    with pytest.raises(ValueError):
+        st.window_snapshots(2, 1.0, slo_target=1.0)
+    # no SLO configured -> rates are None, histograms still fill
+    rows2 = st.window_snapshots(2, 2.0)
+    assert all(r["burn_rate"] is None for r in rows2)
+    assert rows2[0]["ttft_ms_p50"] is not None

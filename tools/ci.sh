@@ -36,13 +36,18 @@
 #   8. observability gate (train + serving smoke under the run log;
 #      /metrics parses as Prometheus text, compile tracker pins the
 #      decode/prefill compile budget, run-log events feed
-#      tools/trace_summary.py)
+#      tools/trace_summary.py; per-request tracing blame identity +
+#      Perfetto export + /v1/requests/<id> debug endpoint, with the
+#      recompile predictor proving tracing never compiles)
 #   9. loadgen SLO gate (seeded open-loop traffic through the
 #      SLO-admitting gpt2-tiny engine: goodput > 0 with attainment
 #      reported and zero leaked KV blocks, then the chaos crossover —
 #      submit/alloc faults injected, degradation must stay graceful —
 #      then the same traffic through a --disagg 1x2 fleet: goodput
-#      still > 0, handoffs actually happened, still zero leaks)
+#      still > 0, handoffs actually happened, still zero leaks —
+#      closing with the tracing-overhead budget: a fully-traced run
+#      must hold goodput within 5% of an untraced one on the same
+#      seed)
 #  10. chaos soak gate (hours of seeded diurnal traffic on the virtual
 #      clock with replica kills injected at virtual instants and
 #      auto-restart healing the fleet: goodput > 0 in every window,
@@ -247,6 +252,36 @@ print(f\"   tenants: \" + \", \".join(
     f\"{n} {t['completed']}/{t['offered']}\" for n, t in pt.items())
       + f\", 0 new compiles, 0 leaks\")
 "
+echo "   tracing-overhead budget (traced vs untraced, <= 5%)"
+# per-request tracing is pure host-side mark appends on the engine
+# clock (never a jit input), so a fully-traced run must hold goodput
+# within 5% of an untraced one on the same seed — the workload is
+# step-compute dominated, which keeps the wall-clock ratio stable
+TRACED_JSON=$(mktemp); UNTRACED_JSON=$(mktemp)
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode bursty --rate "$LG_RATE" --duration "$LG_DURATION" --seed 0 \
+  --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16 \
+  --new-tokens 2:8 --slo-ttft-ms 2000 --trace-sample 1.0 --json \
+  --expect-zero-leaks > "$TRACED_JSON"
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode bursty --rate "$LG_RATE" --duration "$LG_DURATION" --seed 0 \
+  --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16 \
+  --new-tokens 2:8 --slo-ttft-ms 2000 --trace-sample 0.0 --json \
+  --expect-zero-leaks > "$UNTRACED_JSON"
+JAX_PLATFORMS=cpu python - "$TRACED_JSON" "$UNTRACED_JSON" <<'PY'
+import json, sys
+t = json.load(open(sys.argv[1]))
+u = json.load(open(sys.argv[2]))
+assert t["completed"] == u["completed"], (t["completed"], u["completed"])
+assert t["blame"]["requests"] > 0, t.get("blame")
+gt, gu = t["goodput_per_s"], u["goodput_per_s"]
+drop = (gu - gt) / gu if gu else 0.0
+assert drop <= 0.05, \
+    f"tracing overhead {drop:.1%} > 5% budget ({gt} vs {gu}/s)"
+print(f"   tracing overhead: traced {gt}/s vs untraced {gu}/s "
+      f"({drop:+.1%} of the 5% budget)")
+PY
+rm -f "$TRACED_JSON" "$UNTRACED_JSON"
 
 echo "== 10/15 chaos soak gate (virtual-clock fleet fault tolerance)"
 # hours of seeded diurnal traffic compressed into seconds on the

@@ -863,6 +863,15 @@ def main(argv=None) -> int:
                     help="print the report as one JSON line")
     ap.add_argument("--trace", default="",
                     help="write the per-request trace JSON here")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    metavar="FRAC",
+                    help="FLAGS_serving_trace for this run: fraction "
+                    "of requests carrying a distributed trace "
+                    "(deterministic id-hash sampling; 1.0 = all, "
+                    "0 = off). Host-side only — zero new compiles")
+    ap.add_argument("--span-trace-out", default="", metavar="PATH",
+                    help="export the sampled requests' span traces as "
+                    "Perfetto-loadable chrome-trace JSON after the run")
     ap.add_argument("--expect-goodput-min", type=float, default=None,
                     help="exit 1 unless goodput_per_s >= this")
     ap.add_argument("--expect-zero-leaks", action="store_true",
@@ -919,6 +928,11 @@ def main(argv=None) -> int:
         _fl.set_flags({"serving_lora_rank": args.lora_rank,
                        "serving_lora_max_adapters":
                            max(len(lora_tenants), 1)})
+    if args.trace_sample is not None:
+        from paddle_tpu import flags as _fl
+        _fl.set_flags({"serving_trace": args.trace_sample})
+    from paddle_tpu.observability import tracing as _tracing
+    _tracing.reset()
     vc = (VirtualClock() if args.virtual_step_ms > 0 else None)
     eng_kwargs = dict(
         max_slots=args.slots, max_len=args.max_len,
@@ -973,6 +987,14 @@ def main(argv=None) -> int:
         with open(args.trace, "w") as f:
             json.dump({"schedule": json.loads(lg.trace_bytes()),
                        "requests": trace}, f)
+    if args.span_trace_out:
+        _tracing.export_chrome_trace(args.span_trace_out)
+        report["span_trace"] = args.span_trace_out
+    # blame rides in the report whenever any request carried a trace
+    # (FLAGS_serving_trace defaults to sampling everything)
+    blame = _tracing.blame_summary()
+    if blame["requests"]:
+        report["blame"] = blame
     if args.json:
         print(json.dumps(report))
     else:

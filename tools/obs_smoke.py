@@ -47,9 +47,17 @@ Asserts, end to end through the observability plane:
     equals the predictor's claim (``sampling`` recipes are validated
     no-ops, ``lora`` geometry is one retrace), and neither KV blocks
     nor adapter pages leak;
+  - per-request tracing (FLAGS_serving_trace, default-on) on a traced
+    burst through a fresh engine: every finished request's blame
+    decomposition sums exactly to its measured E2E (the accounting
+    identity in paddle_tpu/observability/tracing.py), the chrome-trace
+    export is a Perfetto-loadable document with one flow per request,
+    GET /v1/requests/<id> serves the span timeline (and 404s unknown
+    ids), and the predictor agrees ``tracing`` never compiles —
+    per-phase predicted counts equal the live tracker;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
-    int8-quantization and SLO-admission metrics;
+    int8-quantization, SLO-admission and tracing metrics;
   - tools/trace_summary.py consumes the emitted JSONL run log.
 
 Run from the repo root:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
@@ -61,6 +69,7 @@ import json
 import os
 import sys
 import tempfile
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -521,6 +530,81 @@ def main() -> int:
     finally:
         pt.set_flags({"serving_lora_rank": 0})
 
+    # -- tracing phase: spans, blame identity, Perfetto, debug API ----
+    # FLAGS_serving_trace defaults to 1.0, so every request above was
+    # already traced — host-side (kind, t, track) marks on the engine
+    # clock, never a jit input. Reset the ring and run a traced burst
+    # on a fresh engine at the warm geometry: the decoding phase's
+    # finally bumped the flags version, so each site retraces exactly
+    # once (a fresh phase, like the pallas one) and the per-phase
+    # delta must equal the predictor's claim WITH tracing=True — which
+    # must itself equal the prediction without it (the no-op family).
+    # Every finished request's blame components must sum exactly to
+    # its measured E2E, the chrome export must be a Perfetto document
+    # with flow events stitching each request across tracks, and the
+    # HTTP debug endpoint must serve the timeline.
+    from paddle_tpu.observability import tracing
+    tracing.reset()
+    baseT = {site: c["count"]
+             for site, c in observability.compiles().items()
+             if site.startswith(("serving_", "decode_", "verify_"))}
+    engT = ServingEngine(model, max_slots=3, max_len=32,
+                         buckets=[8, 16], max_queue=16, block_size=4)
+    reqsT = [engT.submit(p, max_new_tokens=4) for p in prompts]
+    engT.run_until_idle()
+    assert all(r.state == "done" for r in reqsT)
+    for r in reqsT:
+        info = tracing.get(r.id)
+        assert info is not None and info["outcome"] == "done", info
+        gap = abs(sum(info["blame_ms"].values()) - info["e2e_ms"])
+        assert gap < 1e-6, (
+            f"blame identity broke on request {r.id}: components "
+            f"{info['blame_ms']} vs e2e {info['e2e_ms']} (gap {gap})")
+    docT = tracing.export_chrome_trace()
+    spansT = [e for e in docT["traceEvents"] if e.get("ph") == "X"]
+    flowsT = [e for e in docT["traceEvents"]
+              if e.get("ph") in ("s", "t", "f")]
+    assert spansT and len(flowsT) >= 1, (len(spansT), len(flowsT))
+    assert {e["args"]["request"] for e in spansT} == \
+        set(range(len(reqsT))), spansT
+    afterT = {site: c["count"]
+              for site, c in observability.compiles().items()
+              if site.startswith(("serving_", "decode_", "verify_"))}
+    deltaT = {site: n - baseT.get(site, 0) for site, n in afterT.items()
+              if n - baseT.get(site, 0)}
+    burstT = [[(p, 4) for p in prompts]]
+    predT = predict_serving_compiles(
+        burstT, buckets=[8, 16], max_len=32, block_size=4,
+        tracing=True)
+    assert predT == predict_serving_compiles(
+        burstT, buckets=[8, 16], max_len=32, block_size=4), (
+        "tracing must be a predictor no-op")
+    assert deltaT == predT, (
+        f"tracing-phase recompile prediction drifted:\n"
+        f"  predicted {predT}\n  observed  {deltaT}")
+    srvT = ServingHTTPServer(engT, port=0)
+    srvT.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srvT.port}/v1/requests/"
+                f"{reqsT[0].id}", timeout=10) as r:
+            assert r.status == 200
+            got = json.loads(r.read().decode())
+        assert got["outcome"] == "done" and got["marks"], got
+        assert got["blame_ms"] == tracing.get(reqsT[0].id)["blame_ms"]
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srvT.port}/v1/requests/999999",
+                timeout=10)
+            raise AssertionError("unknown request id must 404")
+        except urllib.error.HTTPError as e404:
+            assert e404.code == 404, e404.code
+    finally:
+        srvT.stop()
+    print(f"   tracing: {len(reqsT)} traced requests, blame sums == "
+          f"E2E, {len(spansT)} spans / {len(flowsT)} flow events, "
+          f"/v1/requests/<id> 200+404, {deltaT} == predicted")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -549,7 +633,8 @@ def main() -> int:
                    "STAT_serving_lora_loads",
                    "serving_replica_state",
                    "serving_rehomed_total",
-                   "STAT_serving_rehomed"):
+                   "STAT_serving_rehomed",
+                   "serving_traced_total"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 

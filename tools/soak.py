@@ -41,6 +41,14 @@ degradation contract**:
 frontier (replica-seconds provisioned vs SLO-met completions/s) —
 written to ``--out`` (e.g. ``BENCH_r12.json``).
 
+Every request carries a distributed trace (``observability.tracing``,
+virtual-clock timestamps), so the per-window report also includes SLO
+**burn rate** ((1 - attainment) / (1 - ``--slo-target``)) and TTFT
+percentiles from the trace store, the record includes the fleet blame
+summary (which latency component dominates the E2E p95 tail), and
+``--trace-out`` exports the whole arm as Perfetto-loadable
+chrome-trace JSON — byte-identical across same-seed runs.
+
 CLI gates (``--expect-*``) exit nonzero on violation, so CI can hold
 the line::
 
@@ -100,10 +108,14 @@ def run_arm(model, lg, args, *,
             fault_spec: str = "") -> dict:
     """One soak arm: fresh fleet, same schedule, same kill times."""
     from paddle_tpu import observability as _obs
+    from paddle_tpu.observability import tracing as _tracing
     from paddle_tpu.resilience import fault_scope
     from paddle_tpu.serving import AutoscalePolicy, ReplicaRouter
     from tools.loadgen import VirtualClock, warmup
 
+    # fresh trace store per arm: every span in the export belongs to
+    # THIS run, and two same-seed soaks export byte-identical traces
+    _tracing.reset()
     vc = VirtualClock()
     rt = ReplicaRouter(
         model, n_replicas=args.replicas,
@@ -196,6 +208,15 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="",
                     help="write the soak record (windows + frontier) "
                     "here, e.g. BENCH_r12.json")
+    ap.add_argument("--trace-out", default="", metavar="PATH",
+                    help="export the primary arm's per-request span "
+                    "traces as Perfetto-loadable chrome-trace JSON "
+                    "(virtual-clock timestamps: byte-identical across "
+                    "same-seed runs)")
+    ap.add_argument("--slo-target", type=float, default=0.99,
+                    help="SLO attainment target the per-window burn "
+                    "rate is measured against (burn = (1 - "
+                    "attainment) / (1 - target); default 0.99)")
     ap.add_argument("--expect-kills-min", type=int, default=None,
                     help="exit 1 unless the primary arm killed+"
                     "restarted at least this many replicas")
@@ -243,6 +264,21 @@ def main(argv=None) -> int:
     lg = fresh_lg()
     report = run_arm(model, lg, args, fault_spec=spec)
     windows = _windows(report, args.windows)
+
+    # ---- tracing view of the same arm: burn rate + blame -----------
+    # (snapshot BEFORE the sweep arms reset the trace store)
+    from paddle_tpu.observability import tracing as _tracing
+    snaps = _tracing.window_snapshots(
+        args.windows, max(report["makespan_s"], 1e-9),
+        slo_ttft_ms=args.slo_ttft_ms, slo_target=args.slo_target)
+    for row, snap in zip(windows, snaps):
+        row["attainment"] = snap["attainment"]
+        row["burn_rate"] = snap["burn_rate"]
+        row["ttft_ms_p50"] = snap["ttft_ms_p50"]
+        row["ttft_ms_p95"] = snap["ttft_ms_p95"]
+    blame = _tracing.blame_summary()
+    if args.trace_out:
+        _tracing.export_chrome_trace(args.trace_out)
     trace = report.pop("trace")
     errored = sum(1 for d in report["decisions"]
                   if d[0] in ("invalid", "error"))
@@ -310,10 +346,15 @@ def main(argv=None) -> int:
         "fault_spec": spec,
         "report": report,
         "windows": windows,
+        "blame": blame,
+        "slo_target": args.slo_target,
+        "burn_rate": [row["burn_rate"] for row in windows],
         "predictor_noop": predictor_noop,
         "identity_ok": identity_ok,
         "frontier": frontier,
     }
+    if args.trace_out:
+        out["trace_out"] = args.trace_out
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1, sort_keys=True)
@@ -328,11 +369,17 @@ def main(argv=None) -> int:
                   "new_compiles_after_warmup"):
             print(f"{k}: {report[k]}")
         for row in windows:
+            burn = row.get("burn_rate")
             print(f"window {row['window']} "
                   f"[{row['t0']:>8.1f}s..{row['t1']:>8.1f}s): "
                   f"offered {row['offered']:>3} completed "
                   f"{row['completed']:>3} goodput "
-                  f"{row['goodput_per_s']}/s")
+                  f"{row['goodput_per_s']}/s burn "
+                  f"{'-' if burn is None else burn}")
+        if blame["requests"]:
+            print(f"tail blame: {blame['tail_dominant']} dominates "
+                  f"the E2E p95 tail ({blame['e2e_ms_p95']} ms over "
+                  f"{blame['requests']} traced requests)")
         for row in frontier:
             print(f"frontier {row['arm']}: "
                   f"{row['replica_seconds']} replica-s -> "

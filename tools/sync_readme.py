@@ -660,7 +660,8 @@ def sync_trainserve_block(text, check):
 
 _OBS_BEGIN = "<!-- BEGIN GENERATED: observability -->"
 _OBS_END = "<!-- END GENERATED: observability -->"
-_OBS_FLAGS = ("warn_recompiles", "runlog_dir", "runlog_max_mb")
+_OBS_FLAGS = ("warn_recompiles", "runlog_dir", "runlog_max_mb",
+              "serving_trace", "serving_trace_keep")
 
 
 def render_observability_block():
@@ -691,6 +692,40 @@ def render_observability_block():
         "`BENCH_*.json`, and counter/histogram summaries appended to",
         "`profiler.stop_profiler()`'s table. The `monitor.stat_*` API",
         "is a shim over the same registry.",
+        "",
+        "Per-request tracing rides on top",
+        "(`paddle_tpu.observability.tracing`): every sampled request",
+        "(`FLAGS_serving_trace`, default everything) carries its id",
+        "from `submit()` through admit / prefill / handoff / decode /",
+        "re-home / finish-or-shed as host-side `(kind, t, track)` marks",
+        "on the engine's own clock (wall or the soak harness's virtual",
+        "clock — never a jit input, so tracing is a validated",
+        "zero-compile no-op: `predict_serving_compiles(...,",
+        "tracing=True)`). A kill stitches the survivor's spans onto the",
+        "original trace, so a re-homed request is ONE timeline whose",
+        "re-home penalty is its own blame component. `tracing.blame()`",
+        "decomposes each finished request's E2E into queue | prefill |",
+        "decode | handoff | rehome components that sum *exactly* to the",
+        "measured E2E (and the prefix up to the first token exactly to",
+        "TTFT) — an accounting identity, not an approximation;",
+        "`blame_summary()` aggregates fleet-wide shares, p95s and the",
+        "component that dominates the E2E-p95 tail.",
+        "`export_chrome_trace()` writes a Perfetto-loadable chrome",
+        "trace — one named track per engine/replica/role, one flow per",
+        "request stitching its spans across tracks — and",
+        "`export_spans_jsonl()` the same spans as JSONL; both",
+        "canonicalize ids and track names so two same-seed virtual-",
+        "clock runs export byte-identical files (a CI flake guard).",
+        "`python tools/trace_summary.py TRACE --blame` prints the",
+        "component blame table from either export;",
+        "`GET /v1/requests/<id>` on `ServingHTTPServer` serves one",
+        "request's live timeline + blame (404 once evicted from the",
+        "`FLAGS_serving_trace_keep` ring); and",
+        "`tracing.window_snapshots(...)` folds finished traces into",
+        "per-window TTFT histograms, SLO attainment and burn rate",
+        "(`(1 - attainment) / (1 - target)`) — the",
+        "`serving_slo_burn_rate` gauge and the per-window report of",
+        "`tools/soak.py --trace-out`.",
         "",
         "Instruments:",
         "",
