@@ -137,7 +137,8 @@ def predict_serving_compiles(
         disagg: Optional[Tuple[int, int]] = None,
         sampling: Optional[Sequence[Tuple[float, int, float]]] = None,
         lora: Optional[Tuple[int, int]] = None,
-        tracing: Optional[float] = None) -> Dict[str, int]:
+        tracing: Optional[float] = None,
+        sanitize: bool = False) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -260,6 +261,16 @@ def predict_serving_compiles(
     into any jitted function, no shape, dtype or donation anywhere
     near the step cache. Tracing every request predicts the same
     counts as tracing none.
+
+    ``sanitize`` (``FLAGS_sanitize_locks``: the concurrency
+    sanitizer) is a validated no-op like ``tracing``: the sanitizer
+    swaps host-side ``threading`` locks for instrumented wrappers and
+    checks guarded-state writes in ``__setattr__`` — pure Python
+    control flow around the compiled dispatches, with no tensor,
+    shape, dtype or donation anywhere near the step cache. Running
+    the whole fleet under the sanitizer predicts the same counts as
+    running it bare (and ``tools/obs_smoke.py`` asserts exactly
+    that, predicted == observed, with the flag on).
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -337,6 +348,10 @@ def predict_serving_compiles(
             raise ValueError(
                 f"tracing must be a sampling fraction in [0, 1] (or "
                 f"True = 1.0), got {tracing!r}")
+    if sanitize not in (True, False):
+        raise ValueError(
+            f"sanitize must be a bool (FLAGS_sanitize_locks is "
+            f"on/off), got {sanitize!r}")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

@@ -133,6 +133,16 @@ define_flag("check_program", False,
             "ERROR diagnostics abort the run with block/op locations "
             "instead of an opaque tracer error. Default off in "
             "production; tests/conftest.py turns it on for the suite.")
+define_flag("sanitize_locks", False,
+            "Swap the serving/observability locks for instrumented "
+            "wrappers (analysis/concurrency.py): record the per-thread "
+            "lock-acquisition-order graph, report lock-order inversions "
+            "(potential deadlock cycles) with held-lock witnesses, and "
+            "enforce the declared guarded-state registry — a write to "
+            "a '# guarded-by' attribute without its lock raises "
+            "GuardedStateError. Pure host-side instrumentation: zero "
+            "overhead when off (plain threading locks), zero effect on "
+            "compiled steps when on.")
 define_flag("check_ir_passes", False,
             "Verify the Program IR after every pass in a "
             "PassManager.apply pipeline; a failure names the offending "

@@ -100,6 +100,12 @@ INSTRUMENT_DOCS = {
         "the engine clock whose spans decompose TTFT/E2E into "
         "queue | prefill | decode | handoff | rehome components — an "
         "accounting identity, see observability/tracing.py)",
+    "sanitizer_lock_acquires":
+        "counter — lock acquisitions instrumented by the concurrency "
+        "sanitizer (FLAGS_sanitize_locks): every outermost acquire of "
+        "a make_lock() lock records held->acquired order edges; "
+        "inversions and guarded-state violations are read back via "
+        "analysis.sanitizer_report()",
     "serving_slo_burn_rate{window=...}":
         "gauge — per-window SLO error-budget burn rate from "
         "tracing.window_snapshots: (1 - window attainment) / "

@@ -20,6 +20,18 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+try:
+    # under FLAGS_sanitize_locks the registry lock joins the
+    # concurrency sanitizer's order graph (engine/router locks are
+    # held across gauge updates — exactly the edges worth watching);
+    # plain RLock otherwise, and during early-bootstrap import orders
+    # where the analysis plane isn't loadable yet
+    from ..analysis.concurrency import make_lock as _make_lock
+except ImportError:                                  # pragma: no cover
+    def _make_lock(name, reentrant=False):
+        return (threading.RLock() if reentrant
+                else threading.Lock())
+
 # 4 buckets per decade, 1e-6 .. 1e4: spans ns-scale host timings to
 # multi-hour totals whether callers observe seconds or milliseconds
 DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
@@ -222,7 +234,7 @@ class MetricsRegistry:
     call site and raises."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _make_lock("metrics.registry", reentrant=True)
         self._instruments: Dict[str, Instrument] = {}
 
     def _get_or_create(self, cls, name: str, help_str: str,

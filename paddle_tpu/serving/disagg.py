@@ -69,6 +69,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import monitor as _monitor
+from ..analysis import concurrency as _ccz
 from .. import observability as _obs
 from ..observability import runlog as _runlog
 from ..observability import tracing as _tracing
@@ -124,8 +125,9 @@ class HandoffQueue:
         if bound < 1:
             raise ValueError(f"handoff bound must be >= 1, got {bound}")
         self.bound = int(bound)
-        self._items: deque = deque()
-        self._lock = threading.Lock()
+        self._items: deque = deque()     # guarded-by: _lock
+        self._lock = _ccz.make_lock("handoff._lock")
+        _ccz.declare_guarded(self, {"_items": "_lock"})
 
     def __len__(self) -> int:
         with self._lock:
@@ -195,9 +197,9 @@ class PrefillEngine(ServingEngine):
         kwargs["paged"] = True
         super().__init__(model, **kwargs)
         self._handoff = handoff
-        self._pending: deque = deque()   # exported, waiting for room
+        self._pending: deque = deque()  # guarded-by: _step_lock
 
-    def _flush_pending(self) -> int:
+    def _flush_pending(self) -> int:  # holds: _step_lock
         moved = 0
         while self._pending:
             if not self._handoff.put(self._pending[0]):
@@ -206,7 +208,7 @@ class PrefillEngine(ServingEngine):
             moved += 1
         return moved
 
-    def _stage_running(self) -> int:
+    def _stage_running(self) -> int:  # holds: _step_lock
         """Export every running row into ``_pending`` (deterministic
         request-id order so seeded runs replay exactly)."""
         staged = 0
@@ -250,7 +252,7 @@ class PrefillEngine(ServingEngine):
             queued = bool(self._queue)
         return not queued and not self._active and not self._pending
 
-    def shed_pending(self, reason: str = "fault") -> int:
+    def shed_pending(self, reason: str = "fault") -> int:  # holds: _step_lock
         """Shed every exported-but-undelivered record, releasing its
         block references — the killed-worker cleanup path."""
         shed = 0
@@ -287,15 +289,17 @@ class DecodeEngine(ServingEngine):
         kwargs["paged"] = True
         super().__init__(model, **kwargs)
         self._handoff = handoff
-        self.adopted = 0          # handoffs spliced/copied in
-        self.adopted_copies = 0   # the cross-pool subset
+        self.adopted = 0          # guarded-by: _step_lock
+        self.adopted_copies = 0   # guarded-by: _step_lock
+        _ccz.declare_guarded(self, {"adopted": "_step_lock",
+                                    "adopted_copies": "_step_lock"})
 
     def submit(self, *a, **k):
         raise RuntimeError(
             "DecodeEngine does not accept submissions; submit through "
             "the DisaggRouter (prefill workers feed this engine)")
 
-    def _handoff_attempt(self, item: _Handoff) -> Optional[int]:
+    def _handoff_attempt(self, item: _Handoff) -> Optional[int]:  # holds: _step_lock
         """Adopt one record; None = no capacity (leave it queued).
         The ``serving.handoff`` fault site injects here: ``skip``
         sheds the request, drop/error retries per RetryPolicy."""
@@ -313,7 +317,7 @@ class DecodeEngine(ServingEngine):
             self.adopted_copies += 1
         return row
 
-    def _adopt_handoffs(self) -> int:
+    def _adopt_handoffs(self) -> int:  # holds: _step_lock
         """Drain what fits: same-pool records first (free splices),
         then cross-pool copies, oldest first within each class."""
         adopted = 0
@@ -469,15 +473,15 @@ class DisaggRouter:
             self.decodes.append(
                 DecodeEngine(model, self._handoff, **kw))
         self.colocate = bool(colocate)
-        self._killed: List[ServingEngine] = []
-        self._rehomed = 0
-        self._draining = False
-        self._lock = threading.Lock()
+        self._killed: List[ServingEngine] = []  # guarded-by: _lock
+        self._rehomed = 0                       # guarded-by: _lock
+        self._draining = False                  # guarded-by: _lock
+        self._lock = _ccz.make_lock("disagg._lock")
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # chain key -> PrefillEngine that last prefilled that prefix
         self._affinity: "OrderedDict[int, PrefillEngine]" = \
-            OrderedDict()
+            OrderedDict()                       # guarded-by: _lock
         rid = str(next(DisaggRouter._router_ids))
         self._rid = rid
         self._aff_hits = _obs.counter(
@@ -508,6 +512,9 @@ class DisaggRouter:
             "serving_disagg_workers",
             "single-role workers in this disaggregated fleet, by role"
             ).labels(router=rid, role="decode").set(n_decode)
+        _ccz.declare_guarded(self, {
+            "_rehomed": "_lock", "_draining": "_lock",
+            "_killed": "_lock", "_affinity": "_lock"})
 
     # ----------------------------------------------------------- routing
     @property
@@ -541,12 +548,17 @@ class DisaggRouter:
         against the worker's actual pool (a stale hit still routes
         there: queued same-prefix requests coalesce and re-publish)."""
         for key in reversed(keys):
-            eng = self._affinity.get(key)
-            if eng is None or eng.draining or \
-                    eng not in self.prefills:
-                continue
-            self._affinity.move_to_end(key)
-            idx = self.prefills.index(eng)
+            # the index is shared with every submitting thread and the
+            # kill path — reads, LRU bumps and publishes all take the
+            # router lock (an unlocked move_to_end on the OrderedDict
+            # corrupts its internal linkage under contention)
+            with self._lock:
+                eng = self._affinity.get(key)
+                if eng is None or eng.draining or \
+                        eng not in self.prefills:
+                    continue
+                self._affinity.move_to_end(key)
+                idx = self.prefills.index(eng)
             if eng.cache.match_prefix_blocks(prompt) > 0:
                 self._aff_hits.add(1)
                 _monitor.stat_add("STAT_serving_affinity_hits")
@@ -558,11 +570,12 @@ class DisaggRouter:
 
     def _publish_affinity(self, keys: Sequence[int],
                           eng: "PrefillEngine"):
-        for key in keys:
-            self._affinity[key] = eng
-            self._affinity.move_to_end(key)
-        while len(self._affinity) > self.AFFINITY_CAP:
-            self._affinity.popitem(last=False)
+        with self._lock:
+            for key in keys:
+                self._affinity[key] = eng
+                self._affinity.move_to_end(key)
+            while len(self._affinity) > self.AFFINITY_CAP:
+                self._affinity.popitem(last=False)
 
     def _route_attempt(self, prompt, max_new_tokens, eos_token_id,
                        priority, **decode_kwargs) -> Request:
@@ -757,8 +770,10 @@ class DisaggRouter:
             eng.draining = True
             self._killed.append(eng)
         # forget the worker in the affinity index
-        for key in [k for k, v in self._affinity.items() if v is eng]:
-            del self._affinity[key]
+        with self._lock:
+            for key in [k for k, v in self._affinity.items()
+                        if v is eng]:
+                del self._affinity[key]
         # undelivered handoff records: shed + release their refs
         shed = 0
         for item in self._handoff.evict_from(eng):
@@ -853,7 +868,6 @@ class DisaggRouter:
                     key=lambda p: (
                         0 if rec["pool"] is p.cache.pool else 1,
                         self._depth(p), -self._blocks_free(p)))
-                handled = False
                 for peer in order:
                     same_pool = rec["pool"] is peer.cache.pool
                     row2 = (peer.cache.import_row(rec) if same_pool
@@ -863,7 +877,6 @@ class DisaggRouter:
                     if not same_pool:
                         # the copy is done; drop the source references
                         rec["pool"].release_blocks(rec["blocks"])
-                    handled = True
                     if req.tenant and peer.lora_pool is not None:
                         try:
                             peer.lora_pool.acquire(req.tenant)
@@ -887,7 +900,10 @@ class DisaggRouter:
                             stage="adopt", engine=peer._eid,
                             slot=row2, copied=not same_pool)
                     break
-                if not handled:
+                else:
+                    # no survivor had room (every import/adopt came
+                    # back None, leaving the record's references
+                    # intact) — shed with everything released
                     rec["pool"].release_blocks(rec["blocks"])
                     eng._shed(req, QueueFullError(
                         "no surviving decode worker could adopt the "
@@ -898,7 +914,8 @@ class DisaggRouter:
         if not any(e.cache.pool is eng.cache.pool
                    for e in self.prefills + self.decodes):
             eng.cache.flush_prefix_cache()
-        self._rehomed += rehomed
+        with self._lock:
+            self._rehomed += rehomed
         _monitor.stat_add("STAT_serving_worker_killed")
         _runlog.log_event("serving_worker_kill", role="decode",
                           worker=index, shed=shed, rerouted=rehomed,
@@ -998,11 +1015,17 @@ class DisaggRouter:
                     t[0] += c
                     t[1] += el
                     t[2] += m
+        # router-owned mutable state under the router lock — stats()
+        # is scraped from the HTTP thread while kills/re-homes run
+        with self._lock:
+            draining = self._draining
+            rehomed = self._rehomed
+            affinity_entries = len(self._affinity)
         out = {
             "prefill_workers": len(self.prefills),
             "decode_workers": len(self.decodes),
             "colocated": self.colocate,
-            "draining": self._draining,
+            "draining": draining,
             "handoff_queued": len(self._handoff),
             "handoff_bound": self._handoff.bound,
             "handoffs_adopted": adopted,
@@ -1010,14 +1033,14 @@ class DisaggRouter:
             "prefix_affinity": self.prefix_affinity,
             "affinity_hits": int(self._aff_hits.value),
             "affinity_misses": int(self._aff_misses.value),
-            "affinity_index_entries": len(self._affinity),
+            "affinity_index_entries": affinity_entries,
             "fleet_prefix_hits": hits,
             "fleet_prefix_misses": misses,
             "fleet_prefix_hit_rate": (
                 round(hits / (hits + misses), 4)
                 if hits + misses else None),
             "completed": completed,
-            "rehomed": self._rehomed,
+            "rehomed": rehomed,
             "shed": shed,
             "shed_total": sum(shed.values()),
             "queue_depths": [self._depth(e) for e in self.prefills],

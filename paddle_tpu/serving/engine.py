@@ -106,6 +106,7 @@ import jax.numpy as jnp
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from ..analysis import concurrency as _ccz
 from .. import observability as _obs
 from .. import profiler as _profiler
 from ..observability import compile_tracker as _ct
@@ -387,8 +388,8 @@ class ServingEngine:
         self._prefill_ewma: Dict[int, float] = {}
         self._prefill_ewma_all: Optional[float] = None
         self._tpot_ewma: Optional[float] = None
-        self._shed_by_reason: Dict[str, int] = {}
-        self._slo_met = 0
+        self._shed_by_reason: Dict[str, int] = {}   # guarded-by: _lock
+        self._slo_met = 0                           # guarded-by: _lock
         self.spec_tokens = int(spec_tokens if spec_tokens is not None
                                else g["serving_spec_tokens"])
         self.spec_ngram = int(g["serving_spec_ngram"])
@@ -509,15 +510,19 @@ class ServingEngine:
         self._vocab = int(cfg.vocab_size)
         if self.mesh is not None:
             self._place_on_mesh()
-        self._queue: deque = deque()
-        self._active: Dict[int, Request] = {}
-        self._all: List[Request] = []
+        self._queue: deque = deque()          # guarded-by: _lock
+        self._active: Dict[int, Request] = {}  # guarded-by: _step_lock
+        self._all: List[Request] = []         # guarded-by: _lock
         # a draining engine refuses new submissions (reason="drain");
         # routers skip it when routing and may re-home its queue via
-        # take_queued()/adopt_request() on a live peer
+        # take_queued()/adopt_request() on a live peer. Deliberately
+        # NOT lock-guarded: a single bool flipped by the router and
+        # read racily by submit (a stale read sheds one request late,
+        # which the drain loop absorbs).
         self.draining = False
-        self._lock = threading.Lock()        # queue + _all
-        self._step_lock = threading.Lock()   # one scheduler at a time
+        self._lock = _ccz.make_lock("engine._lock")  # queue + _all
+        self._step_lock = _ccz.make_lock(
+            "engine._step_lock")             # one scheduler at a time
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -536,7 +541,7 @@ class ServingEngine:
             "serving_tpot_seconds",
             "mean time per output token of completed requests (s)"
             ).labels(engine=eid)
-        self._completed = 0
+        self._completed = 0                         # guarded-by: _lock
         # shed accounting: one counter family, labelled by why and by
         # the victim's priority class — the /metrics view of stats()'s
         # per-reason dict (submit-time rejections included)
@@ -551,10 +556,12 @@ class ServingEngine:
                 "fraction of completed requests whose first token met "
                 "the TTFT SLO (FLAGS_serving_slo_ttft_ms)"
                 ).labels(engine=eid)
-        self._spec_proposed = 0   # draft tokens offered to the verify
-        self._spec_accepted = 0   # draft tokens the model agreed with
-        self._prefix_hit_reqs = 0   # admissions that reused >=1 block
-        self._prefix_miss_reqs = 0  # admissions that reused none
+        # scheduler-owned accounting — written only with the step lock
+        # held (step()/kill paths), scraped by stats() under the same
+        self._spec_proposed = 0     # guarded-by: _step_lock
+        self._spec_accepted = 0     # guarded-by: _step_lock
+        self._prefix_hit_reqs = 0   # guarded-by: _step_lock
+        self._prefix_miss_reqs = 0  # guarded-by: _step_lock
         if self.paged:
             self._blocks_used_g = _obs.gauge(
                 "serving_kv_blocks_used",
@@ -584,7 +591,7 @@ class ServingEngine:
         # per-tenant outcomes ("" keys base traffic): completed and
         # SLO-met counts, surfaced in stats()["tenants"] — the
         # per-tenant attainment the router/loadgen aggregate
-        self._tenant_stats: Dict[str, List[int]] = {}
+        self._tenant_stats: Dict[str, List[int]] = {}  # guarded-by: _lock
         self._lora_gauge = None
         if self.lora_pool is not None:
             self._lora_gauge = _obs.gauge(
@@ -592,13 +599,13 @@ class ServingEngine:
                 "LoRA adapters resident in this engine's paged "
                 "adapter pool (base page excluded)").labels(engine=eid)
             self._lora_gauge.set(len(self.lora_pool.loaded))
-        self._weight_version = 0
+        self._weight_version = 0          # guarded-by: _step_lock
         self._weight_version_g = _obs.gauge(
             "serving_weight_version",
             "live weight hot-swaps applied to this engine's model "
             "(0 = the weights it was built with)").labels(engine=eid)
         self._weight_version_g.set(0)
-        self._qerr_max = 0.0
+        self._qerr_max = 0.0              # guarded-by: _step_lock
         self._qerr_gauge = None
         if self.kv_dtype == "int8":
             self._qerr_gauge = _obs.gauge(
@@ -607,6 +614,21 @@ class ServingEngine:
                 "rows written by this engine's compiled steps"
                 ).labels(engine=eid)
             self._qerr_gauge.set(0.0)
+        # dynamic half of the `# guarded-by:` declarations above: under
+        # FLAGS_sanitize_locks a rebinding write to any of these without
+        # the named lock held raises GuardedStateError. Construction
+        # writes precede this call and are exempt by design.
+        _ccz.declare_guarded(self, {
+            "_queue": "_lock", "_all": "_lock", "_completed": "_lock",
+            "_slo_met": "_lock", "_shed_by_reason": "_lock",
+            "_tenant_stats": "_lock",
+            "_active": "_step_lock", "_spec_proposed": "_step_lock",
+            "_spec_accepted": "_step_lock",
+            "_prefix_hit_reqs": "_step_lock",
+            "_prefix_miss_reqs": "_step_lock",
+            "_weight_version": "_step_lock",
+            "_qerr_max": "_step_lock",
+        })
 
     # -------------------------------------------------------------- mesh
     def _place_on_mesh(self):
@@ -1294,7 +1316,7 @@ class ServingEngine:
                        reason="deadline")
         return out, len(expired)
 
-    def _admit_round_paged(self):
+    def _admit_round_paged(self):  # holds: _step_lock
         """One paged admission pass: pop queued requests in admission
         order (FIFO within a priority class), acquire a block table
         for each (prefix-cache reuse first), group by the unshared
@@ -1410,7 +1432,7 @@ class ServingEngine:
                                    self._take_first(req, first, lg, i))
         return expired + len(candidates) - len(back), admitted
 
-    def _admit_round(self):
+    def _admit_round(self):  # holds: _step_lock
         """One admission pass: pop up to num_free queued requests,
         group them by prefill bucket, and run ONE batched prefill per
         group. Returns (popped, admitted)."""
@@ -1575,7 +1597,7 @@ class ServingEngine:
                   jnp.asarray(self.cache.lengths),
                   self.cache.arrays(), samp)
 
-    def _note_qerr(self, qerr, rows: int):
+    def _note_qerr(self, qerr, rows: int):  # holds: _step_lock
         """Surface an int8 step's max-abs dequantization error: bump
         the quant write counters and ratchet the drift gauge (+ one
         run-log event per new high-water mark). No-op — and no device
@@ -1593,7 +1615,7 @@ class ServingEngine:
                 _runlog.log_event("serving_kv_quant",
                                   max_abs_err=round(e, 6), rows=int(rows))
 
-    def _decode(self) -> int:
+    def _decode(self) -> int:  # holds: _step_lock
         """One batched decode over every occupied slot. Returns how
         many tokens were produced (0 when idle/skipped)."""
         if not self._active:
@@ -1655,7 +1677,7 @@ class ServingEngine:
                   jnp.asarray(self.cache.lengths),
                   self.cache.arrays(), samp)
 
-    def _spec_decode(self) -> int:
+    def _spec_decode(self) -> int:  # holds: _step_lock
         """One speculative draft–verify step over every occupied slot:
         draft K tokens per slot from its own generated suffix, score
         all K+1 positions in one compiled forward, commit the accepted
@@ -1762,7 +1784,7 @@ class ServingEngine:
                 return True
         return False
 
-    def _finish(self, req: Request):
+    def _finish(self, req: Request):  # holds: _step_lock
         if req.slot is not None:
             self._active.pop(req.slot, None)
             self.cache.release(req.slot)
@@ -1851,6 +1873,18 @@ class ServingEngine:
             v = hist.quantile(q)
             return None if v is None else round(v * 1e3, 3)
 
+        # scheduler-owned state is snapshotted under the step lock: a
+        # scrape racing step() used to read _active/_spec_*/_qerr_max/
+        # _prefix_*_reqs bare and could see a half-updated round (e.g.
+        # spec_accepted bumped, spec_proposed not yet). The two locks
+        # are taken sequentially, never nested, so no order edge.
+        with self._step_lock:
+            active = len(self._active)
+            spec_proposed = self._spec_proposed
+            spec_accepted = self._spec_accepted
+            qerr_max = self._qerr_max
+            prefix_hit_reqs = self._prefix_hit_reqs
+            prefix_miss_reqs = self._prefix_miss_reqs
         with self._lock:
             completed = self._completed
             slo_met = self._slo_met
@@ -1866,7 +1900,7 @@ class ServingEngine:
             "spec_tokens": self.spec_tokens,
             "completed": completed,
             "queue_depth": queued,
-            "active": len(self._active),
+            "active": active,
             # per-reason sheds incl. submit-time rejections — the
             # stats() view of serving_shed_total{reason=,priority=}
             "shed": shed,
@@ -1880,18 +1914,18 @@ class ServingEngine:
             out["predicted_ttft_ms"] = round(
                 self.predict_ttft_ms(), 3)
         if self.spec_tokens:
-            out["spec_proposed"] = self._spec_proposed
-            out["spec_accepted"] = self._spec_accepted
+            out["spec_proposed"] = spec_proposed
+            out["spec_accepted"] = spec_accepted
             out["spec_acceptance_rate"] = (
-                round(self._spec_accepted / self._spec_proposed, 4)
-                if self._spec_proposed else None)
+                round(spec_accepted / spec_proposed, 4)
+                if spec_proposed else None)
         out["paged"] = self.paged
         out["attn_impl"] = self.attn_impl
         out["kv_dtype"] = self.kv_dtype
         out["mesh_shape"] = (None if self.mesh_shape is None
                              else list(self.mesh_shape))
         if self.kv_dtype == "int8":
-            out["kv_quant_max_abs_err"] = round(self._qerr_max, 6)
+            out["kv_quant_max_abs_err"] = round(qerr_max, 6)
         if tenants:
             # per-tenant completion + SLO attainment ("base" = no-LoRA
             # traffic); the router sums these across replicas
@@ -1923,8 +1957,8 @@ class ServingEngine:
                 # request-granular (an admission that reused >=1 block
                 # is a hit) and token-granular (prompt tokens whose KV
                 # came from the cache vs were prefilled)
-                "prefix_hit_requests": self._prefix_hit_reqs,
-                "prefix_miss_requests": self._prefix_miss_reqs,
+                "prefix_hit_requests": prefix_hit_reqs,
+                "prefix_miss_requests": prefix_miss_reqs,
                 "prefix_hit_tokens": hit_t,
                 "prefix_miss_tokens": miss_t,
                 "prefix_hit_rate": (round(hit_t / (hit_t + miss_t), 4)

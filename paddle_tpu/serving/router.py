@@ -43,10 +43,10 @@ Decisions are cooldown-limited so one burst doesn't thrash the set.
 from __future__ import annotations
 
 import itertools
-import threading
 from typing import List, Optional, Sequence
 
 from .. import monitor as _monitor
+from ..analysis import concurrency as _ccz
 from .. import observability as _obs
 from ..observability import runlog as _runlog
 from ..observability import tracing as _tracing
@@ -196,7 +196,7 @@ class ReplicaRouter:
                     "autoscaling needs model= construction (the router "
                     "builds scale-up replicas itself); prebuilt "
                     "engines= cannot autoscale")
-            self.engines: List[ServingEngine] = list(engines)
+            self.engines: List[ServingEngine] = list(engines)  # guarded-by: _lock
             if not self.engines:
                 raise ValueError("engines must be non-empty")
         else:
@@ -210,17 +210,18 @@ class ReplicaRouter:
                 n = min(max(n, autoscale.min_replicas),
                         autoscale.max_replicas)
             self.engines = [ServingEngine(model, **engine_kwargs)
-                            for _ in range(n)]
-        self._draining = False
-        self._lock = threading.Lock()
-        self._retiring: List[ServingEngine] = []
-        self._scale_ups = 0
-        self._scale_downs = 0
-        self._steps_since_scale = 0
-        self._kills = 0
-        self._restarts = 0
-        self._rehomed = 0
-        self._victim_rr = 0   # serving.replica round-robin victim
+                            for _ in range(n)]  # guarded-by: _lock
+        self._draining = False              # guarded-by: _lock
+        self._lock = _ccz.make_lock("router._lock")
+        self._retiring: List[ServingEngine] = []  # guarded-by: _lock
+        self._scale_ups = 0                 # guarded-by: _lock
+        self._scale_downs = 0               # guarded-by: _lock
+        self._steps_since_scale = 0         # guarded-by: _lock
+        self._kills = 0                     # guarded-by: _lock
+        self._restarts = 0                  # guarded-by: _lock
+        self._rehomed = 0                   # guarded-by: _lock
+        # serving.replica round-robin victim cursor
+        self._victim_rr = 0                 # guarded-by: _lock
         rid = str(next(ReplicaRouter._router_ids))
         self._rid = rid
         for eng in self.engines:
@@ -242,6 +243,14 @@ class ReplicaRouter:
             for i in range(len(self.engines))]
         self._update_depth_gauges()
         self._update_state_gauges()
+        # construction writes above precede the declaration and are
+        # exempt; everything after must hold _lock to write these
+        _ccz.declare_guarded(self, {
+            "_draining": "_lock", "_scale_ups": "_lock",
+            "_scale_downs": "_lock", "_steps_since_scale": "_lock",
+            "_kills": "_lock", "_restarts": "_lock",
+            "_rehomed": "_lock", "_victim_rr": "_lock",
+        })
 
     # ------------------------------------------------------------ health
     @staticmethod
@@ -317,8 +326,9 @@ class ReplicaRouter:
             action = "crash"
         if action is None:
             return
-        victim = self._victim_rr % len(self.engines)
-        self._victim_rr += 1
+        with self._lock:
+            victim = self._victim_rr % len(self.engines)
+            self._victim_rr += 1
         if action == "crash" and self._auto_restart and \
                 self._model is not None:
             self.restart_replica(victim, cause="fault")
@@ -480,7 +490,7 @@ class ReplicaRouter:
         return page
 
     # -------------------------------------------------------- autoscale
-    def _add_replica(self):
+    def _add_replica(self):  # holds: _lock
         eng = ServingEngine(self._model, **self._engine_kwargs)
         self._init_health(eng)
         self.engines.append(eng)
@@ -490,32 +500,38 @@ class ReplicaRouter:
         pressure, or move the emptiest replica to the retiring list
         (it keeps stepping, receives no routes, and drops once idle —
         in-flight work is never shed by scale-down)."""
-        for eng in list(self._retiring):
-            if eng.idle:
-                self._retiring.remove(eng)
-        self._steps_since_scale += 1
-        if self._steps_since_scale < self._autoscale.cooldown_steps:
-            return
-        n = len(self.engines)
-        target = self._autoscale.decide(self)
-        if target == n:
-            return
-        if target > n:
-            for _ in range(target - n):
-                self._add_replica()
-            self._scale_ups += 1
-            _monitor.stat_add("STAT_serving_autoscale_up")
-        else:
-            idx = min(range(n),
-                      key=lambda i: (self._depth(self.engines[i]), i))
-            self._retiring.append(self.engines.pop(idx))
-            self._scale_downs += 1
-            _monitor.stat_add("STAT_serving_autoscale_down")
-        self._steps_since_scale = 0
-        self._replicas_gauge.set(len(self.engines))
+        # the policy consults per-replica depth under eng._lock while
+        # we hold _lock — a router._lock -> engine._lock order edge;
+        # acyclic, because engine code never reaches back for _lock
+        with self._lock:
+            for eng in list(self._retiring):
+                if eng.idle:
+                    self._retiring.remove(eng)
+            self._steps_since_scale += 1
+            if self._steps_since_scale < self._autoscale.cooldown_steps:
+                return
+            n = len(self.engines)
+            target = self._autoscale.decide(self)
+            if target == n:
+                return
+            if target > n:
+                for _ in range(target - n):
+                    self._add_replica()
+                self._scale_ups += 1
+                _monitor.stat_add("STAT_serving_autoscale_up")
+            else:
+                idx = min(range(n),
+                          key=lambda i: (self._depth(self.engines[i]), i))
+                self._retiring.append(self.engines.pop(idx))
+                self._scale_downs += 1
+                _monitor.stat_add("STAT_serving_autoscale_down")
+            self._steps_since_scale = 0
+            replicas_to = len(self.engines)
+            retiring = len(self._retiring)
+        self._replicas_gauge.set(replicas_to)
         _runlog.log_event("serving_autoscale", replicas_from=n,
-                          replicas_to=len(self.engines),
-                          retiring=len(self._retiring))
+                          replicas_to=replicas_to,
+                          retiring=retiring)
 
     # ---------------------------------------------------------- stepping
     def step(self) -> bool:
@@ -710,8 +726,9 @@ class ReplicaRouter:
         if eng.paged and not any(p.cache.pool is eng.cache.pool
                                  for p in self.engines):
             eng.cache.flush_prefix_cache()
-        self._kills += 1
-        self._rehomed += rehomed
+        with self._lock:
+            self._kills += 1
+            self._rehomed += rehomed
         _monitor.stat_add("STAT_serving_replica_killed")
         self._replicas_gauge.set(len(self.engines))
         self._update_depth_gauges()
@@ -746,11 +763,13 @@ class ReplicaRouter:
                     f"(have {len(self.engines)})")
             self.engines.insert(index + 1, replacement)
         info = self.kill_replica(index, cause=cause)
-        self._restarts += 1
+        with self._lock:
+            self._restarts += 1
+            restarts = self._restarts
         _monitor.stat_add("STAT_serving_replica_restarted")
         _runlog.log_event("serving_replica_recover", replica=index,
                           t=round(replacement._clock(), 6),
-                          restarts=self._restarts)
+                          restarts=restarts)
         return info
 
     def swap_weights(self, state, *, reset_costs: bool = True
@@ -802,8 +821,21 @@ class ReplicaRouter:
         per-reason sheds, slo_attainment), the autoscale posture when
         enabled, and each replica's full ``stats()`` dict under
         ``per_replica``."""
-        engines = list(self.engines) + list(self._retiring)
-        depths = [self._depth(e) for e in self.engines]
+        # snapshot router-owned counters and the replica lists under
+        # _lock (the HTTP scrape thread calls this concurrently with
+        # kill/restart/autoscale mutating them), then read per-engine
+        # state lock-by-lock with _lock released — no nesting
+        with self._lock:
+            live = list(self.engines)
+            retiring = list(self._retiring)
+            draining = self._draining
+            kills = self._kills
+            restarts = self._restarts
+            rehomed = self._rehomed
+            scale_ups = self._scale_ups
+            scale_downs = self._scale_downs
+        engines = live + retiring
+        depths = [self._depth(e) for e in live]
         shed: dict = {}
         completed = slo_met = 0
         tenants: dict = {}
@@ -819,23 +851,22 @@ class ReplicaRouter:
                     t[1] += el
                     t[2] += m
         out = {
-            "replicas": len(self.engines),
-            "draining": self._draining,
-            "mesh_shape": (None if self.engines[0].mesh_shape is None
-                           else list(self.engines[0].mesh_shape)),
+            "replicas": len(live),
+            "draining": draining,
+            "mesh_shape": (None if live[0].mesh_shape is None
+                           else list(live[0].mesh_shape)),
             "queue_depths": depths,
-            "kv_blocks_free": [self._blocks_free(e)
-                               for e in self.engines],
-            "health": [e._health for e in self.engines],
-            "kills": self._kills,
-            "restarts": self._restarts,
-            "rehomed": self._rehomed,
+            "kv_blocks_free": [self._blocks_free(e) for e in live],
+            "health": [e._health for e in live],
+            "kills": kills,
+            "restarts": restarts,
+            "rehomed": rehomed,
             "completed": completed,
             "slo_met": slo_met,
             "slo_attainment": self._slo_attainment(),
             "shed": shed,
             "shed_total": sum(shed.values()),
-            "per_replica": [e.stats() for e in self.engines],
+            "per_replica": [e.stats() for e in live],
         }
         if tenants:
             # fleet-wide per-tenant goodput + SLO attainment, summed
@@ -850,8 +881,8 @@ class ReplicaRouter:
             out["autoscale"] = {
                 "min_replicas": self._autoscale.min_replicas,
                 "max_replicas": self._autoscale.max_replicas,
-                "scale_ups": self._scale_ups,
-                "scale_downs": self._scale_downs,
-                "retiring": len(self._retiring),
+                "scale_ups": scale_ups,
+                "scale_downs": scale_downs,
+                "retiring": len(retiring),
             }
         return out

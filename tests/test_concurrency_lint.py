@@ -1,0 +1,581 @@
+"""Concurrency & resource-lifecycle analysis plane
+(analysis/lifecycle.py, analysis/concurrency.py, tools/lint_serving.py).
+
+Contracts: the static checker proves release-on-all-paths for the
+serving resource APIs — a leak on a raise edge, a release after
+``export_row`` moved the obligation, and a double release are all
+ERRORs with path witnesses, while the handoff protocol (export ->
+record -> import/adopt on the peer) lints clean.  Writes to
+``# guarded-by`` attributes outside their lock are ERRORs; ``# holds``
+and ``# unguarded-ok`` annotations are honored.  The shipped serving
+modules lint clean under ``--strict`` with an EMPTY baseline.  The
+runtime sanitizer observes AB/BA lock-order inversions (recorded, not
+raised), enforces guarded-state declarations under
+``FLAGS_sanitize_locks``, is a plain ``threading`` lock when off, and
+a kill/re-home chaos run over a sanitized fleet finishes with zero
+cycles and zero violations.
+"""
+
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu.analysis import concurrency as ccz
+from paddle_tpu.analysis import lifecycle
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import DisaggRouter, ReplicaRouter
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=97, max_position_embeddings=64,
+                    hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_hidden_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 97, size=n).tolist() for n in sizes]
+
+
+def _leaked_per_pool(rt):
+    """leaked() per *unique* pool (co-located roles share one)."""
+    pools = {}
+    for eng in rt.engines + rt._retiring:
+        pools[id(eng.cache.pool)] = eng.cache
+    out = []
+    for cache in pools.values():
+        cache.flush_prefix_cache()
+        out.append(cache.allocator.leaked())
+    return out
+
+
+@pytest.fixture
+def sanitize():
+    """FLAGS_sanitize_locks on + a clean sanitizer slate, restored
+    after the test (locks built inside the test become sanitized)."""
+    old = flags.get_flag("sanitize_locks")
+    flags.set_flags({"sanitize_locks": True})
+    ccz.reset()
+    try:
+        yield ccz
+    finally:
+        flags.set_flags({"sanitize_locks": old})
+        ccz.reset()
+
+
+def _lint_src(tmp_path, src, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return lifecycle.lint_files([str(p)])
+
+
+def _by_check(result, check):
+    return [d for d in result.diagnostics if d.check == check]
+
+
+# ---------------------------------------------------------------------
+# static lifecycle checker — synthetic fixtures
+# ---------------------------------------------------------------------
+
+
+def test_leak_on_exception_path(tmp_path):
+    r = _lint_src(tmp_path, """
+        class Engine:
+            def leaky(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return None
+                try:
+                    self.fill(row)
+                except RuntimeError:
+                    raise
+                self.cache.release_row(row)
+                return True
+        """)
+    leaks = _by_check(r, "resource-leak")
+    assert len(leaks) == 1 and leaks[0].severity == "error"
+    assert "acquire" in leaks[0].symbol
+    assert "raise" in leaks[0].witness  # the path witness names the edge
+    assert len(r.errors) == 1
+
+
+def test_leak_on_early_return_shed_branch(tmp_path):
+    r = _lint_src(tmp_path, """
+        class Engine:
+            def shed_path(self, req):
+                row = self.cache.acquire(req.blocks)
+                if row is None:
+                    return None
+                if req.expired:
+                    self.shed(req)
+                    return False        # forgot release: leak
+                self.cache.release_row(row)
+                return True
+        """)
+    leaks = _by_check(r, "resource-leak")
+    assert len(leaks) == 1
+    assert "return" in leaks[0].witness
+
+
+def test_export_then_release_double_free(tmp_path):
+    r = _lint_src(tmp_path, """
+        class Prefill:
+            def handoff(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return None
+                rec = self.cache.export_row(row)
+                self.pending.append(rec)
+                self.cache.release_row(row)     # double-free
+                return True
+        """)
+    dbl = _by_check(r, "release-after-move")
+    assert len(dbl) == 1 and dbl[0].severity == "error"
+    assert "export" in dbl[0].message
+
+
+def test_plain_double_release(tmp_path):
+    r = _lint_src(tmp_path, """
+        class Engine:
+            def twice(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return
+                self.cache.release_row(row)
+                self.cache.release_row(row)
+        """)
+    assert len(_by_check(r, "double-release")) == 1
+
+
+def test_clean_exception_safe_function_passes(tmp_path):
+    r = _lint_src(tmp_path, """
+        class Engine:
+            def careful(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return None
+                try:
+                    self.fill(row)
+                except RuntimeError:
+                    self.cache.release_row(row)
+                    raise
+                self.cache.release_row(row)
+                return True
+
+            def with_finally(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return None
+                try:
+                    return self.fill(row)
+                finally:
+                    self.cache.release_row(row)
+        """)
+    assert r.diagnostics == []
+
+
+def test_handoff_protocol_lints_clean(tmp_path):
+    """export moves the obligation into the record; the peer's
+    import/adopt re-acquires it; a failed adopt (None) leaves the
+    record owning its blocks, released via release_blocks."""
+    r = _lint_src(tmp_path, """
+        class Fleet:
+            def produce(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return None
+                rec = self.cache.export_row(row)
+                return rec
+
+            def consume(self, rec, same_pool):
+                row = (self.cache.import_row(rec) if same_pool
+                       else self.cache.adopt_row(rec))
+                if row is None:
+                    rec["pool"].release_blocks(rec["blocks"])
+                    return None
+                self._active[row] = rec
+                return row
+        """)
+    assert r.diagnostics == []
+
+
+def test_guarded_write_outside_lock(tmp_path):
+    r = _lint_src(tmp_path, """
+        class Counter:
+            def __init__(self):
+                self._lock = make_lock("c._lock")
+                self._count = 0          # guarded-by: _lock
+                self._items = []         # guarded-by: _lock
+
+            def good(self):
+                with self._lock:
+                    self._count += 1
+                    self._items.append(1)
+
+            def bad_rebind(self):
+                self._count += 1
+
+            def bad_mutator(self):
+                self._items.append(2)
+
+            def asserted(self):          # holds: _lock
+                self._count += 1
+
+            def waived(self):
+                self._count = 0          # unguarded-ok: test reset
+        """)
+    bad = _by_check(r, "unguarded-write")
+    assert len(bad) == 2
+    assert {d.function.split(".")[-1] for d in bad} == {
+        "bad_rebind", "bad_mutator"}
+    assert all("_lock" in d.message for d in bad)
+
+
+def test_guard_declarations_inherit_across_files(tmp_path):
+    """Subclass methods in another module are checked against the
+    base's # guarded-by declarations (the PrefillEngine/DecodeEngine
+    over ServingEngine layout)."""
+    base = tmp_path / "base.py"
+    base.write_text(textwrap.dedent("""
+        class Base:
+            def __init__(self):
+                self._lock = make_lock("b._lock")
+                self._count = 0          # guarded-by: _lock
+        """))
+    sub = tmp_path / "sub.py"
+    sub.write_text(textwrap.dedent("""
+        class Sub(Base):
+            def bump(self):
+                self._count += 1
+        """))
+    r = lifecycle.lint_files([str(base), str(sub)])
+    bad = _by_check(r, "unguarded-write")
+    assert len(bad) == 1 and "Sub.bump" in bad[0].function
+
+
+# ---------------------------------------------------------------------
+# baseline + CLI
+# ---------------------------------------------------------------------
+
+_LEAKY = """
+class Engine:
+    def leaky(self, n):
+        row = self.cache.acquire(n)
+        if row is None:
+            return
+        self.work(row)
+"""
+
+
+def test_baseline_suppresses_justified_findings(tmp_path):
+    import json
+    p = tmp_path / "fixture.py"
+    p.write_text(_LEAKY)
+    r = lifecycle.lint_files([str(p)])
+    assert len(r.errors) == 1
+    key = r.errors[0].key
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": [
+        {"key": key, "justification": "known leak, tracked"}]}))
+    r2 = lifecycle.apply_baseline(
+        lifecycle.lint_files([str(p)]),
+        lifecycle.load_baseline(str(bl)))
+    assert r2.diagnostics == [] and len(r2.baselined) == 1
+    # an entry without justification is rejected, not honored
+    bl.write_text(json.dumps({"entries": [{"key": key,
+                                           "justification": ""}]}))
+    with pytest.raises(ValueError):
+        lifecycle.load_baseline(str(bl))
+    # a stale entry becomes a warning so the file can only shrink
+    bl.write_text(json.dumps({"entries": [
+        {"key": key, "justification": "ok"},
+        {"key": "resource-leak:gone.py:f:x", "justification": "ok"}]}))
+    r3 = lifecycle.apply_baseline(
+        lifecycle.lint_files([str(p)]),
+        lifecycle.load_baseline(str(bl)))
+    stale = _by_check(r3, "stale-baseline")
+    assert len(stale) == 1 and stale[0].severity == "warning"
+
+
+def test_lint_serving_cli_and_repo_is_clean(tmp_path, capsys):
+    """The CI-gate invocation: the shipped serving modules lint clean
+    under --strict with the shipped (empty) baseline; a leaky fixture
+    fails; --json reports the diagnostics."""
+    import json
+    from tools import lint_serving as tool
+    assert tool.main(["--strict"]) == 0
+    capsys.readouterr()
+    # the shipped baseline carries no entries — the fleet needs none
+    shipped = json.load(open(tool.DEFAULT_BASELINE))
+    assert shipped == {"entries": []}
+    p = tmp_path / "fixture.py"
+    p.write_text(_LEAKY)
+    assert tool.main([str(p), "--no-default-paths",
+                      "--baseline", ""]) == 1
+    capsys.readouterr()
+    assert tool.main([str(p), "--no-default-paths", "--baseline", "",
+                      "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert not rep["ok"] and rep["errors"] == 1
+    d = rep["diagnostics"][0]
+    assert d["check"] == "resource-leak" and d["line"] > 0
+    assert d["file"].endswith("fixture.py")
+
+
+# ---------------------------------------------------------------------
+# runtime sanitizer — lock order
+# ---------------------------------------------------------------------
+
+
+def test_ab_ba_inversion_recorded_not_raised(sanitize):
+    a = ccz.SanitizedLock("A")
+    b = ccz.SanitizedLock("B")
+    with a:
+        with b:
+            pass
+    assert ccz.cycles() == []          # one order seen: no inversion
+    with b:
+        with a:                        # closes the cycle
+            pass
+    cyc = ccz.cycles()
+    assert len(cyc) == 1
+    names = {n.split("#")[0] for n in cyc[0]["locks"]}
+    assert names == {"A", "B"}
+    assert cyc[0]["held"]              # the held-set at the bad edge
+    # deduped: witnessing the same inversion again adds nothing
+    with b:
+        with a:
+            pass
+    assert len(ccz.cycles()) == 1
+    rep = ccz.report()
+    assert rep["lock_acquires"] >= 6 and rep["order_edges"] >= 2
+
+
+def test_consistent_order_and_reentrancy_are_silent(sanitize):
+    a = ccz.SanitizedLock("A")
+    r = ccz.SanitizedLock("R", reentrant=True)
+    for _ in range(3):
+        with a:
+            with r:
+                with r:                # reentrant re-acquire: no edge
+                    pass
+    assert ccz.cycles() == []
+    assert ccz.report()["order_edges"] == 1    # just A -> R
+
+
+def test_inversion_across_threads(sanitize):
+    a = ccz.SanitizedLock("A")
+    b = ccz.SanitizedLock("B")
+    done = threading.Event()
+
+    def ab():
+        with a:
+            with b:
+                pass
+        done.set()
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    assert done.is_set()
+    with b:
+        with a:
+            pass
+    assert len(ccz.cycles()) == 1
+
+
+def test_make_lock_plain_when_flag_off():
+    old = flags.get_flag("sanitize_locks")
+    flags.set_flags({"sanitize_locks": False})
+    try:
+        lk = ccz.make_lock("plain")
+        assert not isinstance(lk, ccz.SanitizedLock)
+        assert isinstance(ccz.make_lock("re", reentrant=True),
+                          type(threading.RLock()))
+        with lk:
+            pass                       # still a working lock
+    finally:
+        flags.set_flags({"sanitize_locks": old})
+
+
+# ---------------------------------------------------------------------
+# runtime sanitizer — guarded state
+# ---------------------------------------------------------------------
+
+
+def test_guarded_state_dynamic_enforcement(sanitize):
+    class Box:
+        def __init__(self):
+            self._lock = ccz.make_lock("box._lock")
+            self._n = 0
+            ccz.declare_guarded(self, {"_n": "_lock"})
+
+    b = Box()
+    with b._lock:
+        b._n = 1                       # fine: lock held
+    with pytest.raises(ccz.GuardedStateError):
+        b._n = 2
+    v = ccz.violations()
+    assert len(v) == 1 and v[0]["attr"] == "_n"
+    assert v[0]["lock"].startswith("box._lock")
+    assert b._n == 1                   # the bare write did not land
+    assert ccz.guards_of(b) == {"_n": b._lock.name}
+    # undeclared attributes stay writable without any lock
+    b.free = 9
+    assert len(ccz.violations()) == 1
+
+
+def test_declare_guarded_noop_when_off():
+    old = flags.get_flag("sanitize_locks")
+    flags.set_flags({"sanitize_locks": False})
+    try:
+        class Box:
+            pass
+
+        b = Box()
+        b._lock = ccz.make_lock("off._lock")
+        b._n = 0
+        ccz.declare_guarded(b, {"_n": "_lock"})
+        b._n = 5                       # no guard class, no raise
+        assert type(b) is Box
+    finally:
+        flags.set_flags({"sanitize_locks": old})
+
+
+# ---------------------------------------------------------------------
+# sanitized fleet chaos: kill / re-home under the flag
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_sanitized_replica_kill_restart_scrape(model, sanitize):
+    """A full fleet lifecycle under FLAGS_sanitize_locks — submits,
+    steps, a concurrent stats() scraper, kill + restart + autoscale
+    bookkeeping — must finish with ZERO lock-order cycles and ZERO
+    guarded-state violations, and the sanitizer must actually have
+    watched it (nonzero instrumented acquires)."""
+    rt = ReplicaRouter(model, n_replicas=2, max_slots=2, max_len=32,
+                      buckets=[8, 16], max_queue=16, block_size=4)
+    reqs = [rt.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 7, 5, 6), seed=11)]
+    stop = threading.Event()
+    errs = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                st = rt.stats()
+                assert st["replicas"] >= 1
+            except Exception as e:     # pragma: no cover
+                errs.append(e)
+                return
+
+    t = threading.Thread(target=scraper, name="scraper")
+    t.start()
+    try:
+        rt.engines[0].step()
+        rt.kill_replica(0)
+        rt.restart_replica(0)
+        rt.run_until_idle()
+    finally:
+        stop.set()
+        t.join()
+    assert not errs
+    assert all(r.state in ("done", "shed") for r in reqs)
+    rep = ccz.report()
+    assert rep["enabled"] is True
+    assert rep["lock_acquires"] > 0 and rep["locks_tracked"] > 0
+    assert rep["cycles"] == [], rep["cycles"]
+    assert rep["violations"] == [], rep["violations"]
+    st = rt.stats()
+    assert st["kills"] == 2 and st["restarts"] == 1
+
+
+@pytest.mark.chaos
+def test_sanitized_disagg_kill_decode_worker(model, sanitize):
+    """The disagg kill/re-home path (handoff splices, affinity-index
+    surgery, cross-pool adoption) under the sanitizer: zero cycles,
+    zero violations, zero KV-block leaks."""
+    rt = DisaggRouter(model, n_prefill=1, n_decode=2, max_slots=2,
+                      max_len=32, buckets=[8, 16], max_queue=16,
+                      block_size=4)
+    reqs = [rt.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 6, 4), seed=12)]
+    for _ in range(3):
+        rt.step()
+    rt.kill_decode_worker(0)
+    rt.run_until_idle()
+    assert all(r.state in ("done", "shed") for r in reqs)
+    assert all(lk == 1 for lk in _leaked_per_pool(rt))  # trash only
+    rep = ccz.report()
+    assert rep["cycles"] == [] and rep["violations"] == []
+    assert rep["lock_acquires"] > 0
+
+
+# ---------------------------------------------------------------------
+# regressions for findings the checkers flagged in the fleet itself
+# ---------------------------------------------------------------------
+
+
+def test_router_stats_scrape_races_autoscale(model, sanitize):
+    """ReplicaRouter.stats() used to read _kills/_rehomed/_retiring
+    outside _lock while kill/autoscale mutated them; now it snapshots
+    under the lock — a tight scrape/kill/restart loop must never
+    raise, corrupt counts, or trip the guarded-state check."""
+    rt = ReplicaRouter(model, n_replicas=2, max_slots=2, max_len=32,
+                      buckets=[8, 16], max_queue=16, block_size=4)
+    errs = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                st = rt.stats()
+                assert st["kills"] >= 0
+            except Exception as e:
+                errs.append(e)
+                return
+
+    t = threading.Thread(target=scraper)
+    t.start()
+    try:
+        for _ in range(3):
+            rt.restart_replica(0)
+    finally:
+        stop.set()
+        t.join()
+    assert not errs
+    assert ccz.violations() == []
+    st = rt.stats()
+    assert st["kills"] == 3 and st["restarts"] == 3
+
+
+def test_disagg_no_survivor_path_releases_blocks(model):
+    """kill_decode_worker when NO survivor can adopt (the for/else
+    restructure the leak checker demanded): every in-flight record's
+    blocks are released and the request sheds — nothing leaks."""
+    rt = DisaggRouter(model, n_prefill=1, n_decode=2, max_slots=1,
+                      max_len=32, buckets=[8, 16], max_queue=16,
+                      block_size=4)
+    reqs = [rt.submit(p, max_new_tokens=4)
+            for p in _prompts((3, 5), seed=13)]
+    for _ in range(3):
+        rt.step()
+    # jam the only survivor so adoption fails, then kill the other
+    survivor = rt.decodes[1]
+    survivor.draining = True
+    rt.kill_decode_worker(0)
+    survivor.draining = False
+    rt.run_until_idle()
+    assert all(r.state in ("done", "shed") for r in reqs)
+    assert all(lk == 1 for lk in _leaked_per_pool(rt))  # trash only

@@ -8,10 +8,15 @@
 #      eight book programs + op-registry grad-contract diff vs baseline
 #   3. sharding-rule lint (GSPMD pre-flight: dead/shadowed rules,
 #      divisibility fallbacks, per-device memory estimate)
-#   4. full test suite on the virtual 8-device CPU mesh
-#   5. chaos suite (deterministic fault injection: retry/skip/rollback
+#   4. serving concurrency/lifecycle lint (AST dataflow over the
+#      serving modules: KV/LoRA resources released on every path incl.
+#      exception edges, no double-release or release-after-move, and
+#      every write to `# guarded-by` state under its declared lock —
+#      strict, with an empty justified baseline)
+#   5. full test suite on the virtual 8-device CPU mesh
+#   6. chaos suite (deterministic fault injection: retry/skip/rollback
 #      recovery paths under FLAGS_fault_spec-driven failures)
-#   6. serving plane (continuous-batching engine == sequential decode
+#   7. serving plane (continuous-batching engine == sequential decode
 #      over the paged KV cache — block tables, prefix reuse and COW
 #      token-identical with AND without the prefix cache, compile-count
 #      budget re-asserted on the paged step names, queue backpressure,
@@ -29,17 +34,17 @@
 #      routing) token-identical to the symmetric router at zero extra
 #      compiles, with the chaos kill-prefill-worker path leaking
 #      nothing
-#   7. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
+#   8. speculative-decoding gate (FLAGS_serving_spec_tokens>0 engine
 #      token-identical to sequential greedy, compile counts pinned;
 #      full mode also runs the BENCH_MODEL=serving spec variant on a
 #      tiny model: tokens/s + acceptance rate vs the plain engine)
-#   8. observability gate (train + serving smoke under the run log;
+#   9. observability gate (train + serving smoke under the run log;
 #      /metrics parses as Prometheus text, compile tracker pins the
 #      decode/prefill compile budget, run-log events feed
 #      tools/trace_summary.py; per-request tracing blame identity +
 #      Perfetto export + /v1/requests/<id> debug endpoint, with the
 #      recompile predictor proving tracing never compiles)
-#   9. loadgen SLO gate (seeded open-loop traffic through the
+#  10. loadgen SLO gate (seeded open-loop traffic through the
 #      SLO-admitting gpt2-tiny engine: goodput > 0 with attainment
 #      reported and zero leaked KV blocks, then the chaos crossover —
 #      submit/alloc faults injected, degradation must stay graceful —
@@ -48,18 +53,20 @@
 #      closing with the tracing-overhead budget: a fully-traced run
 #      must hold goodput within 5% of an untraced one on the same
 #      seed)
-#  10. chaos soak gate (hours of seeded diurnal traffic on the virtual
+#  11. chaos soak gate (hours of seeded diurnal traffic on the virtual
 #      clock with replica kills injected at virtual instants and
 #      auto-restart healing the fleet: goodput > 0 in every window,
 #      completed + rehomed + shed == offered, zero leaks, zero new
-#      compiles after warmup — kill/restart/re-home proven no-ops)
-#  11. op coverage gate (>= 80% of the reference forward-op surface)
-#  12. API-freeze check (public signature snapshot diff)
-#  13. multi-chip dry-run (GSPMD train step on N virtual devices)
-#  14. train->serve loop gate (ZeRO parity on 1x1 + virtual dp=2 with
+#      compiles after warmup — kill/restart/re-home proven no-ops),
+#      then the same seeded soak under FLAGS_sanitize_locks=1 (lock
+#      order graph acyclic, zero guarded-state violations)
+#  12. op coverage gate (>= 80% of the reference forward-op surface)
+#  13. API-freeze check (public signature snapshot diff)
+#  14. multi-chip dry-run (GSPMD train step on N virtual devices)
+#  15. train->serve loop gate (ZeRO parity on 1x1 + virtual dp=2 with
 #      per-device optimizer bytes ~1/dp, then checkpoint publish ->
 #      live hot-swap into a running engine with zero new compiles)
-#  15. README generated fragments vs their registries (no drift)
+#  16. README generated fragments vs their registries (no drift)
 #
 # Usage: tools/ci.sh [quick]   — `quick` skips the full suite and runs
 # a reduced chaos subset; lint and the other static gates still run
@@ -67,7 +74,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/15 import smoke"
+echo "== 1/16 import smoke"
 JAX_PLATFORMS=cpu python -c "
 import paddle_tpu
 from paddle_tpu.ops import registry
@@ -76,11 +83,11 @@ assert n > 350, n
 print(f'   paddle_tpu imports, {n} op lowerings registered')
 "
 
-echo "== 2/15 lint (program verifier + shape inference + op-desc compat)"
+echo "== 2/16 lint (program verifier + shape inference + op-desc compat)"
 JAX_PLATFORMS=cpu python tools/lint_program.py --books --shapes
 JAX_PLATFORMS=cpu python tools/check_op_desc.py --diff tools/op_desc_baseline.json
 
-echo "== 3/15 sharding-rule lint (GSPMD pre-flight)"
+echo "== 3/16 sharding-rule lint (GSPMD pre-flight)"
 # the GPT TP table, the ZeRO-style fully-sharded merge, and the serving
 # TP table (the mesh-sharded engine's placement rules on its
 # ("data","model") mesh) against the GPT benchmark model: no unknown
@@ -93,27 +100,34 @@ JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp --mesh dp=2,mp=2
 JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset serving_tp --mesh data=1,model=2 --strict
 JAX_PLATFORMS=cpu python tools/lint_sharding.py --preset gpt_tp+fully_sharded --mesh dp=2,mp=2 --json > /dev/null
 
+echo "== 4/16 serving concurrency/lifecycle lint"
+# static resource-obligation dataflow (acquire/release/export/adopt)
+# plus guarded-state discipline over the serving modules; --strict
+# fails on warnings too, and the baseline ships empty — every real
+# finding gets fixed, not suppressed
+JAX_PLATFORMS=cpu python tools/lint_serving.py --strict
+
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 4/15 test suite (virtual 8-device CPU mesh)"
+  echo "== 5/16 test suite (virtual 8-device CPU mesh)"
   if python -c 'import pytest_timeout' 2>/dev/null; then
     python -m pytest tests/ -q -x --timeout=1200
   else
     python -m pytest tests/ -q -x
   fi
 else
-  echo "== 4/15 test suite: SKIPPED (quick mode)"
+  echo "== 5/16 test suite: SKIPPED (quick mode)"
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 5/15 chaos suite (deterministic fault injection)"
+  echo "== 6/16 chaos suite (deterministic fault injection)"
   python -m pytest tests/ -q -m chaos
 else
-  echo "== 5/15 chaos suite: reduced subset (quick mode)"
+  echo "== 6/16 chaos suite: reduced subset (quick mode)"
   python -m pytest tests/test_resilience.py -q
 fi
 
 if [[ "${1:-}" != "quick" ]]; then
-  echo "== 6/15 serving plane (incl. paged-KV equivalence)"
+  echo "== 7/16 serving plane (incl. paged-KV equivalence)"
   # the full file carries the paged oracle: engine output token-identical
   # to sequential greedy with the prefix cache on AND off, plus the
   # dense paged=False baseline and the paged compile-count pins
@@ -131,7 +145,7 @@ if [[ "${1:-}" != "quick" ]]; then
   # prefixes; killing a prefill worker mid-handoff leaks nothing
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving_disagg.py -q
 else
-  echo "== 6/15 serving plane: reduced subset (quick mode)"
+  echo "== 7/16 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -k "matches_sequential or queue_full or slot_kv or block_allocator \
 or paged_engine_matches or dense_engine_still or prefix_reuse"
@@ -149,7 +163,7 @@ or head_sharded or drain or chaos_skip"
 or flag_parsing"
 fi
 
-echo "== 7/15 speculative decoding gate"
+echo "== 8/16 speculative decoding gate"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -k "spec"
 if [[ "${1:-}" != "quick" ]]; then
   echo "   bench: spec vs non-spec on the repetitive-suffix workload"
@@ -158,7 +172,7 @@ if [[ "${1:-}" != "quick" ]]; then
     BENCH_SERVING_COMPARE=0 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== 8/15 observability gate"
+echo "== 9/16 observability gate"
 # tiny train + serving smoke under the run log: /metrics parses as
 # Prometheus text (incl. KV block-pool gauges), compile tracker pins
 # decode_step_paged==1 compile and one batched prefill dispatch, a
@@ -166,7 +180,7 @@ echo "== 8/15 observability gate"
 # trace_summary
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
-echo "== 9/15 loadgen SLO gate (goodput under real traffic)"
+echo "== 10/16 loadgen SLO gate (goodput under real traffic)"
 # seeded open-loop traffic through the gpt2-tiny engine with SLO-aware
 # admission: goodput > 0 with attainment reported, zero leaked KV
 # blocks, zero unhandled exceptions — then the chaos crossover: the
@@ -283,7 +297,7 @@ print(f"   tracing overhead: traced {gt}/s vs untraced {gu}/s "
 PY
 rm -f "$TRACED_JSON" "$UNTRACED_JSON"
 
-echo "== 10/15 chaos soak gate (virtual-clock fleet fault tolerance)"
+echo "== 11/16 chaos soak gate (virtual-clock fleet fault tolerance)"
 # hours of seeded diurnal traffic compressed into seconds on the
 # virtual clock, with replica kills injected at virtual instants
 # (serving.replica:error@t>Ns, one FLAGS_fault_spec string — the
@@ -309,15 +323,35 @@ print(f\"   soak: {r['simulated_hours']}h simulated, \"
       f\"{rep['rehomed']} re-homed, goodput {rep['goodput_per_s']}/s, \"
       f\"0 leaks, 0 new compiles\")
 "
+# the same seeded soak under the runtime concurrency sanitizer
+# (FLAGS_sanitize_locks=1): every make_lock() lock instrumented, the
+# acquisition-order graph must stay acyclic and every guarded-state
+# write must happen under its declared lock, through kills, restarts
+# and re-homes — a shorter soak, since the schedule is the same
+FLAGS_sanitize_locks=1 JAX_PLATFORMS=cpu python tools/soak.py \
+  --model gpt2-tiny --hours 0.5 --rate 0.02 --kills 1 --replicas 2 \
+  --seed 0 --windows 4 --json \
+  --expect-kills-min 1 --expect-zero-leaks --expect-zero-new-compiles \
+  --expect-identity --expect-sanitizer-clean \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+san = r['sanitizer']
+assert san['enabled'] and san['lock_acquires'] > 0, san
+assert not san['cycles'] and not san['violations'], san
+print(f\"   sanitized soak: {san['lock_acquires']} acquires over \"
+      f\"{san['locks_tracked']} locks, {san['order_edges']} order \"
+      f\"edges, 0 cycles, 0 violations\")
+"
 
-echo "== 11/15 op coverage gate"
+echo "== 12/16 op coverage gate"
 if [[ -d /root/reference ]]; then
   JAX_PLATFORMS=cpu python tools/op_coverage.py --json
 else
   echo "   reference tree absent — skipped"
 fi
 
-echo "== 12/15 API freeze"
+echo "== 13/16 API freeze"
 SNAP=tools/api_signatures.txt
 API_NOW=$(mktemp)
 API_DIFF=$(mktemp)
@@ -336,7 +370,7 @@ else
   echo "   snapshot created ($(wc -l < "$SNAP") symbols) — commit it"
 fi
 
-echo "== 13/15 multi-chip dry run"
+echo "== 14/16 multi-chip dry run"
 # needs the jax_num_cpu_devices config option to carve out virtual CPU
 # devices; older jax builds (0.4.x) don't have it
 if JAX_PLATFORMS=cpu python -c "
@@ -352,7 +386,7 @@ else
   echo "   installed jax has no jax_num_cpu_devices — skipped"
 fi
 
-echo "== 14/15 train->serve loop gate (ZeRO + live hot-swap)"
+echo "== 15/16 train->serve loop gate (ZeRO + live hot-swap)"
 # 2-step ZeRO train runs match the unsharded baseline loss-for-loss on
 # a 1x1 mesh and again on a subprocess-carved dp=2 mesh (per-device
 # optimizer bytes asserted ~1/2 of total from live shards), then the
@@ -361,7 +395,7 @@ echo "== 14/15 train->serve loop gate (ZeRO + live hot-swap)"
 # zero new compiles
 JAX_PLATFORMS=cpu python tools/zero_smoke.py
 
-echo "== 15/15 README generated-fragment sync"
+echo "== 16/16 README generated-fragment sync"
 JAX_PLATFORMS=cpu python tools/sync_readme.py --check
 
 echo "CI PASSED"

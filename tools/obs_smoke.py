@@ -55,6 +55,12 @@ Asserts, end to end through the observability plane:
     GET /v1/requests/<id> serves the span timeline (and 404s unknown
     ids), and the predictor agrees ``tracing`` never compiles —
     per-phase predicted counts equal the live tracker;
+  - the static serving lint (``analysis.lint_serving``) reports zero
+    findings on the shipped fleet, and replaying the loadgen workload
+    under ``FLAGS_sanitize_locks=1`` keeps goodput within 5% of the
+    plain run, records zero lock-order cycles / guarded-state
+    violations over nonzero instrumented acquires, and matches the
+    predictor's ``sanitize`` no-op claim (predicted == observed);
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
     int8-quantization, SLO-admission and tracing metrics;
@@ -605,6 +611,77 @@ def main() -> int:
           f"E2E, {len(spansT)} spans / {len(flowsT)} flow events, "
           f"/v1/requests/<id> 200+404, {deltaT} == predicted")
 
+    # -- sanitize phase: the concurrency sanitizer is free ------------
+    # Replay the loadgen workload with FLAGS_sanitize_locks=1: every
+    # engine/router/metrics lock becomes a SanitizedLock recording
+    # order edges and guarded-state writes. The flag gates pure host
+    # bookkeeping, so (a) the predictor says sanitize=True compiles
+    # NOTHING new (validated no-op, like tracing) and the fresh-phase
+    # delta equals that prediction, (b) goodput stays within 5% of the
+    # plain loadgen run on the same virtual-clock schedule, and (c)
+    # the report comes back with zero cycles, zero violations, and
+    # nonzero instrumented acquires. The static half must agree the
+    # fleet is clean: lint_serving() returns zero findings.
+    from paddle_tpu.analysis import concurrency as ccz
+    from paddle_tpu.analysis import lint_serving as lint_serving_fn
+    lint_res = lint_serving_fn()
+    assert not lint_res.diagnostics, (
+        f"lint_serving found issues in the shipped fleet: "
+        f"{[str(d) for d in lint_res.diagnostics]}")
+    ccz.reset()
+    baseS = {site: c["count"]
+             for site, c in observability.compiles().items()
+             if site.startswith(("serving_", "decode_", "verify_"))}
+    pt.set_flags({"sanitize_locks": True})
+    try:
+        vcS = VirtualClock()
+        engS = ServingEngine(model, max_slots=3, max_len=32,
+                             buckets=[8, 16], max_queue=16,
+                             block_size=4, clock=vcS.now,
+                             slo_ttft_ms=40.0, slo_prefill_ms=4.0,
+                             slo_tpot_ms=1.0)
+        lgS = LoadGen(mode="bursty", rate=60.0, duration=1.0, seed=3,
+                      vocab_size=97, prompt_tokens=(3, 7),
+                      new_tokens=(2, 4),
+                      priority_mix={0: 0.2, 1: 0.6, 2: 0.2})
+        reportS = lgS.run(engS, clock=vcS, step_cost_ms=4.0)
+        sanS = ccz.report()
+    finally:
+        pt.set_flags({"sanitize_locks": False})
+    assert reportS["exceptions"] == 0, reportS
+    assert reportS["leaked_kv_blocks"] == 0, reportS
+    assert reportS["completed"] > 0, reportS
+    assert abs(reportS["goodput_per_s"] - report["goodput_per_s"]) \
+        <= 0.05 * report["goodput_per_s"], (
+        f"sanitized goodput {reportS['goodput_per_s']}/s strayed >5% "
+        f"from plain {report['goodput_per_s']}/s")
+    assert sanS["enabled"] and sanS["lock_acquires"] > 0, sanS
+    # the fresh engine's queue + step locks (the registry lock predates
+    # the flag flip, so it stays plain in-process)
+    assert sanS["locks_tracked"] >= 2, sanS
+    assert sanS["cycles"] == [], sanS["cycles"]
+    assert sanS["violations"] == [], sanS["violations"]
+    afterS = {site: c["count"]
+              for site, c in observability.compiles().items()
+              if site.startswith(("serving_", "decode_", "verify_"))}
+    deltaS = {site: n - baseS.get(site, 0) for site, n in afterS.items()
+              if n - baseS.get(site, 0)}
+    predS = predict_serving_compiles(
+        lg_workload, buckets=[8, 16], max_len=32, block_size=4,
+        slo_ttft_ms=40.0, sanitize=True)
+    assert predS == predict_serving_compiles(
+        lg_workload, buckets=[8, 16], max_len=32, block_size=4,
+        slo_ttft_ms=40.0), "sanitize must be a predictor no-op"
+    assert deltaS == predS, (
+        f"sanitize-phase recompile prediction drifted:\n"
+        f"  predicted {predS}\n  observed  {deltaS}")
+    print(f"   sanitize: lint_serving clean, "
+          f"{sanS['lock_acquires']} sanitized acquires over "
+          f"{sanS['locks_tracked']} locks ({sanS['order_edges']} "
+          f"order edges), 0 cycles / 0 violations, goodput "
+          f"{reportS['goodput_per_s']}/s ~ plain "
+          f"{report['goodput_per_s']}/s, {deltaS} == predicted")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -634,7 +711,8 @@ def main() -> int:
                    "serving_replica_state",
                    "serving_rehomed_total",
                    "STAT_serving_rehomed",
-                   "serving_traced_total"):
+                   "serving_traced_total",
+                   "sanitizer_lock_acquires"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 

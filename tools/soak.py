@@ -34,7 +34,11 @@ degradation contract**:
 - zero new XLA compiles after warmup — and
   ``analysis.recompile.predict_serving_compiles`` proves statically
   that the kill/restart/re-home counts are no-ops (predicting with
-  them == predicting without).
+  them == predicting without);
+- under ``FLAGS_sanitize_locks=1`` (+ ``--expect-sanitizer-clean``),
+  zero lock-order cycles and zero guarded-state violations from the
+  concurrency sanitizer across every kill/re-home/scrape — the soak
+  record carries ``analysis.sanitizer_report()`` either way.
 
 ``--sweep`` reruns the identical workload + kill schedule across
 :class:`AutoscalePolicy` bounds and emits the cost-vs-goodput
@@ -229,6 +233,11 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-identity", action="store_true",
                     help="exit 1 unless completed + rehomed + shed "
                     "(+ rejects/errors) == offered")
+    ap.add_argument("--expect-sanitizer-clean", action="store_true",
+                    help="exit 1 unless FLAGS_sanitize_locks was on, "
+                    "the sanitizer instrumented lock traffic, and it "
+                    "recorded zero lock-order cycles and zero "
+                    "guarded-state violations")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -338,6 +347,10 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
 
+    # ---- concurrency sanitizer verdict over every arm --------------
+    from paddle_tpu.analysis import concurrency as _ccz
+    san = _ccz.sanitizer_report()
+
     out = {
         "bench": "soak_fleet_fault_tolerance",
         "model": args.model,
@@ -352,6 +365,7 @@ def main(argv=None) -> int:
         "predictor_noop": predictor_noop,
         "identity_ok": identity_ok,
         "frontier": frontier,
+        "sanitizer": san,
     }
     if args.trace_out:
         out["trace_out"] = args.trace_out
@@ -384,6 +398,12 @@ def main(argv=None) -> int:
             print(f"frontier {row['arm']}: "
                   f"{row['replica_seconds']} replica-s -> "
                   f"{row['goodput_per_s']}/s goodput")
+        if san["enabled"]:
+            print(f"sanitizer: {san['lock_acquires']} acquires over "
+                  f"{san['locks_tracked']} locks, "
+                  f"{san['order_edges']} order edges, "
+                  f"{len(san['cycles'])} cycles, "
+                  f"{len(san['violations'])} violations")
 
     ok = True
     if args.expect_kills_min is not None and \
@@ -417,6 +437,19 @@ def main(argv=None) -> int:
             print(f"FAIL: predictor says kills/restarts/re-homes "
                   f"change compile counts:\n  plain {plain_pred}\n"
                   f"  chaos {chaos_pred}", file=sys.stderr)
+            ok = False
+    if args.expect_sanitizer_clean:
+        if not san["enabled"] or san["lock_acquires"] == 0:
+            print("FAIL: --expect-sanitizer-clean needs "
+                  "FLAGS_sanitize_locks=1 and instrumented lock "
+                  f"traffic (enabled={san['enabled']}, acquires="
+                  f"{san['lock_acquires']})", file=sys.stderr)
+            ok = False
+        if san["cycles"] or san["violations"]:
+            print(f"FAIL: sanitizer saw {len(san['cycles'])} lock-"
+                  f"order cycle(s), {len(san['violations'])} guarded-"
+                  f"state violation(s): {san['cycles']} "
+                  f"{san['violations']}", file=sys.stderr)
             ok = False
     if args.expect_identity and not identity_ok:
         print(f"FAIL: completed {report['completed']} + rehomed "

@@ -11,7 +11,8 @@ Three fragments are generated, everything else stays hand-written:
   - the GPT flagship headline bullet (from the latest BENCH_r*.json)
   - the "Static program checks" list between the
     `<!-- BEGIN GENERATED: verifier-checks -->` markers (from
-    framework/analysis.py:ANALYSIS_CHECKS + the registered flags)
+    framework/analysis.py:ANALYSIS_CHECKS +
+    analysis/lifecycle.py:CHECK_DOCS + the registered flags)
   - the "Fault tolerance" section between the
     `<!-- BEGIN GENERATED: fault-tolerance -->` markers (from
     resilience/injector.py:FAULT_SITES + the registered flags)
@@ -122,6 +123,7 @@ def render_checks_block():
     sys.path.insert(0, REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from paddle_tpu import flags
+    from paddle_tpu.analysis.lifecycle import CHECK_DOCS
     from paddle_tpu.framework.analysis import ANALYSIS_CHECKS
 
     def bullet(head, body):
@@ -132,9 +134,28 @@ def render_checks_block():
              ""]
     lines += [bullet(f"`{name}`", cd.description)
               for name, cd in ANALYSIS_CHECKS.items()]
+    lines += [
+        "",
+        "Serving concurrency & lifecycle (`analysis.lifecycle`, the",
+        "static half of the concurrency plane — the runtime half is the",
+        "`FLAGS_sanitize_locks` sanitizer below): an AST dataflow pass",
+        "over the serving sources models the KV/LoRA resource APIs as",
+        "obligation effects (acquire creates, release discharges,",
+        "export_row *moves* ownership into the handoff record, storing/",
+        "returning a handle escapes it to the holder's lifecycle) and",
+        "interprets each function over a path-merging abstract state",
+        "that follows raise edges and except handlers; a companion pass",
+        "checks every write to `# guarded-by: <lock>` attributes happens",
+        "under `with self.<lock>:` (declarations inherit across",
+        "subclasses; `# holds: <lock>` asserts a caller-held lock,",
+        "`# unguarded-ok: <reason>` waives one site). Checks:",
+        "",
+    ]
+    lines += [bullet(f"`{name}`", doc)
+              for name, doc in CHECK_DOCS.items()]
     lines += ["", "Flags:", ""]
     defs = flags.list_flags()
-    for name in _VERIFIER_FLAGS:
+    for name in _VERIFIER_FLAGS + ("sanitize_locks",):
         d = defs[name]
         lines.append(bullet(
             f"`FLAGS_{name}` (default `{d['default']}`)", d["help"]))
@@ -151,6 +172,26 @@ def render_checks_block():
         "`_fit_spec` replicated fallbacks, unknown mesh axes, and the "
         "per-device parameter-memory estimate — no devices needed "
         "(the mesh is plain axis sizes)."))
+    lines.append(bullet(
+        "`python tools/lint_serving.py --strict [--json]`",
+        "the serving concurrency/lifecycle lint over "
+        "engine/router/disagg/kv_cache/lora (`analysis.lifecycle."
+        "lint_serving`); `--strict` fails on warnings too, and "
+        "`--baseline tools/lint_serving_baseline.json` carries "
+        "justified findings — every entry needs a one-line "
+        "justification, stale entries warn so the baseline only "
+        "shrinks (it ships empty)."))
+    lines.append(bullet(
+        "`FLAGS_sanitize_locks=1 python tools/soak.py ... "
+        "--expect-sanitizer-clean`",
+        "the runtime half under chaos: every `make_lock()` lock "
+        "records held->acquired order edges (cycles = potential "
+        "deadlocks, recorded not raised) and `declare_guarded` "
+        "attributes raise `GuardedStateError` on writes without the "
+        "declared lock; the soak gate requires zero cycles and zero "
+        "violations through kills/restarts/re-homes, and "
+        "`analysis.sanitizer_report()` feeds the "
+        "`sanitizer_lock_acquires` counter."))
     return "\n".join(lines)
 
 
