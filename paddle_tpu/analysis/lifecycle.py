@@ -11,10 +11,12 @@ models the serving resource APIs as effects on *obligations*:
   ``LoRAPool.acquire`` create an obligation (the returned handle must
   eventually be released); all three row acquirers may return ``None``
   (no capacity), which ``if x is None:`` narrowing discharges;
-- ``release_row`` / ``release`` / ``release_blocks`` / ``deref``
-  discharge an obligation — discharging one that is already released
-  (double-release) or was exported (release-after-move — the classic
-  handoff double-free) is an ERROR;
+- ``release_row`` / ``release`` / ``release_blocks`` / ``deref`` /
+  ``cancel`` discharge an obligation — discharging one that is
+  already released (double-release — e.g. a hedge-loser teardown
+  releasing a row the winner's settlement already released) or was
+  exported (release-after-move — the classic handoff double-free) is
+  an ERROR;
 - ``export_row`` *moves* the obligation: the row no longer owns its
   blocks, the returned record does (a fresh obligation);
 - storing a handle into longer-lived state (``self._active[row] =
@@ -82,8 +84,9 @@ CHECK_DOCS = {
         "naming the leaking edge",
     "double-release":
         "an obligation already discharged is released again "
-        "(release_row / release / release_blocks / deref on a "
-        "RELEASED handle)",
+        "(release_row / release / release_blocks / deref / cancel on "
+        "a RELEASED handle — e.g. a hedge-loser teardown releasing a "
+        "row its winner's settlement already released)",
     "release-after-move":
         "a row released after export_row moved its blocks into a "
         "handoff record — the classic disaggregated-handoff "
@@ -98,9 +101,17 @@ CHECK_DOCS = {
         "justified-findings file can only shrink",
 }
 
-#: method name -> effect kind for the serving resource APIs
+#: method name -> effect kind for the serving resource APIs.
+#: ``cancel`` joins the release family (PR 17): canceling a request
+#: discharges whatever its stage still holds — the queued entry, the
+#: active row, or the handoff record's exported references — exactly
+#: once. A cancel path that pulls a slot out of ``_active`` without
+#: releasing it is a leak, and a hedge-loser teardown that releases
+#: the same row the winner's mirror already released is a
+#: double-release; both are the findings this pass exists to catch.
 FRESH_METHODS = ("acquire", "import_row", "adopt_row")
-RELEASE_METHODS = ("release_row", "release", "release_blocks", "deref")
+RELEASE_METHODS = ("release_row", "release", "release_blocks", "deref",
+                   "cancel")
 MOVE_METHODS = ("export_row",)
 #: container mutators the guarded-state checker treats as writes
 MUTATORS = frozenset((

@@ -134,6 +134,8 @@ def predict_serving_compiles(
         replica_kills: int = 0,
         restarts: int = 0,
         rehomed: int = 0,
+        cancel: int = 0,
+        hedge: int = 0,
         disagg: Optional[Tuple[int, int]] = None,
         sampling: Optional[Sequence[Tuple[float, int, float]]] = None,
         lora: Optional[Tuple[int, int]] = None,
@@ -217,6 +219,21 @@ def predict_serving_compiles(
     buckets ``warmup()`` already compiled, never widen the surface.
     N kill/restart/re-home cycles therefore predict the same counts
     as zero — the soak harness's degradation contract, statically.
+
+    ``cancel`` / ``hedge`` (the request-lifecycle robustness plane:
+    ``engine.cancel``/``router.cancel`` calls — client disconnects,
+    hard-deadline expiries, hedge-loser teardowns — and hedged
+    prefills dispatched by the router anywhere in the workload) are
+    validated no-ops for complementary reasons: a *cancel* is pure
+    host-side reclamation — the slot leaves ``_active``, its blocks
+    deref, the LoRA pin releases, counters bump — nothing ever reaches
+    a compiled step; a *hedge* submits a clone of an already-admitted
+    prompt, and a clone's prompt length lands in the same prefill
+    bucket its primary warmed (identical tokens, identical bucket), so
+    the duplicate dispatch replays a cached trace by construction. N
+    cancels and M hedges therefore predict the same counts as zero —
+    the cancellation/hedging soak's zero-new-compiles contract,
+    statically.
 
     ``disagg`` (``FLAGS_serving_disagg``: a ``(n_prefill, n_decode)``
     disaggregated fleet behind a ``DisaggRouter``) is the newest
@@ -313,7 +330,8 @@ def predict_serving_compiles(
         raise ValueError(
             f"weight_swaps must be >= 0, got {weight_swaps}")
     for val, name in ((replica_kills, "replica_kills"),
-                      (restarts, "restarts"), (rehomed, "rehomed")):
+                      (restarts, "restarts"), (rehomed, "rehomed"),
+                      (cancel, "cancel"), (hedge, "hedge")):
         if int(val) < 0:
             raise ValueError(f"{name} must be >= 0, got {val}")
     if disagg is not None:

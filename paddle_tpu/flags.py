@@ -179,6 +179,20 @@ define_flag("retry_max_delay", 2.0,
 define_flag("retry_deadline", 30.0,
             "RetryPolicy: wall-clock budget in seconds across all "
             "attempts of one call.")
+define_flag("retry_budget_ratio", 0.1,
+            "Fleet-wide RetryBudget: retry tokens earned per "
+            "successful call (the classic 'retries may add at most "
+            "this fraction of extra load'). Budgeted sites "
+            "(serving.route / serving.handoff / serving.replica) "
+            "withdraw one token per retry attempt; an empty bucket "
+            "turns the retry into an immediate RetryError, so "
+            "correlated failures shed as backpressure instead of "
+            "amplifying into a retry storm.")
+define_flag("retry_budget_reserve", 10.0,
+            "Fleet-wide RetryBudget: tokens the shared bucket starts "
+            "with (and its refill cap is 10x this floor), so isolated "
+            "early failures still retry before any successes have "
+            "funded the budget.")
 define_flag("guardian_max_skip", 3,
             "TrainGuardian: consecutive NaN/Inf steps tolerated as "
             "batch skips before rolling back to the latest "
@@ -403,6 +417,43 @@ define_flag("serving_auto_restart", True,
             "and the replacement reuses the compiled steps (zero new "
             "XLA compiles). False leaves the fleet one replica "
             "smaller (kill without restart).")
+define_flag("serving_hedge_ms", 0.0,
+            "ReplicaRouter hedged prefill (the Dean & Barroso "
+            "tail-at-scale move): when a submission's assigned "
+            "replica predicts a TTFT beyond this many ms, dispatch a "
+            "hedge copy to the second-best healthy replica after the "
+            "same delay — first first-token wins, the loser is "
+            "canceled with every KV block and LoRA pin reclaimed. "
+            "0 (default) disables hedging; a negative value derives "
+            "the threshold live from the traced fleet's TTFT p95 "
+            "(observability.tracing), so the hedge line tracks the "
+            "tail it is trimming. Pure host-side queue surgery: "
+            "predict_serving_compiles(hedge=N) is a validated no-op.")
+define_flag("serving_hedge_budget", 0.05,
+            "ReplicaRouter hedged prefill: token-bucket bound on "
+            "duplicated work — each offered submission deposits this "
+            "many hedge tokens and each dispatched hedge spends one, "
+            "so hedges never exceed budget * offered (+1 initial "
+            "allowance). 0 refuses all hedges even when "
+            "serving_hedge_ms arms them.")
+define_flag("serving_breaker_window", 20,
+            "ReplicaRouter per-replica circuit breaker: recent step "
+            "outcomes (ok / raised) remembered per replica. The "
+            "breaker complements the strike watchdog: strikes need "
+            "consecutive failures, the breaker trips on failure RATE "
+            "over this window, so a replica flapping between ok and "
+            "error stops receiving traffic before it ever reaches "
+            "the strike limit. 0 disables the breaker.")
+define_flag("serving_breaker_threshold", 0.5,
+            "ReplicaRouter per-replica circuit breaker: failure "
+            "fraction over the outcome window (with at least half "
+            "the window observed) that opens the breaker — an open "
+            "replica is skipped by routing like a draining one.")
+define_flag("serving_breaker_cooldown_s", 5.0,
+            "ReplicaRouter per-replica circuit breaker: seconds "
+            "(engine clock) an open breaker holds before going "
+            "half-open — one probe routes through; success closes "
+            "the breaker, failure re-opens it for another cooldown.")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

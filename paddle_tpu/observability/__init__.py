@@ -94,6 +94,31 @@ INSTRUMENT_DOCS = {
         "onto a live peer (queued re-routes + in-flight re-prefills "
         "and block-table splices); the third term of the accounting "
         "identity completed + shed + rehomed == offered",
+    "serving_canceled_total{engine=..., reason=...}":
+        "counter — requests canceled mid-lifecycle, by reason (client "
+        "| disconnect | deadline | hedge_lose | duplicate); every "
+        "cancel reclaims its KV blocks and LoRA pin at whatever stage "
+        "it caught the request (queued | prefill | decode | handoff), "
+        "the fourth term of the accounting identity completed + "
+        "rehomed + shed + canceled == offered",
+    "serving_hedges_total{router=..., outcome=...}":
+        "counter — hedged prefills, by outcome (fired: a hedge copy "
+        "was dispatched; win: the hedge produced first token first; "
+        "lose: the primary beat it and the hedge was canceled) — "
+        "volume bounded by the FLAGS_serving_hedge_budget token "
+        "bucket, losers torn down leak-free via cancel",
+    "serving_retry_budget_remaining":
+        "gauge — tokens left in the shared fleet-wide RetryBudget "
+        "(successes at budgeted sites deposit "
+        "FLAGS_retry_budget_ratio, every retry withdraws 1; an empty "
+        "bucket sheds would-be retries as backpressure instead of "
+        "letting correlated failures storm)",
+    "serving_breaker_state{router=..., replica=...}":
+        "gauge — per-replica circuit breaker: 0 closed (routing "
+        "normally), 1 open (error rate over "
+        "FLAGS_serving_breaker_threshold in the last "
+        "FLAGS_serving_breaker_window steps; replica skipped by the "
+        "router), 0.5 half-open (cooldown elapsed, one probe admitted)",
     "serving_traced_total":
         "counter — requests that carried a per-request trace (sampled "
         "in by FLAGS_serving_trace; the trace is host-side marks on "
@@ -202,6 +227,16 @@ EVENT_DOCS = {
                                "same geometry, so recovery reuses the "
                                "compiled steps (zero new XLA "
                                "compiles)",
+    "serving_cancel": "request canceled mid-lifecycle (request, stage: "
+                      "queued | prefill | decode | handoff, reason: "
+                      "client | disconnect | deadline | hedge_lose | "
+                      "duplicate) — all KV/LoRA holds reclaimed at the "
+                      "point of cancel",
+    "serving_hedge": "hedged prefill dispatched (request, primary, "
+                     "hedge, predicted_ttft_ms) — the straggler "
+                     "mitigation; resolution lands as a hedge_win/"
+                     "hedge_lose trace mark and a serving_cancel of "
+                     "the loser",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

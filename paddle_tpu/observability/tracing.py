@@ -70,6 +70,20 @@ _PHASE_AFTER = {
 #: every component name blame() can emit, in display order
 COMPONENTS = ("queue", "prefill", "decode", "handoff", "rehome")
 
+#: point-in-time annotations, not span boundaries: these marks record
+#: lifecycle *events* (a cancel landing, a hedge firing/resolving) on
+#: the timeline without starting a latency component, so the blame
+#: identity (sum(components) == e2e) and the COMPONENTS vocabulary are
+#: untouched by PR 17's cancellation/hedging edges. They still appear
+#: in get()'s mark list — visible in the timeline, invisible to blame.
+ANNOTATION_KINDS = frozenset({"cancel", "hedge", "hedge_win",
+                              "hedge_lose"})
+
+
+def _span_marks(marks):
+    """Marks that bound spans: the timeline minus pure annotations."""
+    return [m for m in marks if m[0] not in ANNOTATION_KINDS]
+
 
 class Trace:
     """One request's mark timeline. Marks are ``(kind, t, track)``
@@ -92,8 +106,9 @@ def blame(trace: Trace) -> dict:
     The identity is structural: spans are the gaps between consecutive
     marks, so ``sum(components) == e2e_s`` exactly (float addition
     aside) and the prefix ending at the ``first_token`` mark is
-    exactly the measured TTFT."""
-    marks = trace.marks
+    exactly the measured TTFT. ``ANNOTATION_KINDS`` marks are
+    timeline events, not span boundaries, and are skipped here."""
+    marks = _span_marks(trace.marks)
     comp: Dict[str, float] = {}
     ttft = None
     elapsed = 0.0
@@ -188,8 +203,9 @@ class TraceStore:
 
     def finish(self, rid: int, t: float, track: str, outcome: str,
                reason: Optional[str] = None) -> bool:
-        """Close a trace (outcome ``done`` | ``shed``) and move it to
-        the finished ring, evicting beyond the keep bound."""
+        """Close a trace (outcome ``done`` | ``shed`` | ``canceled``)
+        and move it to the finished ring, evicting beyond the keep
+        bound. Only ``done`` traces feed blame/TTFT aggregates."""
         keep = max(1, int(self._flags()["serving_trace_keep"]))
         with self._lock:
             tr = self._active.pop(int(rid), None)
@@ -281,6 +297,23 @@ class TraceStore:
             "tail_dominant": dominant,
         }
 
+    def ttft_p95_ms(self) -> Optional[float]:
+        """Fleet TTFT p95 (ms) over finished ``done`` traces — the
+        auto-derivation source for the hedge threshold
+        (``FLAGS_serving_hedge_ms < 0``): a hedge should fire only
+        when a request's predicted TTFT is already in the observed
+        tail. None until at least one traced request finished with a
+        first token."""
+        ttfts = []
+        for tr in self.finished():
+            if tr.outcome != "done":
+                continue
+            b = blame(tr)
+            if b["ttft_s"] is not None:
+                ttfts.append(b["ttft_s"] * 1e3)
+        p = _pctl(ttfts, 95)
+        return None if p is None else round(p, 6)
+
     # --------------------------------------------------------- exports
     def _export_rows(self):
         """Finished traces in submission (= request id) order with
@@ -299,7 +332,7 @@ class TraceStore:
         out: Dict[str, str] = {}
         counts: Dict[str, int] = {}
         for _i, tr in rows:
-            for _k, _t, trk in tr.marks:
+            for _k, _t, trk in _span_marks(tr.marks):
                 if trk in out:
                     continue
                 role = trk.rstrip("0123456789") or "track"
@@ -320,7 +353,7 @@ class TraceStore:
         names = self._track_names(rows)
         tracks: "OrderedDict[str, int]" = OrderedDict()
         for _i, tr in rows:
-            for _k, _t, trk in tr.marks:
+            for _k, _t, trk in _span_marks(tr.marks):
                 if trk not in tracks:
                     tracks[trk] = len(tracks)
 
@@ -335,8 +368,9 @@ class TraceStore:
                            "tid": tid, "args": {"name": names[trk]}})
         for idx, tr in rows:
             spans = []
-            for (k0, t0, _tr0), (k1, t1, trk1) in zip(tr.marks,
-                                                      tr.marks[1:]):
+            smarks = _span_marks(tr.marks)
+            for (k0, t0, _tr0), (k1, t1, trk1) in zip(smarks,
+                                                      smarks[1:]):
                 spans.append((_PHASE_AFTER.get(k0, k0), t0, t1, trk1))
             for si, (name, t0, t1, trk) in enumerate(spans):
                 tid = tracks[trk]
@@ -377,8 +411,9 @@ class TraceStore:
         rows = self._export_rows()
         names = self._track_names(rows)
         for idx, tr in rows:
-            for (k0, t0, _tr0), (k1, t1, trk1) in zip(tr.marks,
-                                                      tr.marks[1:]):
+            smarks = _span_marks(tr.marks)
+            for (k0, t0, _tr0), (k1, t1, trk1) in zip(smarks,
+                                                      smarks[1:]):
                 lines.append(json.dumps(
                     {"trace": idx,
                      "span": _PHASE_AFTER.get(k0, k0),
@@ -491,6 +526,10 @@ def reset():
 
 def blame_summary() -> dict:
     return _STORE.blame_summary()
+
+
+def ttft_p95_ms() -> Optional[float]:
+    return _STORE.ttft_p95_ms()
 
 
 def export_chrome_trace(path: Optional[str] = None) -> dict:

@@ -23,12 +23,14 @@ from .injector import (FAULT_SITE_DOCS, FAULT_SITES, FaultInjector,
                        InjectedDrop, InjectedFault, InjectedIOError,
                        InjectedPreemption, fault_point, fault_scope,
                        injector_active, set_time_source)
-from .retry import RetryError, RetryPolicy
+from .retry import (BUDGETED_SITES, RetryBudget, RetryError, RetryPolicy,
+                    default_budget, reset_default_budget)
 from .guardian import TrainGuardian
 
 __all__ = [
-    "FAULT_SITE_DOCS", "FAULT_SITES", "FaultInjector", "InjectedDrop",
-    "InjectedFault", "InjectedIOError", "InjectedPreemption", "RetryError",
-    "RetryPolicy", "TrainGuardian", "fault_point", "fault_scope",
-    "injector_active", "set_time_source",
+    "BUDGETED_SITES", "FAULT_SITE_DOCS", "FAULT_SITES", "FaultInjector",
+    "InjectedDrop", "InjectedFault", "InjectedIOError", "InjectedPreemption",
+    "RetryBudget", "RetryError", "RetryPolicy", "TrainGuardian",
+    "default_budget", "fault_point", "fault_scope", "injector_active",
+    "reset_default_budget", "set_time_source",
 ]

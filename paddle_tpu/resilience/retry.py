@@ -11,11 +11,13 @@ chaos tests can assert the recovery actually ran.
 from __future__ import annotations
 
 import random
+import threading
 import time
-from typing import Callable, Optional, Tuple, Type
+from typing import Callable, Dict, Optional, Tuple, Type
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from .. import observability as _obs
 
 
 class RetryError(Exception):
@@ -27,6 +29,109 @@ class RetryError(Exception):
 # retrying them can only waste the deadline hiding a real bug
 _NON_TRANSIENT = (FileNotFoundError, FileExistsError, IsADirectoryError,
                   NotADirectoryError, PermissionError)
+
+
+class RetryBudget:
+    """Fleet-wide token bucket bounding *total* retry volume.
+
+    Per-call retry loops amplify correlated failures: when every request
+    hits the same fault, each one independently burns its full attempt
+    budget and offered load multiplies by ``max_attempts``. The classic
+    fix (Google SRE book, "retry budgets") is a shared bucket: every
+    *success* anywhere in the fleet deposits ``ratio`` tokens, every
+    retry anywhere withdraws one, so retries can add at most ``ratio``
+    extra load in steady state. An empty bucket turns would-be retries
+    into immediate :class:`RetryError` — correlated failure sheds as
+    backpressure instead of storming.
+
+    The bucket starts at ``reserve`` tokens (so isolated early failures
+    still retry before any successes have funded it) and is capped at
+    10x ``reserve`` (so a long quiet period cannot bank an unbounded
+    storm allowance). Thread-safe; one shared instance per process (see
+    :func:`default_budget`) is the normal deployment — handing the same
+    object to every budgeted policy is what makes the bound fleet-wide.
+    """
+
+    def __init__(self, ratio: Optional[float] = None,
+                 reserve: Optional[float] = None):
+        g = _flags.get_flags(["retry_budget_ratio",
+                              "retry_budget_reserve"])
+        self.ratio = float(ratio if ratio is not None
+                           else g["retry_budget_ratio"])
+        self.reserve = float(reserve if reserve is not None
+                             else g["retry_budget_reserve"])
+        self.cap = 10.0 * self.reserve
+        self._tokens = min(self.reserve, self.cap)
+        self._lock = threading.Lock()
+        self.deposits = 0
+        self.withdrawals = 0
+        self.denials = 0
+        self._gauge = _obs.gauge(
+            "serving_retry_budget_remaining",
+            "tokens left in the shared fleet-wide RetryBudget "
+            "(successes deposit FLAGS_retry_budget_ratio, every retry "
+            "at a budgeted site withdraws 1; empty bucket = retries "
+            "shed as backpressure)")
+        self._gauge.set(self._tokens)
+
+    def deposit(self):
+        """A success anywhere funds ``ratio`` worth of future retries."""
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+            self.deposits += 1
+            self._gauge.set(self._tokens)
+
+    def try_withdraw(self, n: float = 1.0) -> bool:
+        """Spend ``n`` tokens for a retry; False means the fleet has
+        exhausted its retry allowance and the caller must give up."""
+        with self._lock:
+            if self._tokens >= n:
+                self._tokens -= n
+                self.withdrawals += 1
+                self._gauge.set(self._tokens)
+                return True
+            self.denials += 1
+            return False
+
+    def remaining(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"tokens": self._tokens, "ratio": self.ratio,
+                    "reserve": self.reserve, "cap": self.cap,
+                    "deposits": self.deposits,
+                    "withdrawals": self.withdrawals,
+                    "denials": self.denials}
+
+
+# Sites whose retries ride the shared budget: the serving hot paths
+# where a correlated fault (replica down, handoff stall) hits every
+# in-flight request at once. Checkpoint/PS-style sites keep per-call
+# semantics — their failures are rarely correlated across requests.
+BUDGETED_SITES: Tuple[str, ...] = ("serving.route", "serving.handoff",
+                                   "serving.replica")
+
+_default_budget: Optional[RetryBudget] = None
+_default_budget_lock = threading.Lock()
+
+
+def default_budget() -> RetryBudget:
+    """The process-wide shared budget budgeted sites attach to."""
+    global _default_budget
+    with _default_budget_lock:
+        if _default_budget is None:
+            _default_budget = RetryBudget()
+        return _default_budget
+
+
+def reset_default_budget():
+    """Drop the shared budget so the next use rebuilds from flags
+    (tests; mirrors monitor/observability reset idioms)."""
+    global _default_budget
+    with _default_budget_lock:
+        _default_budget = None
 
 
 class RetryPolicy:
@@ -52,7 +157,8 @@ class RetryPolicy:
                  _NON_TRANSIENT,
                  site: str = "",
                  sleep: Callable[[float], None] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 budget: Optional[RetryBudget] = None):
         g = _flags.get_flags(["retry_max_attempts", "retry_base_delay",
                               "retry_max_delay", "retry_deadline",
                               "fault_seed"])
@@ -71,10 +177,15 @@ class RetryPolicy:
         self._sleep = sleep
         self._clock = clock
         self._rng = random.Random(f"{g['fault_seed']}:{site}")
+        self.budget = budget
 
     @classmethod
     def from_flags(cls, site: str, **overrides) -> "RetryPolicy":
-        """Flag-configured policy for a named site (the common path)."""
+        """Flag-configured policy for a named site (the common path).
+        ``BUDGETED_SITES`` automatically attach the shared fleet-wide
+        :class:`RetryBudget` unless the caller passed ``budget=``."""
+        if site in BUDGETED_SITES and "budget" not in overrides:
+            overrides["budget"] = default_budget()
         return cls(site=site, **overrides)
 
     def backoff(self, attempt: int) -> float:
@@ -85,9 +196,10 @@ class RetryPolicy:
     def call(self, fn: Callable, *args, **kwargs):
         start = self._clock()
         last: Optional[BaseException] = None
+        budget_out = False
         for attempt in range(self.max_attempts):
             try:
-                return fn(*args, **kwargs)
+                result = fn(*args, **kwargs)
             except self.giveup_on:
                 raise
             except self.retry_on as e:
@@ -97,9 +209,25 @@ class RetryPolicy:
                 delay = self.backoff(attempt)
                 if self._clock() + delay - start > self.deadline:
                     break
+                # fleet-wide bound checked *before* the retry goes out:
+                # an empty bucket means correlated failure is already
+                # storming — shed this call as backpressure instead
+                if self.budget is not None and \
+                        not self.budget.try_withdraw():
+                    budget_out = True
+                    break
                 _monitor.stat_add(
                     f"STAT_retry_{self.site or 'anonymous'}")
                 self._sleep(delay)
+            else:
+                if self.budget is not None:
+                    self.budget.deposit()
+                return result
+        if budget_out:
+            raise RetryError(
+                f"{self.site or 'operation'} failed and the shared "
+                f"RetryBudget is exhausted — shedding instead of "
+                f"retrying (last: {last!r})") from last
         raise RetryError(
             f"{self.site or 'operation'} failed after "
             f"{self.max_attempts} attempts / "

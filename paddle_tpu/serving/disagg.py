@@ -155,6 +155,17 @@ class HandoffQueue:
                     return item
             return None
 
+    def take_by_id(self, rid: int) -> Optional[_Handoff]:
+        """Remove and return the record for request ``rid`` (None when
+        it is not queued here) — the cancellation path; the caller owns
+        the record's block references from then on."""
+        with self._lock:
+            for i, item in enumerate(self._items):
+                if item.req.id == rid:
+                    del self._items[i]
+                    return item
+            return None
+
     def put_back(self, item: _Handoff):
         """Return an item taken but not adoptable right now to the
         front, preserving FIFO order for the next attempt."""
@@ -265,6 +276,23 @@ class PrefillEngine(ServingEngine):
             shed += 1
         return shed
 
+    def cancel_pending(self, rid: int,
+                       reason: str = "client") -> Optional[dict]:
+        """Cancel one staged-but-undelivered export: the record owns
+        its block references until it reaches the handoff queue, so a
+        cancel here releases them directly (the LoRA pin was already
+        dropped at export time)."""
+        with self._step_lock:
+            for i, item in enumerate(self._pending):
+                if item.req.id == rid:
+                    del self._pending[i]
+                    item.rec["pool"].release_blocks(
+                        item.rec["blocks"])
+                    self._finalize_cancel(item.req, "handoff", reason)
+                    return {"id": rid, "stage": "handoff",
+                            "reason": reason}
+        return None
+
 
 class DecodeEngine(ServingEngine):
     """The decode-only role: adopt handoffs, then batched decode (or
@@ -327,6 +355,17 @@ class DecodeEngine(ServingEngine):
                 item = self._handoff.take(match)
                 if item is None:
                     break
+                if item.req.hard_deadline is not None and \
+                        self._clock() > item.req.hard_deadline:
+                    # hard (client-patience) expiry in the queue is a
+                    # cancel, not a shed: the client is gone, so the
+                    # record's exported references release here and
+                    # the request exits as canceled-not-completed
+                    item.rec["pool"].release_blocks(
+                        item.rec["blocks"])
+                    self._finalize_cancel(item.req, "handoff",
+                                          "deadline")
+                    continue
                 if item.req.deadline is not None and \
                         self._clock() > item.req.deadline:
                     # a record that outlived its TTFT deadline in the
@@ -381,13 +420,16 @@ class DecodeEngine(ServingEngine):
     def step(self) -> bool:
         with self._step_lock:
             _monitor.stat_add("STAT_serving_steps")
+            # reap hard-expired slots first: their rows free up for
+            # this very step's adoptions
+            reaped = self._reap_expired()
             worked = self._adopt_handoffs() > 0
             produced = (self._spec_decode() if self.spec_tokens
                         else self._decode())
             if self.paged:
                 self._blocks_used_g.set(self.cache.blocks_used)
                 self._blocks_free_g.set(self.cache.blocks_free)
-            return bool(worked or produced)
+            return bool(worked or produced or reaped)
 
 
 class DisaggRouter:
@@ -700,6 +742,78 @@ class DisaggRouter:
             raise ValueError("fleet has no LoRA pool")
         return page
 
+    # ------------------------------------------------------ cancellation
+    def cancel(self, rid: int, reason: str = "client"
+               ) -> Optional[dict]:
+        """Cancel request ``rid`` wherever it lives in the fleet:
+        queued or mid-prefill on a prefill worker, staged for export,
+        sitting in the handoff queue (the record's block references
+        release here), or mid-decode on a decode worker. The Request
+        object is shared across every engine's bookkeeping, so exactly
+        one stage holds its resources — the first hit wins and the
+        walk stops (a re-homed copy can never double-release). Returns
+        ``{"id", "stage", "reason"}`` or None for unknown/finished
+        ids. Pure host-side: zero new compiles
+        (``predict_serving_compiles(cancel=N)``)."""
+        rid = int(rid)
+        with self._lock:
+            engines = (list(self.prefills) + list(self.decodes)
+                       + list(self._killed))
+        req = None
+        for eng in engines:
+            with eng._lock:
+                req = next((r for r in eng._all if r.id == rid), None)
+            if req is not None:
+                break
+        if req is None or req.state in ("done", "shed", "canceled"):
+            return None
+        out = None
+        # queued / mid-prefill-wave / mid-decode — whichever engine
+        # actually holds the queue entry or the slot
+        for eng in engines:
+            out = eng._cancel_request(req, reason)
+            if out is not None:
+                break
+        if out is None:
+            # staged exports (finished prefill waiting for queue room)
+            for eng in engines:
+                if isinstance(eng, PrefillEngine):
+                    out = eng.cancel_pending(rid, reason)
+                    if out is not None:
+                        break
+        if out is None:
+            # in flight between the roles
+            item = self._handoff.take_by_id(rid)
+            if item is not None:
+                item.rec["pool"].release_blocks(item.rec["blocks"])
+                item.src._finalize_cancel(item.req, "handoff", reason)
+                out = {"id": rid, "stage": "handoff", "reason": reason}
+        if out is not None:
+            self._purge_affinity(req.prompt)
+        return out
+
+    def _purge_affinity(self, prompt: Sequence[int]) -> int:
+        """Drop stale fleet-index entries for a canceled prompt's
+        prefix chain: an entry is purged when its worker is gone or no
+        longer holds any cached block of the prefix (entries whose
+        worker still holds the prefix stay — other requests share
+        it)."""
+        if not self.prefix_affinity or not self.prefills:
+            return 0
+        bs = self.prefills[0].cache.block_size
+        keys = prefix_chain_keys(prompt, bs)
+        purged = 0
+        with self._lock:
+            for key in keys:
+                eng = self._affinity.get(key)
+                if eng is None:
+                    continue
+                if eng not in self.prefills or \
+                        eng.cache.match_prefix_blocks(prompt) == 0:
+                    del self._affinity[key]
+                    purged += 1
+        return purged
+
     # ---------------------------------------------------------- stepping
     def step(self) -> bool:
         """One fleet iteration: every prefill worker (admission +
@@ -991,12 +1105,15 @@ class DisaggRouter:
         share one — double counting would flatter the rate)."""
         engines = self.engines + self._retiring
         shed: dict = {}
+        canceled: dict = {}
         completed = 0
         for e in engines:
             with e._lock:
                 completed += e._completed
                 for k, v in e._shed_by_reason.items():
                     shed[k] = shed.get(k, 0) + v
+                for k, v in e._canceled_by_reason.items():
+                    canceled[k] = canceled.get(k, 0) + v
         pools = {}
         for e in engines:
             pools[id(e.cache.pool)] = e.cache.pool
@@ -1043,6 +1160,8 @@ class DisaggRouter:
             "rehomed": rehomed,
             "shed": shed,
             "shed_total": sum(shed.values()),
+            "canceled": canceled,
+            "canceled_total": sum(canceled.values()),
             "queue_depths": [self._depth(e) for e in self.prefills],
             "kv_blocks_free": [self._blocks_free(e)
                                for e in self.prefills],

@@ -160,9 +160,13 @@ class Request:
     """One generation request's lifecycle record.
 
     States: queued -> running -> done, with shed as the fault exit
-    (queued/running -> shed). ``output_ids`` is prompt + generated
-    tokens (EOS included when hit), matching ``greedy_search`` row
-    semantics token for token.
+    (queued/running -> shed) and canceled as the client exit
+    (queued/running -> canceled: a disconnect, an expired hard
+    deadline, or a hedge resolution tore the request down mid-flight,
+    reclaiming its KV blocks and LoRA pin at whatever stage it had
+    reached). ``output_ids`` is prompt + generated tokens (EOS
+    included when hit), matching ``greedy_search`` row semantics token
+    for token.
 
     ``priority`` is an integer class, lower = more urgent (default 1);
     requests within one class keep FIFO order. ``now`` lets the engine
@@ -198,6 +202,14 @@ class Request:
         self._cursor = None        # JsonCursor when json_mode is on
         self._lora_held = False    # this request pins its tenant page
         self.rehomed = False       # recovered from a killed replica
+        self._hedge_clone = False  # router-internal hedge copy: never
+        #                            surfaced in results()/reports
+        # absolute engine-clock time after which the request is
+        # canceled wherever it is (client patience, carried through
+        # handoffs and re-homes); None = no hard deadline. Distinct
+        # from `deadline` (the TTFT SLO bound, an admission-quality
+        # signal that sheds queued work but never kills decodes).
+        self.hard_deadline: Optional[float] = None
         self.tokens: List[int] = []
         self.state = "queued"
         self.slot: Optional[int] = None
@@ -389,6 +401,7 @@ class ServingEngine:
         self._prefill_ewma_all: Optional[float] = None
         self._tpot_ewma: Optional[float] = None
         self._shed_by_reason: Dict[str, int] = {}   # guarded-by: _lock
+        self._canceled_by_reason: Dict[str, int] = {}  # guarded-by: _lock
         self._slo_met = 0                           # guarded-by: _lock
         self.spec_tokens = int(spec_tokens if spec_tokens is not None
                                else g["serving_spec_tokens"])
@@ -549,6 +562,12 @@ class ServingEngine:
             "serving_shed_total",
             "requests shed, by reason (queue_full|slo|deadline|"
             "preempted|fault|drain) and priority class")
+        self._cancel_ctr = _obs.counter(
+            "serving_canceled_total",
+            "requests canceled mid-lifecycle, by reason (client|"
+            "disconnect|deadline|hedge_lose|duplicate); every cancel "
+            "reclaims its KV blocks and LoRA pin at whatever stage it "
+            "caught the request")
         self._slo_gauge = None
         if self.slo_ttft_ms:
             self._slo_gauge = _obs.gauge(
@@ -621,6 +640,7 @@ class ServingEngine:
         _ccz.declare_guarded(self, {
             "_queue": "_lock", "_all": "_lock", "_completed": "_lock",
             "_slo_met": "_lock", "_shed_by_reason": "_lock",
+            "_canceled_by_reason": "_lock",
             "_tenant_stats": "_lock",
             "_active": "_step_lock", "_spec_proposed": "_step_lock",
             "_spec_accepted": "_step_lock",
@@ -874,6 +894,7 @@ class ServingEngine:
                json_mode: Optional[bool] = None,
                tenant: Optional[str] = None,
                decode: Optional[DecodeParams] = None,
+               deadline_ms: Optional[float] = None,
                _log_request: bool = True) -> Request:
         """Queue a generation request; returns its handle immediately.
 
@@ -897,7 +918,19 @@ class ServingEngine:
         ``json_mode`` without a grammar or with speculative decoding
         enabled, ``tenant`` without a LoRA pool or naming an adapter
         that is not loaded. ``decode=`` passes a prebuilt
-        :class:`DecodeParams` instead of the individual fields."""
+        :class:`DecodeParams` instead of the individual fields.
+
+        ``deadline_ms`` is the client's patience: a hard end-to-end
+        deadline (engine-clock ms from submission) after which the
+        request is *canceled* wherever it is — queued, mid-prefill or
+        mid-decode — instead of burning slots for a caller that has
+        given up. It rides the Request through handoffs and re-homes.
+        Unlike the TTFT SLO deadline it never affects admission
+        prediction; None (the default) keeps today's run-to-completion
+        behavior."""
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
         mnt = int(max_new_tokens if max_new_tokens is not None
                   else self.default_max_new_tokens)
         eos = (eos_token_id if eos_token_id is not None
@@ -1011,6 +1044,8 @@ class ServingEngine:
             req._cursor = self.grammar.start()
         if self.slo_ttft_ms:
             req.deadline = now + self.slo_ttft_ms / 1e3
+        if deadline_ms is not None:
+            req.hard_deadline = now + float(deadline_ms) / 1e3
         reject = None          # (reason, predicted_ms) when shedding
         victims: List[Request] = []
         with self._lock:
@@ -1294,9 +1329,12 @@ class ServingEngine:
         oracle's ordering) — shedding any whose TTFT deadline already
         passed (reason="deadline") instead of spending a prefill
         dispatch on work that can no longer meet its SLO. Returns
-        ``(candidates, n_expired)``."""
+        ``(candidates, n_expired)``. Requests whose *hard* deadline
+        (client patience) lapsed in the queue are canceled here, the
+        queued leg of the every-stage-boundary enforcement."""
         out: List[Request] = []
         expired: List[Request] = []
+        hard_expired: List[Request] = []
         now = self._clock()
         with self._lock:
             if len(self._queue) > 1 and \
@@ -1306,7 +1344,10 @@ class ServingEngine:
                     self._queue, key=lambda r: (r.priority, r.id)))
             while len(out) < limit and self._queue:
                 req = self._queue.popleft()
-                if req.deadline is not None and now > req.deadline:
+                if req.hard_deadline is not None and \
+                        now > req.hard_deadline:
+                    hard_expired.append(req)
+                elif req.deadline is not None and now > req.deadline:
                     expired.append(req)
                 else:
                     out.append(req)
@@ -1314,7 +1355,9 @@ class ServingEngine:
             self._shed(req, _Shed("TTFT deadline expired in queue for "
                                   f"request {req.id}"),
                        reason="deadline")
-        return out, len(expired)
+        for req in hard_expired:
+            self._finalize_cancel(req, "queued", "deadline")
+        return out, len(expired) + len(hard_expired)
 
     def _admit_round_paged(self):  # holds: _step_lock
         """One paged admission pass: pop queued requests in admission
@@ -1845,6 +1888,108 @@ class ServingEngine:
                         "shed", reason=reason)
         req._done.set()
 
+    # ------------------------------------------------------ cancellation
+    def cancel(self, rid: int, reason: str = "client",
+               _finalize: bool = True) -> Optional[dict]:
+        """Terminate request ``rid`` at whatever stage it has reached —
+        queued or in a slot (mid-prefill-wave / mid-decode) — releasing
+        its KV row and LoRA pin. Pure host-side queue/slot surgery: no
+        compiled surface is touched (``predict_serving_compiles(
+        cancel=N)`` is a validated no-op). Returns ``{"id", "stage",
+        "reason"}`` on success, None for unknown or already-terminal
+        requests (idempotent: double-cancel is a no-op, not a
+        double-release).
+
+        ``_finalize=False`` is the router-internal detached mode for a
+        hedge primary whose clone won: resources are reclaimed and the
+        cancel is accounted, but the caller-visible handle is left
+        open so the winner's tokens can be mirrored onto it before
+        ``_done`` fires."""
+        rid = int(rid)
+        with self._lock:
+            req = next((r for r in self._all if r.id == rid), None)
+        if req is None or req.state in ("done", "shed", "canceled"):
+            return None
+        return self._cancel_request(req, reason, _finalize=_finalize)
+
+    def _cancel_request(self, req: Request, reason: str,
+                        _finalize: bool = True) -> Optional[dict]:
+        """Stage-dispatch half of :meth:`cancel`: pull the request out
+        of the queue (stage ``queued``) or its slot (stage ``prefill``
+        before the first token, ``decode`` after), then discharge."""
+        stage = None
+        with self._lock:
+            try:
+                self._queue.remove(req)
+                stage = "queued"
+            except ValueError:
+                pass       # not queued (admitted, or mid-admission)
+        if stage is None:
+            with self._step_lock:
+                slot = req.slot
+                if slot is not None and self._active.get(slot) is req:
+                    del self._active[slot]
+                    self.cache.release(slot)
+                    req.slot = None
+                    stage = ("decode" if req.first_token_at is not None
+                             else "prefill")
+        if stage is None:
+            # terminal already, or inside the admission instant of a
+            # concurrent step (it will run to completion normally) —
+            # nothing is held here, so there is nothing to reclaim
+            return None
+        self._finalize_cancel(req, stage, reason, _finalize)
+        return {"id": req.id, "stage": stage, "reason": reason}
+
+    def _finalize_cancel(self, req: Request, stage: str, reason: str,
+                         finalize: bool = True):
+        """Discharge a canceled request's remaining holds and account
+        the cancel. The KV row was already released by the caller (the
+        stage-specific surgery); this releases the LoRA pin, bumps the
+        counters/trace/run-log, and (unless detached) flips the handle
+        terminal. Safe under ``_step_lock`` — takes ``_lock`` in the
+        same step_lock -> lock order ``_finish`` established."""
+        if req._lora_held:
+            self.lora_pool.release(req.tenant)
+            req._lora_held = False
+        with self._lock:
+            self._canceled_by_reason[reason] = \
+                self._canceled_by_reason.get(reason, 0) + 1
+        self._cancel_ctr.labels(engine=self._eid, reason=reason).inc()
+        _monitor.stat_add("STAT_serving_canceled")
+        now = self._clock()
+        _runlog.log_event("serving_cancel", request=req.id,
+                          stage=stage, reason=reason,
+                          tokens=len(req.tokens))
+        _tracing.mark(req.id, "cancel", now, self.trace_track)
+        _tracing.finish(req.id, now, self.trace_track, "canceled",
+                        reason=reason)
+        if finalize:
+            req.state = "canceled"
+            req.shed_reason = reason
+            req.finished_at = now
+            req._done.set()
+
+    def _reap_expired(self) -> int:  # holds: _step_lock
+        """Between-steps hard-deadline sweep: cancel every active slot
+        whose request's ``hard_deadline`` has passed — expired work is
+        canceled-not-completed, so a dead client never burns a decode
+        slot past its patience. Runs before admission so the freed
+        slots are reusable in the same step. Returns cancels."""
+        now = self._clock()
+        n = 0
+        for slot, req in list(self._active.items()):
+            hd = req.hard_deadline
+            if hd is not None and now > hd:
+                del self._active[slot]
+                self.cache.release(slot)
+                req.slot = None
+                stage = ("decode" if req.first_token_at is not None
+                         else "prefill")
+                self._finalize_cancel(req, stage, "deadline")
+                n += 1
+        return n
+
     # --------------------------------------------------------- stepping
     def step(self) -> bool:
         """One scheduler iteration: admit into free slots (batched
@@ -1853,13 +1998,17 @@ class ServingEngine:
         whether any work happened."""
         with self._step_lock:
             _monitor.stat_add("STAT_serving_steps")
+            # hard-deadline sweep first: a request that expired since
+            # the last step is canceled within one step and its slot
+            # is free for this step's admissions
+            reaped = self._reap_expired()
             admitted = self._admit()
             produced = (self._spec_decode() if self.spec_tokens
                         else self._decode())
             if self.paged:
                 self._blocks_used_g.set(self.cache.blocks_used)
                 self._blocks_free_g.set(self.cache.blocks_free)
-            return bool(admitted or produced)
+            return bool(admitted or produced or reaped)
 
     def stats(self) -> dict:
         """Per-engine serving metrics: time-to-first-token and
@@ -1889,6 +2038,7 @@ class ServingEngine:
             completed = self._completed
             slo_met = self._slo_met
             shed = dict(self._shed_by_reason)
+            canceled = dict(self._canceled_by_reason)
             queued = len(self._queue)
             tenants = {k: list(v) for k, v in self._tenant_stats.items()}
         out = {
@@ -1905,6 +2055,11 @@ class ServingEngine:
             # stats() view of serving_shed_total{reason=,priority=}
             "shed": shed,
             "shed_total": sum(shed.values()),
+            # per-reason cancels — the stats() view of
+            # serving_canceled_total{reason=}; the fourth term of
+            # completed + rehomed + shed + canceled == offered
+            "canceled": canceled,
+            "canceled_total": sum(canceled.values()),
         }
         if self.slo_ttft_ms:
             out["slo_ttft_ms"] = self.slo_ttft_ms

@@ -6,7 +6,8 @@ minimal JSON generation protocol:
 
   POST /v1/generate   {"ids": [...], "max_new_tokens"?, "eos_token_id"?,
                        "priority"?, "temperature"?, "top_k"?, "top_p"?,
-                       "stop"?, "seed"?, "tenant"?, "json_mode"?}
+                       "stop"?, "seed"?, "tenant"?, "json_mode"?,
+                       "deadline_ms"?}
                       -> 200 {"id", "output_ids", "generated", "state"}
                          (+ "tenant" echoed when one was named)
                       -> 400 bad request geometry / malformed JSON /
@@ -55,6 +56,23 @@ minimal JSON generation protocol:
                       -> 404 unknown id, unsampled request, or one
                              evicted from the bounded finished ring
                              (FLAGS_serving_trace_keep)
+  DELETE /v1/requests/<id>
+                      -> 200 {"id", "stage", "reason"} — the request
+                             was canceled wherever it lived (queued /
+                             prefill / handoff / decode) with every
+                             KV block and LoRA pin reclaimed
+                             (``engine.cancel``; works identically
+                             against a ReplicaRouter or DisaggRouter
+                             front end)
+                      -> 400 non-integer id
+                      -> 404 unknown id or already-finished request
+                             (double-DELETE is a no-op, not an error)
+
+``deadline_ms`` on POST is the client's patience: the request is
+canceled — not completed — wherever it is the moment the deadline
+lapses (``Request.hard_deadline``). A client that hangs up early gets
+the same treatment: a broken pipe on the response write cancels the
+request so a dead connection never pins KV blocks or decode slots.
 
 Like the KV rendezvous server, this is unauthenticated cluster-private
 HTTP; bind 127.0.0.1 (the default here) unless the network is trusted.
@@ -90,6 +108,16 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _json_or_cancel(self, code: int, payload: dict, rid: int):
+        """Write a response for request ``rid``; a broken pipe means
+        the client hung up before the result landed, so cancel the
+        request — reclaiming its KV blocks and LoRA pin if it is still
+        in flight (a no-op for already-finished requests)."""
+        try:
+            self._json(code, payload)
+        except (BrokenPipeError, ConnectionResetError):
+            self.server.engine.cancel(rid, reason="disconnect")
 
     def do_GET(self):
         engine: ServingEngine = self.server.engine
@@ -153,7 +181,8 @@ class _ServingHandler(BaseHTTPRequestHandler):
                                 stop=body.get("stop"),
                                 seed=body.get("seed"),
                                 json_mode=body.get("json_mode"),
-                                tenant=body.get("tenant"))
+                                tenant=body.get("tenant"),
+                                deadline_ms=body.get("deadline_ms"))
         except QueueFullError as e:
             # Retry-After: the engine's predicted-TTFT backoff when it
             # attached one (how long the backlog actually needs), else
@@ -169,17 +198,37 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
             return
         if not req.wait(self.server.request_timeout):
-            self._json(504, {"error": f"request {req.id} timed out"})
+            self._json_or_cancel(
+                504, {"error": f"request {req.id} timed out"}, req.id)
             return
         if req.state != "done":
-            self._json(503, {"error": f"request {req.id} {req.state}: "
-                                      f"{req.error}"})
+            self._json_or_cancel(
+                503, {"error": f"request {req.id} {req.state}: "
+                               f"{req.error}"}, req.id)
             return
         payload = {"id": req.id, "output_ids": req.output_ids,
                    "generated": len(req.tokens), "state": req.state}
         if req.tenant:
             payload["tenant"] = req.tenant
-        self._json(200, payload)
+        self._json_or_cancel(200, payload, req.id)
+
+    def do_DELETE(self):
+        engine: ServingEngine = self.server.engine
+        if not self.path.startswith("/v1/requests/"):
+            self._json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        tail = self.path[len("/v1/requests/"):]
+        try:
+            rid = int(tail)
+        except ValueError:
+            self._json(400, {"error": f"bad request id {tail!r}"})
+            return
+        out = engine.cancel(rid, reason="client")
+        if out is None:
+            self._json(404, {"error": f"request {rid} is unknown or "
+                                      "already finished"})
+        else:
+            self._json(200, out)
 
 
 class ServingHTTPServer:

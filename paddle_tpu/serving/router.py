@@ -43,7 +43,8 @@ Decisions are cooldown-limited so one burst doesn't thrash the set.
 from __future__ import annotations
 
 import itertools
-from typing import List, Optional, Sequence
+from collections import deque
+from typing import Dict, List, Optional, Sequence
 
 from .. import monitor as _monitor
 from ..analysis import concurrency as _ccz
@@ -153,13 +154,36 @@ class ReplicaRouter:
 
     def __init__(self, model=None, n_replicas: Optional[int] = None,
                  engines: Optional[Sequence[ServingEngine]] = None,
-                 autoscale=None, **engine_kwargs):
+                 autoscale=None, hedge_ms: Optional[float] = None,
+                 hedge_budget: Optional[float] = None,
+                 **engine_kwargs):
         from .. import flags as _flags
         g = _flags.get_flags(["serving_replicas", "serving_autoscale",
                               "serving_replica_strikes",
-                              "serving_auto_restart"])
+                              "serving_auto_restart",
+                              "serving_hedge_ms",
+                              "serving_hedge_budget",
+                              "serving_breaker_window",
+                              "serving_breaker_threshold",
+                              "serving_breaker_cooldown_s"])
         self._strike_limit = max(1, int(g["serving_replica_strikes"]))
         self._auto_restart = bool(g["serving_auto_restart"])
+        # hedged prefill (Dean & Barroso tail-at-scale): 0 = off,
+        # > 0 = fixed threshold/delay ms, < 0 = auto-derive from the
+        # traced fleet TTFT p95 (tracing.ttft_p95_ms)
+        self._hedge_ms = float(hedge_ms if hedge_ms is not None
+                               else g["serving_hedge_ms"])
+        self._hedge_budget_frac = float(
+            hedge_budget if hedge_budget is not None
+            else g["serving_hedge_budget"])
+        if self._hedge_budget_frac < 0:
+            raise ValueError(
+                "serving_hedge_budget must be >= 0, got "
+                f"{self._hedge_budget_frac}")
+        # per-replica circuit breaker config (0 window disables)
+        self._brk_window_n = max(0, int(g["serving_breaker_window"]))
+        self._brk_threshold = float(g["serving_breaker_threshold"])
+        self._brk_cooldown = float(g["serving_breaker_cooldown_s"])
         if autoscale is None:
             bounds = _parse_autoscale(g["serving_autoscale"])
             if bounds is not None:
@@ -222,6 +246,16 @@ class ReplicaRouter:
         self._rehomed = 0                   # guarded-by: _lock
         # serving.replica round-robin victim cursor
         self._victim_rr = 0                 # guarded-by: _lock
+        # hedged-prefill registry: primary request id -> pending hedge
+        # record; the token bucket starts at 1.0 and earns
+        # hedge_budget per offered request, so fired hedges can never
+        # exceed 1 + hedge_budget * offered
+        self._hedges: Dict[int, dict] = {}  # guarded-by: _lock
+        self._hedge_tokens = 1.0            # guarded-by: _lock
+        self._hedge_fired = 0               # guarded-by: _lock
+        self._hedge_wins = 0                # guarded-by: _lock
+        self._hedge_loses = 0               # guarded-by: _lock
+        self._hedge_dup_tokens = 0          # guarded-by: _lock
         rid = str(next(ReplicaRouter._router_ids))
         self._rid = rid
         for eng in self.engines:
@@ -230,6 +264,11 @@ class ReplicaRouter:
             "serving_rehomed_total",
             "requests recovered off a killed replica onto a live peer"
             ).labels(router=rid)
+        self._hedge_ctr = _obs.counter(
+            "serving_hedges_total",
+            "hedged prefills, by outcome (fired | win | lose); volume "
+            "bounded by the FLAGS_serving_hedge_budget token bucket, "
+            "losers canceled leak-free")
         self._replicas_gauge = _obs.gauge(
             "serving_replicas",
             "data-parallel engine replicas behind this ReplicaRouter"
@@ -250,13 +289,23 @@ class ReplicaRouter:
             "_scale_downs": "_lock", "_steps_since_scale": "_lock",
             "_kills": "_lock", "_restarts": "_lock",
             "_rehomed": "_lock", "_victim_rr": "_lock",
+            "_hedges": "_lock", "_hedge_tokens": "_lock",
+            "_hedge_fired": "_lock", "_hedge_wins": "_lock",
+            "_hedge_loses": "_lock", "_hedge_dup_tokens": "_lock",
         })
 
     # ------------------------------------------------------------ health
-    @staticmethod
-    def _init_health(eng: ServingEngine):
+    def _init_health(self, eng: ServingEngine):
         eng._health = "healthy"
         eng._strikes = 0
+        # circuit-breaker state rides the engine like _health/_strikes:
+        # a rolling window of step outcomes, tripping on error RATE
+        # (the strikes watchdog needs CONSECUTIVE failures — a replica
+        # failing every other step never strikes out but still poisons
+        # its share of traffic; the breaker catches exactly that)
+        eng._brk_window = deque(maxlen=max(1, self._brk_window_n))
+        eng._brk_state = "closed"
+        eng._brk_opened_at = 0.0
 
     def _update_state_gauges(self):
         for i, eng in enumerate(self.engines):
@@ -268,6 +317,15 @@ class ReplicaRouter:
                     ).labels(router=self._rid, replica=str(i),
                              state=state).set(
                         1 if eng._health == state else 0)
+            if self._brk_window_n > 0:
+                _obs.gauge(
+                    "serving_breaker_state",
+                    "per-replica circuit breaker: 0 closed, 1 open "
+                    "(error rate tripped; replica skipped by routing), "
+                    "0.5 half-open (one probe admitted)"
+                    ).labels(router=self._rid, replica=str(i)).set(
+                        {"closed": 0.0, "open": 1.0,
+                         "half-open": 0.5}[eng._brk_state])
 
     def _step_replica(self, eng: ServingEngine) -> bool:
         """One supervised step: an exception, or no progress while the
@@ -280,18 +338,51 @@ class ReplicaRouter:
         except Exception:
             worked = False
             eng._strikes += 1
+            self._note_breaker(eng, False)
         else:
             if worked:
                 eng._strikes = 0
                 if eng._health in ("suspect", "recovering"):
                     eng._health = "healthy"
+                self._note_breaker(eng, True)
             elif self._depth(eng) > 0:
                 eng._strikes += 1
+                self._note_breaker(eng, False)
         if eng._strikes >= self._strike_limit:
             eng._health = "dead"
         elif eng._strikes >= 1 and eng._health == "healthy":
             eng._health = "suspect"
         return worked
+
+    def _note_breaker(self, eng: ServingEngine, ok: bool):
+        """Feed one step outcome into the replica's breaker window.
+        Closed: trips open when the windowed failure rate reaches
+        FLAGS_serving_breaker_threshold with at least half the window
+        observed. Open: cools down FLAGS_serving_breaker_cooldown_s of
+        engine-clock time, then half-opens. Half-open: one probe —
+        success closes (window reset), failure re-opens."""
+        if self._brk_window_n <= 0:
+            return
+        now = eng._clock()
+        if eng._brk_state == "open":
+            if now - eng._brk_opened_at >= self._brk_cooldown:
+                eng._brk_state = "half-open"
+            return
+        if eng._brk_state == "half-open":
+            if ok:
+                eng._brk_state = "closed"
+                eng._brk_window.clear()
+            else:
+                eng._brk_state = "open"
+                eng._brk_opened_at = now
+            return
+        w = eng._brk_window
+        w.append(bool(ok))
+        if len(w) >= max(1, self._brk_window_n // 2):
+            rate = 1.0 - sum(w) / len(w)
+            if rate >= self._brk_threshold:
+                eng._brk_state = "open"
+                eng._brk_opened_at = now
 
     def _reap_dead(self):
         """Tear down replicas the watchdog declared dead: restart them
@@ -406,6 +497,13 @@ class ReplicaRouter:
                 last_err = QueueFullError(
                     f"replica {i} is draining", reason="drain")
                 continue
+            if getattr(eng, "_brk_state", "closed") == "open":
+                # breaker tripped on error rate: skipped like a
+                # draining replica until the cooldown half-opens it
+                # (half-open admits this request as the probe)
+                last_err = QueueFullError(
+                    f"replica {i} breaker is open", reason="fault")
+                continue
             try:
                 req = eng.submit(prompt, max_new_tokens=max_new_tokens,
                                  eos_token_id=eos_token_id,
@@ -415,6 +513,7 @@ class ReplicaRouter:
             except QueueFullError as e:
                 last_err = e
                 continue
+            req._routed_to = eng
             _monitor.stat_add("STAT_serving_routed")
             _runlog.log_event("serving_route", request=req.id,
                               replica=i, depth=self._depth(eng),
@@ -446,13 +545,248 @@ class ReplicaRouter:
                                      "are shed for rolling shutdown",
                                      reason="drain")
         try:
-            return RetryPolicy.from_flags("serving.route").call(
+            req = RetryPolicy.from_flags("serving.route").call(
                 self._route_attempt, prompt, max_new_tokens,
                 eos_token_id, priority, _log_request, **decode_kwargs)
         except RetryError as e:
             _monitor.stat_add("STAT_serving_route_shed")
             raise QueueFullError(
                 f"routing retries exhausted: {e}", reason="fault") from e
+        if self._hedge_ms != 0.0:
+            with self._lock:
+                # every offered request funds the hedge bucket, so
+                # fired hedges <= 1 + hedge_budget * offered by
+                # construction (spend is 1.0 per fire, at fire time)
+                self._hedge_tokens += self._hedge_budget_frac
+            self._maybe_arm_hedge(req, prompt, dict(
+                max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id, priority=priority,
+                **decode_kwargs))
+        return req
+
+    # ----------------------------------------------------- hedged prefill
+    def _hedge_threshold_ms(self) -> Optional[float]:
+        """The active hedge threshold/delay in ms: the flag when fixed
+        (> 0), the traced fleet TTFT p95 when auto (< 0, None until
+        enough traced requests finished), None when hedging is off."""
+        if self._hedge_ms > 0:
+            return self._hedge_ms
+        if self._hedge_ms < 0:
+            return _tracing.ttft_p95_ms()
+        return None
+
+    def _routable(self, but: Optional[ServingEngine] = None
+                  ) -> List[ServingEngine]:
+        return [e for e in self.engines
+                if e is not but and e._health != "dead"
+                and not getattr(e, "draining", False)
+                and getattr(e, "_brk_state", "closed") != "open"]
+
+    def _maybe_arm_hedge(self, req: Request, prompt, kwargs: dict):
+        """Arm a hedge for a just-routed request whose assigned
+        replica's predicted TTFT exceeds the threshold: after the
+        threshold delay, if the primary still has no first token, a
+        clone is dispatched to the second-best healthy replica (first
+        first-token wins, the loser is canceled leak-free)."""
+        thr = self._hedge_threshold_ms()
+        if thr is None or thr <= 0:
+            return
+        eng = getattr(req, "_routed_to", None)
+        if eng is None or not self._routable(but=eng):
+            return            # nowhere to hedge to
+        pred = eng.predict_ttft_ms(len(prompt))
+        if pred <= thr:
+            return
+        with self._lock:
+            self._hedges[req.id] = {
+                "req": req, "primary": eng, "clone": None,
+                "won": False, "prompt": [int(t) for t in prompt],
+                "kwargs": kwargs, "pred_ms": pred,
+                "fire_at": eng._clock() + thr / 1e3}
+
+    def _fire_due_hedges(self):
+        """Dispatch every armed hedge whose delay elapsed while the
+        primary is still tokenless; disarm hedges whose primary
+        produced or retired in time. Budget-gated: each fire spends
+        one token from the offered-funded bucket — a dry bucket drops
+        the hedge (the primary just runs unhedged)."""
+        with self._lock:
+            if not self._hedges:
+                return
+            now = self.engines[0]._clock()
+            due = []
+            for rid, h in list(self._hedges.items()):
+                if h["clone"] is not None:
+                    continue   # fired; resolution handles it
+                req = h["req"]
+                if req.state in ("done", "shed", "canceled") or \
+                        req.first_token_at is not None:
+                    del self._hedges[rid]   # beat the threshold
+                    continue
+                if now < h["fire_at"]:
+                    continue
+                if self._hedge_tokens < 1.0:
+                    del self._hedges[rid]   # budget dry: no hedge
+                    continue
+                self._hedge_tokens -= 1.0
+                due.append(h)
+        for h in due:
+            self._dispatch_hedge(h)
+
+    def _dispatch_hedge(self, h: dict):
+        """Submit the hedge copy to the best routable replica other
+        than the primary. The clone is router-internal (never appears
+        in results()/reports); a failed dispatch refunds the token."""
+        req = h["req"]
+        peers = sorted(self._routable(but=h["primary"]),
+                       key=lambda p: (_HEALTH_RANK[p._health],
+                                      self._depth(p),
+                                      -self._blocks_free(p)))
+        clone = None
+        for peer in peers:
+            try:
+                clone = peer.submit(h["prompt"], _log_request=False,
+                                    **h["kwargs"])
+            except (QueueFullError, ValueError):
+                continue
+            clone._hedge_clone = True
+            clone._routed_to = peer
+            break
+        with self._lock:
+            if clone is None:
+                self._hedges.pop(req.id, None)
+                self._hedge_tokens += 1.0   # refund: nothing fired
+                return
+            h["clone"] = clone
+            self._hedge_fired += 1
+        self._hedge_ctr.labels(router=self._rid, outcome="fired").inc()
+        _monitor.stat_add("STAT_serving_hedges")
+        _runlog.log_event("serving_hedge", request=req.id,
+                          hedge=clone.id,
+                          predicted_ttft_ms=round(h["pred_ms"], 3))
+        t = self.engines[0]._clock()
+        _tracing.mark(req.id, "hedge", t, h["primary"].trace_track)
+
+    def _count_hedge(self, outcome: str, dup_tokens: int = 0):
+        with self._lock:
+            if outcome == "win":
+                self._hedge_wins += 1
+            else:
+                self._hedge_loses += 1
+            self._hedge_dup_tokens += int(dup_tokens)
+        self._hedge_ctr.labels(router=self._rid, outcome=outcome).inc()
+
+    def _mirror_clone(self, req: Request, clone: Request):
+        """Graft the winning clone's result onto the caller-visible
+        primary handle (detach-canceled when the clone won): tokens,
+        timing and terminal state, then release the waiter."""
+        req.tokens = list(clone.tokens)
+        req.first_token_at = clone.first_token_at
+        req.finished_at = clone.finished_at
+        req.error = clone.error
+        req.shed_reason = clone.shed_reason
+        req.state = clone.state
+        req._done.set()
+
+    def _resolve_hedges(self):
+        """Settle fired hedges: first first-token wins. A losing clone
+        is canceled through the engine cancel path (zero leaked
+        blocks); a losing *primary* is detach-canceled (resources
+        reclaimed, handle kept open) and the clone's result is
+        mirrored onto it once the clone retires."""
+        with self._lock:
+            items = list(self._hedges.items())
+        for rid, h in items:
+            req, clone = h["req"], h["clone"]
+            if clone is None:
+                continue
+            if h["won"]:
+                # waiting for the winning clone to retire -> mirror
+                if clone.state in ("done", "shed", "canceled"):
+                    self._mirror_clone(req, clone)
+                    with self._lock:
+                        self._hedges.pop(rid, None)
+                continue
+            p_first, c_first = req.first_token_at, clone.first_token_at
+            p_term = req.state in ("done", "shed", "canceled")
+            c_term = clone.state in ("done", "shed", "canceled")
+            if p_first is not None and (c_first is None or
+                                        p_first <= c_first):
+                # primary won (ties break to the primary): tear the
+                # clone down wherever it is
+                _tracing.mark(clone.id, "hedge_lose",
+                              self.engines[0]._clock(),
+                              getattr(clone, "_routed_to",
+                                      h["primary"]).trace_track)
+                self._cancel_on_engines(clone.id, "hedge_lose")
+                self._count_hedge("lose",
+                                  dup_tokens=len(clone.tokens))
+                with self._lock:
+                    self._hedges.pop(rid, None)
+            elif c_first is not None:
+                # the hedge won: reclaim the primary's seat now (its
+                # queue position / slot), mirror when the clone ends
+                _tracing.mark(clone.id, "hedge_win", c_first,
+                              getattr(clone, "_routed_to",
+                                      h["primary"]).trace_track)
+                self._cancel_on_engines(req.id, "hedge_lose",
+                                        _finalize=False)
+                self._count_hedge("win", dup_tokens=len(req.tokens))
+                h["won"] = True
+                if c_term:
+                    self._mirror_clone(req, clone)
+                    with self._lock:
+                        self._hedges.pop(rid, None)
+            elif c_term:
+                # clone died without a token (shed/fault): hedge lost,
+                # the primary continues unhedged
+                self._count_hedge("lose")
+                with self._lock:
+                    self._hedges.pop(rid, None)
+            elif p_term:
+                # primary retired without a token (shed / canceled
+                # externally): the pair is moot — tear the clone down
+                self._cancel_on_engines(clone.id, "duplicate")
+                self._count_hedge("lose",
+                                  dup_tokens=len(clone.tokens))
+                with self._lock:
+                    self._hedges.pop(rid, None)
+
+    # ------------------------------------------------------ cancellation
+    def _cancel_on_engines(self, rid: int, reason: str,
+                           _finalize: bool = True) -> Optional[dict]:
+        """Try the cancel on every engine (live + retiring) until one
+        holds the request — the fleet-level dedupe: a re-homed request
+        appears in several engines' books but the shared Request
+        object is canceled exactly once, wherever its resources
+        actually live."""
+        for eng in list(self.engines) + list(self._retiring):
+            res = eng.cancel(rid, reason=reason, _finalize=_finalize)
+            if res is not None:
+                return res
+        return None
+
+    def cancel(self, rid: int, reason: str = "client"
+               ) -> Optional[dict]:
+        """Cancel request ``rid`` anywhere in the fleet — queued or
+        in-flight on any replica, re-homed copies deduped — releasing
+        its KV blocks and LoRA pin. If the request has a pending or
+        fired hedge, the whole pair is torn down (the clone cancels as
+        reason="duplicate" — never a double release: each side's
+        resources are released by its own engine exactly once).
+        Returns ``{"id", "stage", "reason"}`` or None for unknown /
+        already-finished requests."""
+        rid = int(rid)
+        with self._lock:
+            h = self._hedges.pop(rid, None)
+        res = self._cancel_on_engines(rid, reason)
+        if h is not None and h["clone"] is not None:
+            clone = h["clone"]
+            if self._cancel_on_engines(clone.id, "duplicate") \
+                    is not None:
+                self._count_hedge("lose",
+                                  dup_tokens=len(clone.tokens))
+        return res
 
     # ----------------------------------------------------- LoRA adapters
     def load_adapter(self, name: str, state) -> int:
@@ -542,6 +876,7 @@ class ReplicaRouter:
         (deterministic test/benchmark path). Returns whether any
         replica worked."""
         self._check_replica_fault()
+        self._fire_due_hedges()
         worked = False
         for eng in list(self.engines):
             if eng in self.engines:     # not torn down this iteration
@@ -549,6 +884,7 @@ class ReplicaRouter:
         self._reap_dead()
         for eng in list(self._retiring):
             worked = eng.step() or worked
+        self._resolve_hedges()
         if self._autoscale is not None:
             self._maybe_autoscale()
         self._update_depth_gauges()
@@ -699,6 +1035,13 @@ class ReplicaRouter:
             # the kill mark opens the re-home span on the dead
             # replica's track; the adopting peer's admit closes it
             _tracing.mark(req.id, "kill", t_kill, eng.trace_track)
+            if req.hard_deadline is not None and \
+                    t_kill > req.hard_deadline:
+                # deadline enforcement rides through re-homes: expired
+                # work is canceled here, never adopted (its blocks and
+                # pins were already stripped above)
+                eng._finalize_cancel(req, "queued", "deadline")
+                continue
             placed = False
             for peer in sorted(
                     (p for p in self.engines
@@ -798,6 +1141,8 @@ class ReplicaRouter:
             seen: dict = {}
             for eng in self.engines + self._retiring:
                 for r in eng.results():
+                    if r._hedge_clone:
+                        continue   # router-internal hedge copy
                     seen.setdefault(r.id, r)
             return sorted(seen.values(), key=lambda r: r.id)
         for r in out:
@@ -834,9 +1179,16 @@ class ReplicaRouter:
             rehomed = self._rehomed
             scale_ups = self._scale_ups
             scale_downs = self._scale_downs
+            hedges = {"fired": self._hedge_fired,
+                      "wins": self._hedge_wins,
+                      "loses": self._hedge_loses,
+                      "dup_tokens": self._hedge_dup_tokens,
+                      "tokens": round(self._hedge_tokens, 6),
+                      "pending": len(self._hedges)}
         engines = live + retiring
         depths = [self._depth(e) for e in live]
         shed: dict = {}
+        canceled: dict = {}
         completed = slo_met = 0
         tenants: dict = {}
         for e in engines:
@@ -845,6 +1197,8 @@ class ReplicaRouter:
                 slo_met += e._slo_met
                 for k, v in e._shed_by_reason.items():
                     shed[k] = shed.get(k, 0) + v
+                for k, v in e._canceled_by_reason.items():
+                    canceled[k] = canceled.get(k, 0) + v
                 for name, (c, el, m) in e._tenant_stats.items():
                     t = tenants.setdefault(name, [0, 0, 0])
                     t[0] += c
@@ -866,8 +1220,14 @@ class ReplicaRouter:
             "slo_attainment": self._slo_attainment(),
             "shed": shed,
             "shed_total": sum(shed.values()),
+            "canceled": canceled,
+            "canceled_total": sum(canceled.values()),
             "per_replica": [e.stats() for e in live],
         }
+        if self._hedge_ms != 0.0:
+            out["hedges"] = hedges
+        if self._brk_window_n > 0:
+            out["breaker"] = [e._brk_state for e in live]
         if tenants:
             # fleet-wide per-tenant goodput + SLO attainment, summed
             # across replicas (tenants resolve by name everywhere)

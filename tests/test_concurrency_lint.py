@@ -184,6 +184,65 @@ def test_clean_exception_safe_function_passes(tmp_path):
     assert r.diagnostics == []
 
 
+def test_leaky_cancel_path_is_flagged(tmp_path):
+    """The cancellation-plane regression PR 17 guards against: a
+    cancel branch that tears the request out of its slot but forgets
+    the KV release leaks on exactly that edge."""
+    r = _lint_src(tmp_path, """
+        class Engine:
+            def admit_or_cancel(self, req):
+                row = self.cache.acquire(req.blocks)
+                if row is None:
+                    return None
+                if req.canceled:
+                    req.slot = None
+                    return False      # forgot the release: leak
+                self.cache.release_row(row)
+                return True
+        """)
+    leaks = _by_check(r, "resource-leak")
+    assert len(leaks) == 1 and leaks[0].severity == "error"
+    assert "return" in leaks[0].witness
+
+
+def test_cancel_discharges_obligation_cleanly(tmp_path):
+    """``cancel`` is in the release family: discharging via the cancel
+    teardown on one path and the normal release on the other is
+    exception-safe and lints clean."""
+    r = _lint_src(tmp_path, """
+        class Engine:
+            def admit_or_cancel(self, req):
+                row = self.cache.acquire(req.blocks)
+                if row is None:
+                    return None
+                if req.canceled:
+                    self.cache.cancel(row)
+                    return False
+                self.cache.release_row(row)
+                return True
+        """)
+    assert r.diagnostics == []
+
+
+def test_double_release_on_hedge_lose_is_flagged(tmp_path):
+    """The hedge-race teardown hazard: the losing primary is canceled
+    by the resolver AND released again by the finish path — cancel
+    counts as a discharge, so the second teardown is a double-release
+    error, not silence."""
+    r = _lint_src(tmp_path, """
+        class Router:
+            def resolve_hedge_lose(self, n):
+                row = self.cache.acquire(n)
+                if row is None:
+                    return None
+                self.cache.cancel(row)          # loser torn down...
+                self.cache.release_row(row)     # ...twice
+                return True
+        """)
+    dbl = _by_check(r, "double-release")
+    assert len(dbl) == 1 and dbl[0].severity == "error"
+
+
 def test_handoff_protocol_lints_clean(tmp_path):
     """export moves the obligation into the record; the peer's
     import/adopt re-acquires it; a failed adopt (None) leaves the

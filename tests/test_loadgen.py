@@ -473,3 +473,81 @@ def test_soak_kill_spec_and_windows_units():
     assert [x["offered"] for x in w] == [1, 2]
     assert [x["completed"] for x in w] == [1, 1]
     assert w[1]["goodput_per_s"] == 0.2
+
+
+# ------------------------------------------------- abandonment plane
+
+_AB_KW = dict(mode="poisson", seed=1, closed_loop=4,
+              think_time_ms=(2.0, 8.0), abandon_frac=0.2)
+
+
+def test_abandonment_draws_are_seeded_and_trace_carried():
+    """The abandonment stream is a dedicated RandomState: same seed
+    same abandoners, thresholds always past the first token, and the
+    trace rows carry the fraction in column 10."""
+    import json as _json
+    a = LoadGen(**_AB_KW, **_LG_KW)
+    b = LoadGen(**_AB_KW, **_LG_KW)
+    assert a.trace_bytes() == b.trace_bytes()
+    sched = a.schedule()
+    quitters = [x for x in sched if x.abandon_after > 0]
+    assert len(quitters) >= 1
+    assert all(0.25 <= q.abandon_after <= 0.75 for q in quitters)
+    rows = _json.loads(a.trace_bytes())["arrivals"]
+    assert all(len(r) > 9 for r in rows)
+    assert sorted(r[9] for r in rows if r[9] > 0) == \
+        sorted(q.abandon_after for q in quitters)
+
+
+def test_abandonment_trace_roundtrip_byte_identical():
+    """from_trace on an abandonment-bearing trace re-serializes byte
+    for byte — the replay *is* the recorded workload."""
+    import json as _json
+    lg = LoadGen(**_AB_KW, **_LG_KW)
+    raw = lg.trace_bytes()
+    lg2 = LoadGen.from_trace(_json.loads(raw))
+    assert lg2.trace_bytes() == raw
+    assert any(a.abandon_after > 0 for a in lg2.schedule())
+
+
+def test_abandon_free_seed_trace_unchanged_by_the_feature():
+    """abandon_frac=0 must not perturb the arrival schedule of
+    existing seeds (the draws come from a dedicated stream)."""
+    plain = LoadGen(mode="poisson", seed=42, **_LG_KW)
+    off = LoadGen(mode="poisson", seed=42, closed_loop=3,
+                  abandon_frac=0.0, **_LG_KW)
+    assert [a[:4] for a in plain.schedule()] == \
+        [a[:4] for a in off.schedule()]
+
+
+def test_closed_loop_abandonment_cancels_and_replays(model):
+    """Closed-loop clients that abandon mid-decode land as cancels
+    (reason="disconnect") with full reclaim — zero leaked KV blocks —
+    the accounting identity extends with the canceled term, and a
+    from_trace replay reproduces the same cancels decision for
+    decision."""
+    import json as _json
+
+    def run(lg):
+        vc = VirtualClock()
+        return lg.run(_engine(model, vc.now, max_queue=8), clock=vc,
+                      step_cost_ms=4.0)
+
+    lg1 = LoadGen(**_AB_KW, **_LG_KW)
+    r1 = run(lg1)
+    assert r1["abandoned"] >= 1
+    assert r1["canceled"] == {"disconnect": r1["abandoned"]}
+    assert r1["canceled_total"] == r1["abandoned"]
+    assert r1["leaked_kv_blocks"] == 0 and r1["exceptions"] == 0
+    done = sum(1 for d, _ in r1["decisions"] if d == "done")
+    shed = sum(1 for d, _ in r1["decisions"] if d == "shed")
+    assert done + shed + r1["canceled_total"] == r1["offered"]
+
+    lg2 = LoadGen.from_trace(_json.loads(lg1.trace_bytes()))
+    lg2.closed_loop = lg1.closed_loop
+    lg2.think_time_ms = lg1.think_time_ms
+    r2 = run(lg2)
+    assert r2["decisions"] == r1["decisions"]
+    assert r2["canceled"] == r1["canceled"]
+    assert r2["abandoned"] == r1["abandoned"]
+    assert r2["leaked_kv_blocks"] == 0

@@ -688,3 +688,80 @@ def test_paged_health_and_stats_surface(model):
         c.close()
     finally:
         srv.stop()
+
+
+# -- cancellation over HTTP ----------------------------------------------
+
+def test_http_delete_cancels_and_status_combos(model):
+    """DELETE /v1/requests/<id> is the cancel front door: 200 with the
+    reclaimed stage for an in-flight request, 400 for a non-integer
+    id, 404 for unknown ids, finished requests and foreign paths —
+    cancel-after-done is a no-op, never a double release."""
+    prompt = _prompts((4,), seed=9)[0]
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=8, block_size=4)
+    srv = ServingHTTPServer(eng, port=0)
+    srv.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+        # an in-flight victim: submitted straight to the engine so the
+        # HTTP DELETE races a real scheduler thread
+        victim = eng.submit(prompt, max_new_tokens=24)
+        c.request("DELETE", f"/v1/requests/{victim.id}")
+        r = c.getresponse()
+        assert r.status == 200
+        out = json.loads(r.read())
+        assert out["id"] == victim.id and out["reason"] == "client"
+        assert out["stage"] in ("queued", "prefill", "decode")
+        assert victim.wait(30)
+        assert victim.state == "canceled"
+        assert victim.shed_reason == "client"
+        # double-cancel over HTTP: the request is already terminal
+        c.request("DELETE", f"/v1/requests/{victim.id}")
+        assert c.getresponse().status == 404
+        c.request("DELETE", "/v1/requests/abc")
+        assert c.getresponse().status == 400
+        c.request("DELETE", "/v1/requests/999999")
+        assert c.getresponse().status == 404
+        c.request("DELETE", "/v1/other/1")
+        assert c.getresponse().status == 404
+        # a completed request: DELETE afterwards is 404, not a release
+        body = json.dumps({"ids": prompt, "max_new_tokens": 2})
+        c.request("POST", "/v1/generate", body=body)
+        done = json.loads(c.getresponse().read())
+        assert done["state"] == "done"
+        c.request("DELETE", f"/v1/requests/{done['id']}")
+        assert c.getresponse().status == 404
+        c.close()
+    finally:
+        srv.stop()
+    assert eng.stats()["canceled"] == {"client": 1}
+    eng.cache.flush_prefix_cache()
+    assert eng.cache.allocator.leaked() == 1     # trash block only
+
+
+def test_http_broken_pipe_cancels_inflight_request(model):
+    """A client that hangs up before its result lands must not leak
+    the request: the response writer turns BrokenPipeError into
+    cancel(reason="disconnect"), reclaiming queue slot / KV row."""
+    import types
+
+    from paddle_tpu.serving.http import _ServingHandler
+
+    eng = ServingEngine(model, max_slots=1, max_len=32, buckets=[8],
+                        max_queue=8, block_size=4)
+    req = eng.submit(_prompts((4,), seed=10)[0], max_new_tokens=4)
+
+    h = _ServingHandler.__new__(_ServingHandler)
+    h.server = types.SimpleNamespace(engine=eng)
+    h._json = lambda code, payload, headers=None: (
+        (_ for _ in ()).throw(BrokenPipeError()))
+    _ServingHandler._json_or_cancel(h, 200, {"id": req.id}, req.id)
+    assert req.state == "canceled" and req.shed_reason == "disconnect"
+    assert eng.stats()["canceled"] == {"disconnect": 1}
+    # finished request: the hang-up cancel is a no-op, not a release
+    h2 = _ServingHandler.__new__(_ServingHandler)
+    h2.server = types.SimpleNamespace(engine=eng)
+    h2._json = h._json
+    _ServingHandler._json_or_cancel(h2, 200, {}, req.id)
+    assert eng.stats()["canceled"] == {"disconnect": 1}

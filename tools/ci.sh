@@ -52,7 +52,11 @@
 #      still > 0, handoffs actually happened, still zero leaks —
 #      closing with the tracing-overhead budget: a fully-traced run
 #      must hold goodput within 5% of an untraced one on the same
-#      seed)
+#      seed — and the hedging-under-chaos crossover: closed-loop
+#      traffic with a deterministic straggler replica, a mid-run
+#      chaos kill and 10% client abandonment (disconnect -> cancel
+#      with full reclaim), where the hedged arm must beat the
+#      unhedged arm's goodput at zero leaks / zero new compiles)
 #  11. chaos soak gate (hours of seeded diurnal traffic on the virtual
 #      clock with replica kills injected at virtual instants and
 #      auto-restart healing the fleet: goodput > 0 in every window,
@@ -296,6 +300,52 @@ print(f"   tracing overhead: traced {gt}/s vs untraced {gu}/s "
       f"({drop:+.1%} of the 5% budget)")
 PY
 rm -f "$TRACED_JSON" "$UNTRACED_JSON"
+echo "   hedging under chaos (straggler + kill + 10% abandonment)"
+# the request-lifecycle robustness crossover: seeded closed-loop
+# traffic against a 2-replica fleet where replica 0 is a deterministic
+# straggler (slow-but-alive, below the strikes watchdog), a chaos kill
+# removes it mid-run, and 10% of clients disconnect mid-decode
+# (--abandon-frac -> cancel with full reclaim). The hedged arm
+# (--hedge-ms) must fire at least one hedge and beat the unhedged
+# arm's goodput under the identical fault schedule; both arms must
+# account every request (completed + canceled == admitted offered),
+# leak zero KV blocks, and compile nothing new after warmup. The
+# seed/rate pair is load-bearing: seed 3 at rate 20 x 2s is a schedule
+# whose abandonment stream actually selects clients.
+HEDGED_JSON=$(mktemp); UNHEDGED_JSON=$(mktemp)
+HEDGE_ARGS=(--model gpt2-tiny --mode poisson --rate 20 --duration 2
+  --seed 3 --slots 4 --max-len 64 --buckets 16,32 --prompt-tokens 4:16
+  --new-tokens 2:8 --replicas 2 --depth-only --slo-ttft-ms 400
+  --closed-loop 4 --think-time-ms 0:20 --abandon-frac 0.1
+  --straggler 0:600 --chaos 2.5:kill:0 --json
+  --expect-zero-leaks --expect-zero-new-compiles)
+JAX_PLATFORMS=cpu python tools/loadgen.py "${HEDGE_ARGS[@]}" \
+  > "$UNHEDGED_JSON"
+JAX_PLATFORMS=cpu python tools/loadgen.py "${HEDGE_ARGS[@]}" \
+  --hedge-ms 100 --hedge-budget 0.3 > "$HEDGED_JSON"
+JAX_PLATFORMS=cpu python - "$HEDGED_JSON" "$UNHEDGED_JSON" <<'PY'
+import json, sys
+h = json.load(open(sys.argv[1]))
+u = json.load(open(sys.argv[2]))
+for arm in (h, u):
+    assert arm["exceptions"] == 0, arm
+    assert arm["chaos_applied"] == 1, arm
+    assert arm["abandoned"] >= 1, arm
+    assert arm["canceled"].get("disconnect", 0) == arm["abandoned"], arm
+    assert arm["leaked_kv_blocks"] == 0, arm
+    assert arm["new_compiles_after_warmup"] == 0, arm
+# identical seed -> identical abandonment in both arms
+assert h["abandoned"] == u["abandoned"], (h["abandoned"], u["abandoned"])
+hs = h["hedges"]
+assert hs["fired"] >= 1, hs
+assert hs["pending"] == 0, hs
+gh, gu = h["goodput_per_s"], u["goodput_per_s"]
+assert gh > gu, f"hedged goodput {gh}/s not above unhedged {gu}/s"
+print(f"   hedging: goodput {gh}/s vs {gu}/s unhedged, "
+      f"{hs['fired']} fired / {hs['wins']} won, "
+      f"{h['abandoned']} abandoned -> canceled, 0 leaks, 0 new compiles")
+PY
+rm -f "$HEDGED_JSON" "$UNHEDGED_JSON"
 
 echo "== 11/16 chaos soak gate (virtual-clock fleet fault tolerance)"
 # hours of seeded diurnal traffic compressed into seconds on the
@@ -305,7 +355,10 @@ echo "== 11/16 chaos soak gate (virtual-clock fleet fault tolerance)"
 # healing the fleet: goodput > 0 in every traffic window that offered
 # load, completed + rehomed + shed == offered, zero leaked KV blocks,
 # zero unhandled exceptions, zero new compiles after warmup — and the
-# recompile predictor proving kill/restart/re-home add none
+# recompile predictor proving kill/restart/re-home add none; the
+# extended accounting identity (completed + rehomed + shed + canceled
+# == offered) and the hedge-budget envelope are re-asserted on a
+# closed-loop arm with client abandonment below
 if [[ "${1:-}" != "quick" ]]; then SOAK_HOURS=2; else SOAK_HOURS=1; fi
 JAX_PLATFORMS=cpu python tools/soak.py --model gpt2-tiny \
   --hours "$SOAK_HOURS" --rate 0.02 --kills 2 --replicas 2 --seed 0 \
@@ -322,6 +375,30 @@ print(f\"   soak: {r['simulated_hours']}h simulated, \"
       f\"{rep['kills']} kills/{rep['restarts']} restarts, \"
       f\"{rep['rehomed']} re-homed, goodput {rep['goodput_per_s']}/s, \"
       f\"0 leaks, 0 new compiles\")
+"
+# closed-loop soak with 15% client abandonment and hedging armed:
+# every disconnect must land as a cancel with full reclaim, the
+# extended accounting identity must hold (completed + rehomed + shed
+# + canceled == offered — --expect-identity covers the canceled
+# term), and hedge volume must stay inside the token-bucket envelope
+# (--expect-hedge-budget-respected: fired <= 1 + budget * offered)
+JAX_PLATFORMS=cpu python tools/soak.py --model gpt2-tiny \
+  --hours 0.5 --rate 0.02 --kills 0 --replicas 2 --seed 3 \
+  --windows 4 --closed-loop 4 --abandon-frac 0.15 \
+  --hedge-ms 50 --hedge-budget 0.3 --json \
+  --expect-zero-leaks --expect-zero-new-compiles \
+  --expect-identity --expect-hedge-budget-respected \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+rep = r['report']
+assert r['identity_ok'] and r['predictor_noop'], r
+assert r['hedge_budget_ok'], r
+assert rep['abandoned'] >= 1, rep
+assert rep['canceled'].get('disconnect', 0) == rep['abandoned'], rep
+print(f\"   abandonment soak: {rep['abandoned']} disconnects -> \"
+      f\"cancels, identity holds with canceled term, \"
+      f\"{rep['hedges']['fired']} hedges inside budget, 0 leaks\")
 "
 # the same seeded soak under the runtime concurrency sanitizer
 # (FLAGS_sanitize_locks=1): every make_lock() lock instrumented, the

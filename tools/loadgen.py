@@ -63,6 +63,20 @@ Two release disciplines:
   RandomState, so open-loop seeds keep producing byte-identical
   schedules.
 
+Abandonment (``--abandon-frac F``, closed loop only): a seeded
+fraction of clients hang up mid-decode — each fires a fleet
+``cancel(reason="disconnect")`` once 25-75% of its token budget has
+landed. The draws come from a dedicated RandomState (abandon-free
+seeds keep their byte-identical traces) and ride the trace rows as
+column 10, so an abandonment workload replays byte for byte; the
+report counts ``canceled`` per reason and ``abandoned`` clients, and
+``leaked_kv_blocks`` must stay 0 regardless of where the cancels
+landed. With a hedging router (``--hedge-ms``, ``--hedge-budget``)
+the report grows a ``hedges`` section — fired/wins/loses, hedge rate
+vs offered load, win rate, and the duplicated-token cost of racing
+(``--straggler I:MS`` makes replica I a deterministic straggler for
+the hedge to beat).
+
 Chaos replay: a trace may carry a ``chaos`` schedule (rows of
 ``[t, kind, index]``, kind in kill | restart | kill_decode —
 ``tools/trace_convert.py`` extracts them from a live run's
@@ -114,6 +128,10 @@ class Arrival(NamedTuple):
     top_p: float = 1.0
     seed: int = 0
     tenant: str = ""       # "" = base weights (no LoRA adapter)
+    # client patience: > 0 means the closed-loop client hangs up
+    # (fleet cancel) once this fraction of the new-token budget has
+    # been produced — the abandonment workload; 0 = patient client
+    abandon_after: float = 0.0
 
 
 class VirtualClock:
@@ -160,7 +178,8 @@ class LoadGen:
                  sample_frac: float = 0.0,
                  tenant_mix: Optional[dict] = None,
                  closed_loop: int = 0,
-                 think_time_ms: Tuple[float, float] = (0.0, 0.0)):
+                 think_time_ms: Tuple[float, float] = (0.0, 0.0),
+                 abandon_frac: float = 0.0):
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
                              f"got {mode!r}")
@@ -221,6 +240,13 @@ class LoadGen:
             raise ValueError("think_time_ms must satisfy 0 <= lo <= hi")
         self.closed_loop = int(closed_loop)
         self.think_time_ms = (lo, hi)
+        # Abandonment draws come from their own RandomState (like the
+        # think times), so abandon-free seeds keep producing their old
+        # traces byte for byte.
+        if not (0.0 <= float(abandon_frac) <= 1.0):
+            raise ValueError("abandon_frac must be in [0, 1]")
+        self.abandon_frac = float(abandon_frac)
+        self._abandon = self.abandon_frac > 0
         #: chaos schedule replayed alongside the arrivals: dicts of
         #: {"t", "kind", "index"}; populated by from_trace or by hand
         self.chaos: List[dict] = []
@@ -250,6 +276,8 @@ class LoadGen:
             if len(row) > 4:   # decode-bearing rows: 5 more fields
                 extra = (float(row[4]), int(row[5]), float(row[6]),
                          int(row[7]), str(row[8]))
+            if len(row) > 9:   # abandonment-bearing rows: col 10
+                extra = extra + (float(row[9]),)
             arrivals.append(Arrival(float(t),
                                     tuple(int(x) for x in prompt),
                                     int(mnt), int(pri), *extra))
@@ -268,6 +296,10 @@ class LoadGen:
         lg._schedule = arrivals
         # decode-bearing traces re-serialize with their decode fields
         lg._decoded = any(len(r) > 4 for r in trace["arrivals"])
+        # abandonment-bearing traces re-serialize byte-identically too
+        lg._abandon = any(len(r) > 9 for r in trace["arrivals"])
+        if lg._abandon:
+            lg.abandon_frac = 1.0   # marker; the schedule rows govern
         # chaos rows ([t, kind, index]) replay kill/restart schedules
         lg.chaos = [{"t": float(r[0]), "kind": str(r[1]),
                      "index": int(r[2])}
@@ -304,6 +336,8 @@ class LoadGen:
         if self._schedule is not None:
             return self._schedule
         rng = np.random.RandomState(self.seed)
+        ab_rng = np.random.RandomState(
+            (self.seed * 2654435761 + 131) % (2 ** 32))
         if self.mode == "poisson":
             peak = self.rate
             segs = None
@@ -357,9 +391,20 @@ class LoadGen:
                     ten = self._tenant_vals[int(rng.choice(
                         len(self._tenant_vals), p=self._tenant_probs))]
                 extra = (temp, tk, tp, sd, ten)
+            ab = 0.0
+            if self._abandon:
+                # fixed draw count per candidate (kept or thinned):
+                # u1 decides whether this client abandons, u2 picks how
+                # far into the token budget it hangs up (25%..75%) —
+                # always past the first token, so abandonment lands
+                # mid-decode, never pre-admission
+                u1 = float(ab_rng.uniform())
+                u2 = float(ab_rng.uniform())
+                if u1 < self.abandon_frac:
+                    ab = round(0.25 + 0.5 * u2, 6)
             if keep:
                 out.append(Arrival(round(t, 9), prompt, mnt, pri,
-                                   *extra))
+                                   *extra, abandon_after=ab))
         self._schedule = out
         return out
 
@@ -369,9 +414,13 @@ class LoadGen:
         rows = []
         for a in self.schedule():
             row = [a.t, list(a.prompt), a.max_new_tokens, a.priority]
-            if self._decoded:   # decode-bearing rows carry 5 more
+            if self._decoded or self._abandon:
+                # decode-bearing rows carry 5 more; abandonment rows
+                # pad them (greedy defaults) so col 10 stays col 10
                 row += [a.temperature, a.top_k, a.top_p, a.seed,
                         a.tenant]
+            if self._abandon:   # abandonment-bearing rows add col 10
+                row.append(a.abandon_after)
             rows.append(row)
         payload = {
             "mode": self.mode, "rate": self.rate,
@@ -418,7 +467,9 @@ class LoadGen:
                     "max_new_tokens": a.max_new_tokens,
                     "priority": a.priority,
                     "sampled": a.temperature > 0,
-                    "tenant": a.tenant, "outcome": None,
+                    "tenant": a.tenant,
+                    "abandon_after": a.abandon_after,
+                    "abandoned": False, "outcome": None,
                     "reason": None, "req": None}
                    for i, a in enumerate(arrivals)]
         from paddle_tpu.serving import QueueFullError
@@ -488,7 +539,21 @@ class LoadGen:
                     if rec is not None:
                         req = rec["req"]
                         if req is not None and \
-                                req.state not in ("done", "shed"):
+                                req.state not in ("done", "shed",
+                                                  "canceled"):
+                            # impatient client: once enough of the
+                            # token budget has landed, hang up — a
+                            # fleet-wide cancel that must reclaim every
+                            # block (the abandonment workload)
+                            if rec["abandon_after"] > 0 and \
+                                    not rec["abandoned"] and \
+                                    req.first_token_at is not None and \
+                                    len(req.tokens) >= max(1, math.ceil(
+                                        rec["abandon_after"] *
+                                        rec["max_new_tokens"])):
+                                rec["abandoned"] = True
+                                target.cancel(req.id,
+                                              reason="disconnect")
                             continue
                         done_at = now
                         if req is not None and \
@@ -585,6 +650,8 @@ class LoadGen:
                 exceptions, include_trace, t0: float = 0.0,
                 chaos_applied: int = 0) -> dict:
         shed: dict = {}
+        canceled: dict = {}
+        abandoned = 0
         decisions: List[List] = []
         ttfts, tpots = [], []
         completed = rehomed_done = slo_met = slo_known = 0
@@ -636,6 +703,10 @@ class LoadGen:
             if rec["outcome"] in ("shed", "rejected"):
                 key = rec["reason"] or "unknown"
                 shed[key] = shed.get(key, 0) + 1
+            elif rec["outcome"] == "canceled":
+                key = rec["reason"] or "unknown"
+                canceled[key] = canceled.get(key, 0) + 1
+            abandoned += int(rec["abandoned"])
             decisions.append([rec["outcome"], rec.get("reason")])
 
         leaked = 0
@@ -664,9 +735,12 @@ class LoadGen:
             "makespan_s": round(makespan, 6),
             "steps": steps,
             "admitted": sum(1 for d in decisions
-                            if d[0] in ("done", "shed")),
+                            if d[0] in ("done", "shed", "canceled")),
             "completed": completed,
             "rehomed": rehomed_done,
+            "canceled": canceled,
+            "canceled_total": sum(canceled.values()),
+            "abandoned": abandoned,
             "closed_loop": self.closed_loop,
             "chaos_applied": chaos_applied,
             "shed": shed,
@@ -709,6 +783,16 @@ class LoadGen:
             report["leaked_lora_pages"] = leaked_pages
         stats = getattr(target, "stats", None)
         st = stats() if callable(stats) else {}
+        if "hedges" in st:
+            # hedged-prefill section: volume (rate vs offered, budget
+            # tokens left), outcome split, and the duplicated-token
+            # cost of racing — the ISSUE-locked report surface
+            h = dict(st["hedges"])
+            fired = int(h.get("fired", 0))
+            h["hedge_rate"] = round(fired / max(1, len(records)), 4)
+            h["win_rate"] = (round(int(h.get("wins", 0)) / fired, 4)
+                             if fired else None)
+            report["hedges"] = h
         if "prefill_workers" in st:
             report["disagg"] = {k: st[k] for k in (
                 "prefill_workers", "decode_workers", "colocated",
@@ -809,6 +893,12 @@ def main(argv=None) -> int:
                     default=(0.0, 0.0), metavar="A:B",
                     help="closed-loop per-client think time, uniform "
                     "on [A, B] ms from a dedicated seeded stream")
+    ap.add_argument("--abandon-frac", type=float, default=0.0,
+                    metavar="F", help="fraction of closed-loop clients "
+                    "that hang up mid-decode (seeded draws from a "
+                    "dedicated stream; each fires a fleet cancel once "
+                    "25-75%% of its token budget has landed); "
+                    "requires --closed-loop")
     ap.add_argument("--priority-mix", type=_parse_mix, default=None,
                     metavar="P:W,P:W", help="priority class weights, "
                     "e.g. '0:0.1,1:0.8,2:0.1' (lower = more urgent)")
@@ -839,6 +929,23 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--autoscale", default="", metavar="MIN:MAX",
                     help="enable router autoscaling inside the bounds")
+    ap.add_argument("--hedge-ms", type=float, default=0.0,
+                    metavar="MS", help="router hedged prefill: when "
+                    "a request's predicted TTFT exceeds MS, race a "
+                    "clone on the second-best replica after that "
+                    "delay (> 0 fixed threshold, -1 auto from the "
+                    "traced TTFT p95, 0 off); adds a 'hedges' report "
+                    "section")
+    ap.add_argument("--hedge-budget", type=float, default=None,
+                    metavar="FRAC", help="hedge token bucket refill "
+                    "per offered request (fired hedges <= 1 + "
+                    "FRAC * offered; default "
+                    "FLAGS_serving_hedge_budget)")
+    ap.add_argument("--straggler", default="", metavar="I:MS",
+                    help="after warmup, pin replica I's predicted "
+                    "prefill cost to MS ms and slow its steps to "
+                    "match — the deterministic straggler the hedge "
+                    "races against (wall-clock multi-replica runs)")
     ap.add_argument("--disagg", default="", metavar="PxD",
                     help="run a disaggregated fleet of P prefill-only "
                     "+ D decode-only workers behind a DisaggRouter "
@@ -846,6 +953,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-prefix-affinity", action="store_true",
                     help="with --disagg: route least-loaded instead of "
                     "to the worker holding the longest cached prefix")
+    ap.add_argument("--chaos", default="", metavar="T:KIND:I,...",
+                    help="inline chaos schedule fired on the run "
+                    "clock: comma-separated T:KIND:INDEX events, KIND "
+                    "in kill|restart|kill_decode|kill_prefill (e.g. "
+                    "'2.0:kill:0' kills replica 0 two seconds in)")
     ap.add_argument("--replay", default="", metavar="TRACE.json",
                     help="replay a recorded arrival trace (from "
                     "tools/trace_convert.py or a prior --trace file) "
@@ -901,6 +1013,11 @@ def main(argv=None) -> int:
     pt.seed(0)
     model = GPTForCausalLM(cfg)
     model.eval()
+    if args.abandon_frac and not args.closed_loop:
+        print("FAIL: --abandon-frac needs --closed-loop clients "
+              "(abandonment is a client hang-up mid-decode)",
+              file=sys.stderr)
+        return 1
     if args.replay:
         lg = LoadGen.from_trace(args.replay)
         if args.closed_loop:
@@ -916,7 +1033,14 @@ def main(argv=None) -> int:
                      sample_frac=args.sample_frac,
                      tenant_mix=args.tenant_mix,
                      closed_loop=args.closed_loop,
-                     think_time_ms=args.think_time_ms)
+                     think_time_ms=args.think_time_ms,
+                     abandon_frac=args.abandon_frac)
+    if args.chaos:
+        for part in args.chaos.split(","):
+            t_s, kind, idx = part.split(":")
+            lg.chaos.append({"t": float(t_s), "kind": str(kind),
+                             "index": int(idx)})
+        lg.chaos.sort(key=lambda e: e["t"])
     lora_tenants = sorted(t for t in (args.tenant_mix or {})
                           if t not in ("", "base"))
     if lora_tenants and args.lora_rank <= 0:
@@ -953,11 +1077,14 @@ def main(argv=None) -> int:
                 "serving_prefix_affinity":
                     not args.no_prefix_affinity})
             target = DisaggRouter(model=model, **eng_kwargs)
-        elif args.replicas > 1 or bounds is not None:
+        elif args.replicas > 1 or bounds is not None or \
+                args.hedge_ms != 0.0:
             target = ReplicaRouter(
                 model=model, n_replicas=args.replicas,
                 autoscale=(None if bounds is None else AutoscalePolicy(
                     min_replicas=bounds[0], max_replicas=bounds[1])),
+                hedge_ms=args.hedge_ms,
+                hedge_budget=args.hedge_budget,
                 **eng_kwargs)
         else:
             target = ServingEngine(model, **eng_kwargs)
@@ -970,6 +1097,35 @@ def main(argv=None) -> int:
                     name, make_adapter(cfg, args.lora_rank, seed=i + 1))
         if not args.no_warmup:
             warmup(target)
+        if args.straggler:
+            # deterministic straggler: pin one replica's predicted
+            # prefill cost high (so the hedge gate sees it coming) and
+            # stretch each real step to MS of wall time spread over
+            # three router passes (two idle passes of MS/3, then the
+            # real step). Spreading matters twice over: hedge-fire
+            # checks run between router passes, so a sleep-then-step
+            # wrapper would finish the prefill inside the very pass
+            # that slept and beat every hedge — and the strikes
+            # watchdog kills a replica after three consecutive
+            # unproductive passes while it holds work, so the wrapper
+            # must produce every third call to stay the slow-but-
+            # *alive* tail replica hedging exists for, not a dead one.
+            # Applied after warmup: pins survive reset_cost_estimates
+            # and the wrapper compiles nothing.
+            si_s, sms_s = args.straggler.split(":")
+            si, sms = int(si_s), float(sms_s)
+            slow_eng = target.engines[si]
+            slow_eng._prefill_ms_pin = sms
+            _orig_step = slow_eng.step
+            _stall = {"n": 0}
+
+            def _slow_step(_o=_orig_step, _ms=sms):
+                time.sleep(_ms / 3e3)
+                _stall["n"] += 1
+                if _stall["n"] % 3:
+                    return False
+                return _o()
+            slow_eng.step = _slow_step
         from paddle_tpu import observability as _obs
         _SERVING = ("serving_", "decode_", "verify_")
         base_compiles = {k: v["count"] for k, v in _obs.compiles().items()

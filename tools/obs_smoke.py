@@ -61,6 +61,12 @@ Asserts, end to end through the observability plane:
     plain run, records zero lock-order cycles / guarded-state
     violations over nonzero instrumented acquires, and matches the
     predictor's ``sanitize`` no-op claim (predicted == observed);
+  - a cancel/hedge episode on a hedging 2-replica router (one hedge
+    race fired and won against a deterministic straggler; cancels at
+    the queued and mid-decode stages plus the race's loser) leaks
+    nothing, logs serving_cancel / serving_hedge events, mints the
+    canceled/hedge/retry-budget metrics, and matches the predictor's
+    ``cancel``/``hedge`` no-op claims (predicted == observed);
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
     int8-quantization, SLO-admission and tracing metrics;
@@ -682,6 +688,90 @@ def main() -> int:
           f"{reportS['goodput_per_s']}/s ~ plain "
           f"{report['goodput_per_s']}/s, {deltaS} == predicted")
 
+    # -- cancel/hedge phase: request lifecycle is host-side -----------
+    # Cancellation is pure queue/slot surgery and a hedge clone lands
+    # in the primary's already-warm prefill bucket, so a fresh phase
+    # (the sanitize finally bumped the flags version) that cancels at
+    # the queued AND decode stages and races one real hedge must
+    # retrace exactly what the plain workload would: the predictor
+    # says ``cancel=``/``hedge=`` are no-ops and the live tracker must
+    # agree. The race's loser is canceled leak-free, the shared
+    # RetryBudget gauge goes live for the /metrics scrape below, and
+    # the run log grows serving_cancel / serving_hedge events.
+    import time as _time
+
+    from paddle_tpu.serving import ReplicaRouter
+    baseC = {site: c["count"]
+             for site, c in observability.compiles().items()
+             if site.startswith(("serving_", "decode_", "verify_"))}
+    rtC = ReplicaRouter(model, n_replicas=2, max_slots=3, max_len=32,
+                        buckets=[8, 16], max_queue=16, block_size=4,
+                        hedge_ms=5.0)
+    # deterministic straggler: replica 0 predicts slow (pinned prefill
+    # cost) and IS slow (its first steps do nothing), so the hedge
+    # fires after the 5 ms delay and the clone on replica 1 wins
+    slowC = rtC.engines[0]
+    slowC._prefill_ms_pin = 500.0
+    _orig_stepC = slowC.step
+    _skipC = {"n": 0}
+
+    def _lazy_stepC():
+        _skipC["n"] += 1
+        if _skipC["n"] <= 8:
+            return False
+        return _orig_stepC()
+    slowC.step = _lazy_stepC
+    rh = rtC.submit([1, 2, 3, 4], max_new_tokens=4)
+    _time.sleep(0.01)        # let the hedge delay lapse
+    for _ in range(400):
+        rtC.step()
+        if rh.done:
+            break
+    assert rh.state == "done", (rh.state, rh.error)
+    slowC.step = _orig_stepC
+    slowC._prefill_ms_pin = 0.0
+    hstC = rtC.stats()["hedges"]
+    assert hstC["fired"] == 1 and hstC["wins"] == 1, hstC
+    r_q = rtC.submit([5, 6, 7, 8], max_new_tokens=4)
+    outq = rtC.cancel(r_q.id)
+    assert outq is not None and outq["stage"] == "queued", outq
+    assert rtC.cancel(r_q.id) is None   # double-cancel: no-op
+    r_d = rtC.submit([2, 3, 4, 5], max_new_tokens=8)
+    for _ in range(400):
+        rtC.step()
+        if r_d.first_token_at is not None:
+            break
+    outd = rtC.cancel(r_d.id, reason="client")
+    assert outd is not None and outd["stage"] == "decode", outd
+    rtC.run_until_idle()
+    for e in rtC.engines:
+        e.cache.flush_prefix_cache()
+        assert e.cache.allocator.leaked() == 1, (  # trash block only
+            e.cache.allocator.leaked())
+    cstC = rtC.stats()["canceled"]
+    assert cstC.get("hedge_lose") == 1 and cstC.get("client") == 2, cstC
+    afterC = {site: c["count"]
+              for site, c in observability.compiles().items()
+              if site.startswith(("serving_", "decode_", "verify_"))}
+    deltaC = {site: n - baseC.get(site, 0)
+              for site, n in afterC.items() if n - baseC.get(site, 0)}
+    burstC = [[([1, 2, 3, 4], 4), ([2, 3, 4, 5], 8)]]
+    predC = predict_serving_compiles(
+        burstC, buckets=[8, 16], max_len=32, block_size=4,
+        n_replicas=2, cancel=3, hedge=1)
+    assert predC == predict_serving_compiles(
+        burstC, buckets=[8, 16], max_len=32, block_size=4,
+        n_replicas=2), "cancel/hedge must be predictor no-ops"
+    assert deltaC == predC, (
+        f"cancel/hedge-phase recompile prediction drifted:\n"
+        f"  predicted {predC}\n  observed  {deltaC}")
+    from paddle_tpu.resilience.retry import default_budget
+    assert default_budget().remaining() > 0
+    print(f"   cancel/hedge: hedge fired+won, canceled {cstC} "
+          f"(queued + mid-decode + hedge loser), 0 leaked blocks, "
+          f"retry budget {default_budget().remaining():.1f} tokens, "
+          f"{deltaC} == predicted")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -712,7 +802,10 @@ def main() -> int:
                    "serving_rehomed_total",
                    "STAT_serving_rehomed",
                    "serving_traced_total",
-                   "sanitizer_lock_acquires"):
+                   "sanitizer_lock_acquires",
+                   "serving_canceled_total",
+                   "serving_hedges_total",
+                   "serving_retry_budget_remaining"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 
@@ -727,7 +820,8 @@ def main() -> int:
               "serving_admit", "serving_finish", "serving_weight_swap",
               "serving_request", "serving_handoff",
               "serving_lora_load", "serving_replica_kill",
-              "serving_replica_recover"):
+              "serving_replica_recover", "serving_cancel",
+              "serving_hedge"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])

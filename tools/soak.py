@@ -25,16 +25,21 @@ degradation contract**:
 
 - goodput stays > 0 in every traffic window that offered load
   (``--windows`` equal slices of the run);
-- the accounting identity ``completed + rehomed + shed == offered``
-  holds (every request's fate is recorded, nothing vanishes in a
-  crash);
+- the accounting identity ``completed + rehomed + shed + canceled ==
+  offered`` holds (every request's fate is recorded, nothing vanishes
+  in a crash or a client hang-up);
 - zero leaked KV blocks and zero leaked LoRA pages after the fleet
   drains (dead replicas included);
 - zero unhandled exceptions;
 - zero new XLA compiles after warmup — and
   ``analysis.recompile.predict_serving_compiles`` proves statically
-  that the kill/restart/re-home counts are no-ops (predicting with
-  them == predicting without);
+  that the kill/restart/re-home/cancel/hedge counts are no-ops
+  (predicting with them == predicting without);
+- with hedged prefill on (``--hedge-ms``), fired hedges stay inside
+  the token-bucket envelope — ``--expect-hedge-budget-respected``
+  gates ``fired <= 1 + budget * offered``; with abandonment on
+  (``--closed-loop N --abandon-frac F``) the canceled bucket joins
+  the identity and the fleet still drains leak-free;
 - under ``FLAGS_sanitize_locks=1`` (+ ``--expect-sanitizer-clean``),
   zero lock-order cycles and zero guarded-state violations from the
   concurrency sanitizer across every kill/re-home/scrape — the soak
@@ -86,6 +91,12 @@ def kill_spec(duration: float, kills: int,
     return ";".join(f"{site}:error@t>{t}s" for t in ts)
 
 
+def _hedge_budget_flag() -> float:
+    from paddle_tpu import flags as _fl
+    return float(_fl.get_flags(["serving_hedge_budget"])
+                 ["serving_hedge_budget"])
+
+
 def _windows(report: dict, n: int) -> List[dict]:
     """Per-window offered/completed/goodput over [0, makespan]: the
     continuous form of the degradation contract. Completions land in
@@ -130,7 +141,8 @@ def run_arm(model, lg, args, *,
         buckets=[int(b) for b in args.buckets.split(",")],
         clock=vc.now, slo_ttft_ms=args.slo_ttft_ms,
         slo_prefill_ms=args.slo_prefill_ms,
-        slo_tpot_ms=args.slo_tpot_ms)
+        slo_tpot_ms=args.slo_tpot_ms,
+        hedge_ms=args.hedge_ms, hedge_budget=args.hedge_budget)
     # (virtual time, live replicas) samples -> provisioned-cost
     # integral; gap jumps charge the count at the previous sample
     samples: List[Tuple[float, int]] = []
@@ -197,6 +209,19 @@ def main(argv=None) -> int:
     ap.add_argument("--prompt-tokens", default="4:16", metavar="LO:HI")
     ap.add_argument("--new-tokens", default="2:8", metavar="LO:HI")
     ap.add_argument("--sample-frac", type=float, default=0.0)
+    ap.add_argument("--closed-loop", type=int, default=0,
+                    help="> 0 runs N closed-loop clients instead of "
+                    "open-loop release (needed for --abandon-frac)")
+    ap.add_argument("--abandon-frac", type=float, default=0.0,
+                    help="fraction of closed-loop clients that hang "
+                    "up mid-decode (fleet cancels; the canceled "
+                    "bucket joins the accounting identity)")
+    ap.add_argument("--hedge-ms", type=float, default=0.0,
+                    help="router hedged prefill threshold/delay in "
+                    "virtual ms (> 0 fixed, -1 auto TTFT p95, 0 off)")
+    ap.add_argument("--hedge-budget", type=float, default=None,
+                    help="hedge token-bucket refill per offered "
+                    "request (default FLAGS_serving_hedge_budget)")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--max-len", type=int, default=64)
     ap.add_argument("--max-queue", type=int, default=32)
@@ -231,8 +256,13 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-zero-leaks", action="store_true")
     ap.add_argument("--expect-zero-new-compiles", action="store_true")
     ap.add_argument("--expect-identity", action="store_true",
-                    help="exit 1 unless completed + rehomed + shed "
-                    "(+ rejects/errors) == offered")
+                    help="exit 1 unless completed + rehomed + shed + "
+                    "canceled (+ rejects/errors) == offered")
+    ap.add_argument("--expect-hedge-budget-respected",
+                    action="store_true",
+                    help="exit 1 unless fired hedges <= 1 + "
+                    "hedge_budget * offered (the token-bucket "
+                    "envelope; requires --hedge-ms)")
     ap.add_argument("--expect-sanitizer-clean", action="store_true",
                     help="exit 1 unless FLAGS_sanitize_locks was on, "
                     "the sanitizer instrumented lock traffic, and it "
@@ -264,7 +294,9 @@ def main(argv=None) -> int:
             seed=args.seed, vocab_size=cfg.vocab_size,
             prompt_tokens=parse_range(args.prompt_tokens),
             new_tokens=parse_range(args.new_tokens),
-            sample_frac=args.sample_frac)
+            sample_frac=args.sample_frac,
+            closed_loop=args.closed_loop,
+            abandon_frac=args.abandon_frac)
 
     spec = (args.fault_spec if args.fault_spec is not None
             else kill_spec(duration, args.kills))
@@ -293,7 +325,8 @@ def main(argv=None) -> int:
                   if d[0] in ("invalid", "error"))
     report.pop("decisions")
     identity_ok = (report["completed"] + report["rehomed"] +
-                   report["shed_total"] + errored == report["offered"])
+                   report["shed_total"] + report["canceled_total"] +
+                   errored == report["offered"])
 
     # ---- the static half of the zero-new-compiles proof ------------
     lg_workload = [[(list(a.prompt), a.max_new_tokens)
@@ -302,9 +335,11 @@ def main(argv=None) -> int:
                max_len=args.max_len, n_replicas=args.replicas,
                slo_ttft_ms=args.slo_ttft_ms)
     plain_pred = predict_serving_compiles(lg_workload, **pkw)
+    hedges_fired = int(report.get("hedges", {}).get("fired", 0))
     chaos_pred = predict_serving_compiles(
         lg_workload, replica_kills=report["kills"],
         restarts=report["restarts"], rehomed=report["rehomed"],
+        cancel=report["canceled_total"], hedge=hedges_fired,
         **pkw)
     predictor_noop = (chaos_pred == plain_pred)
 
@@ -364,6 +399,10 @@ def main(argv=None) -> int:
         "burn_rate": [row["burn_rate"] for row in windows],
         "predictor_noop": predictor_noop,
         "identity_ok": identity_ok,
+        "hedge_budget_ok": (
+            hedges_fired <= 1 + (args.hedge_budget if args.hedge_budget
+                                 is not None else _hedge_budget_flag())
+            * report["offered"]) if args.hedge_ms != 0.0 else None,
         "frontier": frontier,
         "sanitizer": san,
     }
@@ -377,6 +416,7 @@ def main(argv=None) -> int:
         print(json.dumps(out))
     else:
         for k in ("offered", "completed", "rehomed", "shed_total",
+                  "canceled_total", "abandoned",
                   "kills", "restarts", "goodput_per_s",
                   "slo_attainment", "replica_seconds",
                   "leaked_kv_blocks", "exceptions",
@@ -454,9 +494,24 @@ def main(argv=None) -> int:
     if args.expect_identity and not identity_ok:
         print(f"FAIL: completed {report['completed']} + rehomed "
               f"{report['rehomed']} + shed {report['shed_total']} + "
+              f"canceled {report['canceled_total']} + "
               f"errors {errored} != offered {report['offered']}",
               file=sys.stderr)
         ok = False
+    if args.expect_hedge_budget_respected:
+        if args.hedge_ms == 0.0 or "hedges" not in report:
+            print("FAIL: --expect-hedge-budget-respected needs "
+                  "--hedge-ms (no hedging ran)", file=sys.stderr)
+            ok = False
+        else:
+            frac = (args.hedge_budget if args.hedge_budget is not None
+                    else _hedge_budget_flag())
+            cap = 1 + frac * report["offered"]
+            if hedges_fired > cap:
+                print(f"FAIL: {hedges_fired} hedges fired > budget "
+                      f"envelope 1 + {frac} * {report['offered']} = "
+                      f"{cap}", file=sys.stderr)
+                ok = False
     if report["exceptions"]:
         print(f"FAIL: {report['exceptions']} unhandled exceptions",
               file=sys.stderr)

@@ -218,6 +218,7 @@ _FAULT_BEGIN = "<!-- BEGIN GENERATED: fault-tolerance -->"
 _FAULT_END = "<!-- END GENERATED: fault-tolerance -->"
 _FAULT_FLAGS = ("fault_spec", "fault_seed", "retry_max_attempts",
                 "retry_base_delay", "retry_max_delay", "retry_deadline",
+                "retry_budget_ratio", "retry_budget_reserve",
                 "guardian_max_skip", "ps_heartbeat_timeout",
                 "ps_connect_timeout", "ps_socket_timeout")
 
@@ -540,6 +541,49 @@ def render_serving_block():
         "--sample-frac 0.5 --lora-rank 2` drives the mixed-tenant",
         "sampled workload with per-tenant goodput in the report and a",
         "`--expect-zero-new-compiles` gate.",
+        "",
+        "The request lifecycle is robust end to end. `cancel(rid)` (or",
+        "`DELETE /v1/requests/<id>`; a broken client pipe cancels too)",
+        "terminates a request at whatever stage it has reached —",
+        "queued, mid-prefill, awaiting handoff, or mid-decode —",
+        "releasing every KV block and LoRA pin, purging affinity",
+        "entries and deduping re-homed copies; it is idempotent and",
+        "pure host-side queue/slot surgery (zero new compiles,",
+        "`predict_serving_compiles(cancel=N)` is a validated no-op),",
+        "and the accounting identity extends to `completed + rehomed +",
+        "shed + canceled == offered`. `submit(deadline_ms=...)` is a",
+        "hard end-to-end deadline carried through handoffs and",
+        "re-homes: every stage boundary and every between-steps reap",
+        "sweep enforces it, so an expired request is canceled — not",
+        "completed — within one step and its slot admits waiting work",
+        "in that same step. Tail latency is hedged",
+        "(`FLAGS_serving_hedge_ms`; negative = auto from the live TTFT",
+        "p95): when the router predicts a slow first token it arms a",
+        "hedge, fires a clone to the second-best replica after the",
+        "delay, takes whichever first token lands first and cancels",
+        "the loser leak-free (`canceled{reason=hedge_lose}`), with",
+        "fired volume bounded by a `FLAGS_serving_hedge_budget` token",
+        "bucket (`fired <= 1 + budget * offered`). Retries on the",
+        "serving hot paths (`serving.route | serving.handoff |",
+        "serving.replica`) share one fleet-wide `RetryBudget`",
+        "(`FLAGS_retry_budget_*`): successes fund retries, correlated",
+        "failure drains the bucket and sheds would-be storms as",
+        "backpressure, and a per-replica circuit breaker stops routing",
+        "to repeat offenders. Observability rides along:",
+        "`serving_canceled_total{reason=}`,",
+        "`serving_hedges_total{outcome=}` and",
+        "`serving_retry_budget_remaining` on `GET /metrics`,",
+        "`serving_cancel` / `serving_hedge` run-log events, and",
+        "cancel / hedge / hedge_win / hedge_lose trace marks.",
+        "`tools/loadgen.py --closed-loop N --abandon-frac F` makes a",
+        "seeded subset of clients hang up mid-decode (abandonment",
+        "rides the trace, so replays reproduce the cancels byte-",
+        "identically), `--straggler I:MS --hedge-ms D` races hedges",
+        "against a deterministic slow replica, and CI gates the lot:",
+        "hedged goodput must beat unhedged under a straggler + chaos",
+        "kill + 10% abandonment at zero leaks and zero new compiles,",
+        "and the soak re-asserts the extended identity and the hedge",
+        "budget envelope.",
         "",
         "Flags:",
         "",
