@@ -121,7 +121,7 @@ MUTATORS = frozenset((
 
 #: the serving modules the CLI lints by default
 SERVING_FILES = ("engine.py", "router.py", "disagg.py", "kv_cache.py",
-                 "lora.py")
+                 "lora.py", "kv_tier.py")
 
 
 @dataclasses.dataclass

@@ -140,7 +140,9 @@ def predict_serving_compiles(
         sampling: Optional[Sequence[Tuple[float, int, float]]] = None,
         lora: Optional[Tuple[int, int]] = None,
         tracing: Optional[float] = None,
-        sanitize: bool = False) -> Dict[str, int]:
+        sanitize: bool = False,
+        host_tier: bool = False,
+        sessions: int = 0) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -288,6 +290,21 @@ def predict_serving_compiles(
     the whole fleet under the sanitizer predicts the same counts as
     running it bare (and ``tools/obs_smoke.py`` asserts exactly
     that, predicted == observed, with the flag on).
+
+    ``host_tier`` / ``sessions`` (``FLAGS_serving_host_tier``: the
+    host-RAM KV block tier, and the number of distinct
+    ``submit(session=...)`` conversations a workload carries) are
+    validated no-ops because every migration is host-side numpy
+    surgery on pool *state*, never on compiled functions: demotion
+    stages cold blocks through pinned staging buffers and quantizes
+    them int8-at-rest with the numpy mirror of the device grid,
+    promotion writes them back with a functional ``.at[dst].set``
+    whose output shape/dtype equals the pool's (an update to a jit
+    *input*, not a new trace), and a resumed session re-prefills only
+    its unshared suffix — which lands in a bucket the original turn
+    already warmed, by construction. A million sessions tiered
+    through host RAM therefore predict the same counts as none —
+    the concurrent-session capacity contract, statically.
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -370,6 +387,20 @@ def predict_serving_compiles(
         raise ValueError(
             f"sanitize must be a bool (FLAGS_sanitize_locks is "
             f"on/off), got {sanitize!r}")
+    if host_tier not in (True, False):
+        raise ValueError(
+            f"host_tier must be a bool (FLAGS_serving_host_tier is "
+            f"on/off), got {host_tier!r}")
+    if int(sessions) < 0:
+        raise ValueError(f"sessions must be >= 0, got {sessions}")
+    if sessions and not host_tier:
+        raise ValueError(
+            "sessions requires host_tier=True (submit(session=...) "
+            "needs the host KV tier to park a conversation)")
+    if host_tier and not paged:
+        raise ValueError(
+            "host_tier requires paged=True (the tier migrates paged "
+            "KV blocks)")
     bks = _parse_buckets(buckets, max_len)
     suffix = "_paged" if paged else ""
     counts: Dict[str, int] = {}

@@ -454,6 +454,32 @@ define_flag("serving_breaker_cooldown_s", 5.0,
             "(engine clock) an open breaker holds before going "
             "half-open — one probe routes through; success closes "
             "the breaker, failure re-opens it for another cooldown.")
+define_flag("serving_host_tier", False,
+            "Host-RAM KV block tier (serving/kv_tier.py): attach a "
+            "TierManager over a pinned numpy HostBlockStore so cold "
+            "prefix chains and finished-session rows demote out of "
+            "the device pool (int8-at-rest) and promote back on "
+            "demand, and submit(session=...) resumes a demoted "
+            "conversation token-identically. Routers build ONE "
+            "fleet-shared store across replicas and roles. Migration "
+            "is host-side block-table surgery over eager pool writes "
+            "— predict_serving_compiles(host_tier=True) is a "
+            "validated no-op.")
+define_flag("serving_host_blocks", 256,
+            "Host-RAM KV tier capacity in blocks (per fleet-shared "
+            "HostBlockStore). Blocks are stored as int8 codes + "
+            "per-block-per-head f32 absmax scales regardless of the "
+            "device pool's kv_dtype, so a host gigabyte holds ~4x "
+            "the f32 sessions; the store evicts idle chains LRU "
+            "(leaf-first) under pressure.")
+define_flag("serving_demote_idle_ms", 0.0,
+            "Host-tier demotion sweep cadence (engine clock ms): a "
+            "device prefix entry must sit cold (cache-only, no live "
+            "request references) across a full window of this length "
+            "before the between-steps sweep demotes it to the host "
+            "store — 0 demotes cold entries at every step (the "
+            "maximum-capacity setting loadgen's returning-users gate "
+            "runs with). Only read when a kv_tier is attached.")
 
 # Observability plane (paddle_tpu/observability): metrics registry,
 # XLA compile tracker, structured run log, Prometheus export.

@@ -152,6 +152,23 @@ INSTRUMENT_DOCS = {
     "serving_lora_adapters_loaded{engine=...}":
         "gauge — tenant LoRA adapters currently resident in an "
         "engine's paged adapter pool (page 0 = base never counts)",
+    "serving_kv_blocks_used{tier=host} / _free{tier=host}":
+        "gauges — host-RAM KV tier occupancy of the fleet-shared "
+        "HostBlockStore (int8-at-rest blocks holding demoted prefix "
+        "chains and finished-session rows); the device-pool series "
+        "carry tier=device so capacity dashboards stack the two tiers",
+    "serving_kv_migrations{dir=...}":
+        "counter — KV blocks migrated between tiers by the "
+        "TierManager, by direction (demote: device->host, promote: "
+        "host->device); pure host-side block surgery, zero compiles "
+        "either way",
+    "serving_sessions_resident / _host / _resumed":
+        "gauges — multi-turn session accounting in the fleet-shared "
+        "SessionStore: sessions currently holding device rows "
+        "(resident), sessions parked with host-resident context "
+        "between turns (host), and cumulative submit(session=...) "
+        "resumes that re-prefilled only their unshared suffix "
+        "(resumed)",
     "STAT_serving_lora_loads / _evictions":
         "counters — adapter pool writes: load_adapter / evict_adapter "
         "calls that landed (both zero-recompile by construction)",
@@ -237,6 +254,19 @@ EVENT_DOCS = {
                      "mitigation; resolution lands as a hedge_win/"
                      "hedge_lose trace mark and a serving_cancel of "
                      "the loser",
+    "serving_kv_demote": "TierManager moved cold device prefix "
+                         "entries into the host tier (entries, "
+                         "blocks, dedup: chains the fleet-shared "
+                         "store already held) — the off-step-path "
+                         "LRU demotion sweep",
+    "serving_kv_promote": "TierManager rebuilt a host-resident prefix "
+                          "chain on device (blocks, tokens) — "
+                          "promotion-on-demand at acquire()/affinity "
+                          "time, all-or-nothing under pool pressure",
+    "serving_session_resume": "submit(session=...) resumed a parked "
+                              "conversation (session, stored_tokens, "
+                              "prompt_tokens) — only the unshared "
+                              "suffix re-prefills, token-identically",
     "fault_injected": "deterministic fault fired (site, fault_kind)",
     "recompile_warning": "tracked function exceeded "
                          "FLAGS_warn_recompiles (fn, signature)",

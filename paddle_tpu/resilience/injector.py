@@ -115,6 +115,14 @@ FAULT_SITE_DOCS: Dict[str, str] = {
                        "restart (permanent capacity loss). Pair with "
                        "@t>Ns virtual-time triggers for seeded soak "
                        "kill schedules",
+    "serving.migrate": "TierManager device<->host block migration "
+                       "(serving/kv_tier.py), once per demote/promote "
+                       "attempt — drop/error are retried via "
+                       "RetryPolicy, `skip` and retry exhaustion skip "
+                       "that migration cleanly (a skipped demotion "
+                       "leaves the chain on device, a skipped "
+                       "promotion falls back to re-prefill; blocks "
+                       "taken mid-attempt are unwound, never leaked)",
 }
 FAULT_SITES: Tuple[str, ...] = tuple(FAULT_SITE_DOCS)
 

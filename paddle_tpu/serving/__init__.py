@@ -54,10 +54,21 @@ step, and loading/evicting adapters at runtime is a functional pool
 write — zero new compiles for all of it
 (``FLAGS_serving_lora_rank`` / ``FLAGS_serving_lora_max_adapters``).
 
+Session capacity scales past HBM with the host-RAM KV tier
+(kv_tier.py, ``FLAGS_serving_host_tier``): a fleet-shared
+:class:`HostBlockStore` holds cold prefix chains int8-at-rest, a
+:class:`TierManager` demotes idle chains between steps and promotes
+them back on demand, and a :class:`SessionStore` lets
+``submit(session=...)`` resume a demoted conversation
+token-identically — concurrent sessions are bounded by host blocks,
+not device blocks, and a system prompt is materialized once per
+fleet.
+
 See engine.py for the scheduler, kv_cache.py for the memory managers,
-decoding.py for sampling-as-data + the JSON grammar, lora.py for the
-paged adapter pool, router.py for the symmetric replica front end,
-disagg.py for the disaggregated fleet, http.py for the JSON front end.
+kv_tier.py for the host-RAM tier + session store, decoding.py for
+sampling-as-data + the JSON grammar, lora.py for the paged adapter
+pool, router.py for the symmetric replica front end, disagg.py for
+the disaggregated fleet, http.py for the JSON front end.
 """
 
 from .engine import QueueFullError, Request, ServingEngine
@@ -68,12 +79,14 @@ from .disagg import (DecodeEngine, DisaggRouter, HandoffQueue,
 from .http import ServingHTTPServer
 from .kv_cache import (BlockAllocator, BlockKVCache, BlockPool,
                        SlotKVCache, prefix_chain_keys)
+from .kv_tier import HostBlockStore, SessionStore, TierManager
 from .lora import LoRAPool, make_adapter
 from .router import AutoscalePolicy, ReplicaRouter
 
 __all__ = ["ServingEngine", "Request", "QueueFullError",
            "SlotKVCache", "BlockKVCache", "BlockAllocator",
            "BlockPool", "prefix_chain_keys",
+           "HostBlockStore", "TierManager", "SessionStore",
            "ServingHTTPServer", "ReplicaRouter", "AutoscalePolicy",
            "DisaggRouter", "PrefillEngine", "DecodeEngine",
            "HandoffQueue",

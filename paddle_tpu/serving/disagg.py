@@ -96,6 +96,21 @@ def parse_disagg(text: str) -> Optional[Tuple[int, int]]:
     return p, d
 
 
+class _HostTierAffinity:
+    """Sentinel value in the fleet prefix index: the worker that last
+    prefilled this chain is dead, but the chain itself is resident in
+    the fleet-shared host KV tier — still reachable, because ANY live
+    prefill worker can promote it from host RAM. Routing resolves the
+    marker to the least-loaded live worker; the next publish replaces
+    it with that worker."""
+
+    def __repr__(self):
+        return "<host-tier>"
+
+
+_HOST_TIER = _HostTierAffinity()
+
+
 class _Handoff:
     """One finished prefill in flight between roles: the request, the
     exported block record (which *owns* the blocks' references until
@@ -252,6 +267,8 @@ class PrefillEngine(ServingEngine):
                 worked = bool(self._admit()) or worked
                 worked = self._stage_running() > 0 or worked
                 worked = self._flush_pending() > 0 or worked
+            if self.kv_tier is not None:
+                self._demote_sweep()
             if self.paged:
                 self._blocks_used_g.set(self.cache.blocks_used)
                 self._blocks_free_g.set(self.cache.blocks_free)
@@ -426,6 +443,8 @@ class DecodeEngine(ServingEngine):
             worked = self._adopt_handoffs() > 0
             produced = (self._spec_decode() if self.spec_tokens
                         else self._decode())
+            if self.kv_tier is not None:
+                self._demote_sweep()
             if self.paged:
                 self._blocks_used_g.set(self.cache.blocks_used)
                 self._blocks_free_g.set(self.cache.blocks_free)
@@ -503,6 +522,27 @@ class DisaggRouter:
                     model.gpt.cfg, rank,
                     int(mx if mx is not None
                         else gl["serving_lora_max_adapters"]))
+        if "kv_tier" not in engine_kwargs:
+            # one host tier across BOTH roles: a chain demoted by any
+            # prefill or decode worker is promotable by every other,
+            # and it outlives any one worker's pool (the crash-safe
+            # half of the fleet prefix index below)
+            gt = _flags.get_flags(["serving_host_tier",
+                                   "serving_host_blocks",
+                                   "serving_block_size"])
+            if gt["serving_host_tier"]:
+                from .kv_tier import HostBlockStore, TierManager
+                cfg = model.gpt.cfg
+                bs = engine_kwargs.get("block_size")
+                bs = int(bs if bs is not None
+                         else gt["serving_block_size"])
+                engine_kwargs = dict(engine_kwargs)
+                engine_kwargs["kv_tier"] = TierManager(
+                    HostBlockStore(
+                        cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                        block_size=bs,
+                        num_blocks=int(gt["serving_host_blocks"])))
+        self.kv_tier = engine_kwargs.get("kv_tier")
         self.prefills: List[PrefillEngine] = [
             PrefillEngine(model, self._handoff, **engine_kwargs)
             for _ in range(n_prefill)]
@@ -596,11 +636,28 @@ class DisaggRouter:
             # corrupts its internal linkage under contention)
             with self._lock:
                 eng = self._affinity.get(key)
-                if eng is None or eng.draining or \
+                if eng is _HOST_TIER:
+                    if self.kv_tier is None or \
+                            not self.kv_tier.has_chain(key):
+                        continue
+                    self._affinity.move_to_end(key)
+                    idx = None  # resolved to a live worker below
+                elif eng is None or eng.draining or \
                         eng not in self.prefills:
                     continue
-                self._affinity.move_to_end(key)
-                idx = self.prefills.index(eng)
+                else:
+                    self._affinity.move_to_end(key)
+                    idx = self.prefills.index(eng)
+            if idx is None:
+                # host-tier marker: the chain is promotable by ANY live
+                # worker, so the least-loaded one takes it — its next
+                # publish replaces the marker with a live entry
+                order = self._least_loaded()
+                if not order:
+                    return None
+                self._aff_hits.add(1)
+                _monitor.stat_add("STAT_serving_affinity_hits")
+                return order[0]
             if eng.cache.match_prefix_blocks(prompt) > 0:
                 self._aff_hits.add(1)
                 _monitor.stat_add("STAT_serving_affinity_hits")
@@ -808,6 +865,14 @@ class DisaggRouter:
                 eng = self._affinity.get(key)
                 if eng is None:
                     continue
+                if eng is _HOST_TIER:
+                    # marker entries stay while the chain is resident
+                    # in the host tier — still reachable fleet-wide
+                    if self.kv_tier is None or \
+                            not self.kv_tier.has_chain(key):
+                        del self._affinity[key]
+                        purged += 1
+                    continue
                 if eng not in self.prefills or \
                         eng.cache.match_prefix_blocks(prompt) == 0:
                     del self._affinity[key]
@@ -883,11 +948,22 @@ class DisaggRouter:
             eng = self.prefills.pop(index)
             eng.draining = True
             self._killed.append(eng)
-        # forget the worker in the affinity index
+        # forget the worker in the affinity index — EXCEPT entries
+        # whose prefix chain is resident in the fleet-shared host
+        # tier: those chains outlive the worker (any survivor can
+        # promote them), so purging the entry would orphan a chain
+        # that is still reachable. Convert to the host-tier marker
+        # instead; drop only what is actually unreachable.
+        kept = 0
         with self._lock:
             for key in [k for k, v in self._affinity.items()
                         if v is eng]:
-                del self._affinity[key]
+                if self.kv_tier is not None and \
+                        self.kv_tier.has_chain(key):
+                    self._affinity[key] = _HOST_TIER
+                    kept += 1
+                else:
+                    del self._affinity[key]
         # undelivered handoff records: shed + release their refs
         shed = 0
         for item in self._handoff.evict_from(eng):
@@ -930,9 +1006,11 @@ class DisaggRouter:
         _monitor.stat_add("STAT_serving_worker_killed")
         _runlog.log_event("serving_worker_kill", role="prefill",
                           worker=index, shed=shed, rerouted=rerouted,
+                          affinity_kept=kept,
                           t=round(eng._clock(), 6),
                           prefills_left=len(self.prefills))
         return {"shed": shed, "rerouted": rerouted,
+                "affinity_kept": kept,
                 "prefills_left": len(self.prefills)}
 
     def kill_decode_worker(self, index: int) -> dict:

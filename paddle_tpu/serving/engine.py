@@ -124,6 +124,7 @@ from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
 from .decoding import DecodeParams, request_key, sample_first
 from .kv_cache import BlockKVCache, SlotKVCache
+from .kv_tier import HostBlockStore, TierManager
 from .lora import LoRAPool
 
 
@@ -202,6 +203,11 @@ class Request:
         self._cursor = None        # JsonCursor when json_mode is on
         self._lora_held = False    # this request pins its tenant page
         self.rehomed = False       # recovered from a killed replica
+        # host-tier conversation id (submit(session=...)): _finish
+        # publishes this request's full context into the prefix cache
+        # and the SessionStore so the next turn resumes off the chain
+        self.session: Optional[str] = None
+        self._session_counted = False  # resident-session gauge held
         self._hedge_clone = False  # router-internal hedge copy: never
         #                            surfaced in results()/reports
         # absolute engine-clock time after which the request is
@@ -338,7 +344,7 @@ class ServingEngine:
                  clock=None, kv_pool=None,
                  lora_rank: Optional[int] = None,
                  lora_max_adapters: Optional[int] = None,
-                 lora_pool=None, grammar=None):
+                 lora_pool=None, grammar=None, kv_tier=None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -357,7 +363,9 @@ class ServingEngine:
                               "serving_slo_tpot_ms",
                               "serving_priority_preempt",
                               "serving_lora_rank",
-                              "serving_lora_max_adapters"])
+                              "serving_lora_max_adapters",
+                              "serving_host_tier",
+                              "serving_host_blocks"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -510,6 +518,37 @@ class ServingEngine:
                 "adapter-page input")
         self._lora_shape = (None if self.lora_pool is None
                             else self.lora_pool.shape_key)
+        # Host-RAM KV tier (serving/kv_tier.py): an explicit kv_tier=
+        # shares one TierManager across engines (the router/disagg
+        # fleet shape, exactly like lora_pool=); FLAGS_serving_host_tier
+        # builds a per-engine one. Migration is host-side block surgery
+        # plus eager pool writes — zero compiled surfaces join the step
+        # cache (predict_serving_compiles(host_tier=True) is a no-op).
+        if kv_tier is not None:
+            self.kv_tier = kv_tier
+        elif g["serving_host_tier"]:
+            if not self.paged:
+                raise ValueError(
+                    "the host KV tier requires the paged KV cache "
+                    "(FLAGS_serving_paged); dense slots have no "
+                    "block-granular migration")
+            self.kv_tier = TierManager(HostBlockStore(
+                cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                block_size=self.cache.block_size,
+                num_blocks=int(g["serving_host_blocks"])))
+        else:
+            self.kv_tier = None
+        if self.kv_tier is not None:
+            if not self.paged:
+                raise ValueError(
+                    "the host KV tier requires the paged KV cache "
+                    "(FLAGS_serving_paged); dense slots have no "
+                    "block-granular migration")
+            self.kv_tier.attach(self.cache)
+        # first-seen-cold timestamps feeding the between-steps demotion
+        # sweep (FLAGS_serving_demote_idle_ms); step-lock-owned like
+        # _active, mutated in place so no guarded rebinding
+        self._cold_since: Dict[int, float] = {}
         # JSON-constrained decoding: a JsonGrammar whose per-request
         # cursors produce the additive [vocab] mask rows. Constructor
         # state like the SLO knobs — json_mode submissions without it
@@ -586,11 +625,11 @@ class ServingEngine:
                 "serving_kv_blocks_used",
                 "physical KV blocks currently referenced (paged "
                 "serving; includes the trash block and prefix-cache "
-                "holds)").labels(engine=eid)
+                "holds)").labels(engine=eid, tier="device")
             self._blocks_free_g = _obs.gauge(
                 "serving_kv_blocks_free",
                 "physical KV blocks on the free list (paged serving)"
-                ).labels(engine=eid)
+                ).labels(engine=eid, tier="device")
             self._blocks_used_g.set(self.cache.blocks_used)
             self._blocks_free_g.set(self.cache.blocks_free)
         # which paged-attention lowering this engine runs (1 on the
@@ -895,6 +934,7 @@ class ServingEngine:
                tenant: Optional[str] = None,
                decode: Optional[DecodeParams] = None,
                deadline_ms: Optional[float] = None,
+               session: Optional[str] = None,
                _log_request: bool = True) -> Request:
         """Queue a generation request; returns its handle immediately.
 
@@ -927,7 +967,17 @@ class ServingEngine:
         given up. It rides the Request through handoffs and re-homes.
         Unlike the TTFT SLO deadline it never affects admission
         prediction; None (the default) keeps today's run-to-completion
-        behavior."""
+        behavior.
+
+        ``session`` names a conversation in the host KV tier
+        (requires FLAGS_serving_host_tier or an engine constructed
+        with ``kv_tier=``): when the SessionStore holds a context for
+        this id, it is prepended to ``prompt`` so the request resumes
+        token-identically off the stored chain — the prefix cache (or
+        a host->device promotion) covers the shared part and only the
+        unshared suffix re-prefills. On finish the full context is
+        saved back and the chain demotes to host RAM between turns,
+        so idle conversations hold zero device blocks."""
         if deadline_ms is not None and float(deadline_ms) <= 0:
             raise ValueError(
                 f"deadline_ms must be > 0, got {deadline_ms}")
@@ -938,6 +988,24 @@ class ServingEngine:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        sid = str(session) if session is not None else None
+        stored_ctx = None
+        if sid is not None:
+            if not sid:
+                raise ValueError("session id must be non-empty")
+            if self.kv_tier is None:
+                raise ValueError(
+                    "submit(session=...) requires the host KV tier: "
+                    "set FLAGS_serving_host_tier or construct the "
+                    "engine with kv_tier=")
+            stored_ctx = self.kv_tier.session_context(sid)
+            if stored_ctx:
+                # resume: prepend the stored conversation so the
+                # rolling-hash chain matches what the previous turn
+                # published — geometry validation below sees the full
+                # context, and admission re-prefills only the suffix
+                # past whatever the prefix cache / promotion covers
+                prompt = stored_ctx + prompt
         if mnt < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
         if decode is not None:
@@ -1020,6 +1088,8 @@ class ServingEngine:
                     extra["json_mode"] = True
             if tenant:
                 extra["tenant"] = tenant
+            if sid is not None:
+                extra["session"] = sid
             _runlog.log_event("serving_request", t=round(now, 6),
                               prompt=prompt, max_new_tokens=mnt,
                               priority=pr, engine=self._eid, **extra)
@@ -1040,6 +1110,7 @@ class ServingEngine:
                                  retry_after_s=self._retry_after_s(0.0))
         req = Request(prompt, mnt, eos, priority=pr, now=now,
                       decode=params, tenant=tenant)
+        req.session = sid
         if params.json_mode:
             req._cursor = self.grammar.start()
         if self.slo_ttft_ms:
@@ -1092,6 +1163,12 @@ class ServingEngine:
             raise QueueFullError(msg, reason=reason,
                                  retry_after_s=self._retry_after_s(pred))
         _monitor.stat_add("STAT_serving_submitted")
+        if sid is not None:
+            req._session_counted = True
+            self.kv_tier.session_started(sid)
+            if stored_ctx:
+                self.kv_tier.session_resumed(
+                    sid, len(stored_ctx), len(prompt) - len(stored_ctx))
         _tracing.begin(req.id, req.submitted_at, self.trace_track,
                        prompt_tokens=len(req.prompt),
                        max_new_tokens=req.max_new_tokens,
@@ -1282,6 +1359,15 @@ class ServingEngine:
         if kind == "skip":
             raise _Shed("injected allocator failure for request "
                         f"{req.id}")
+        if self.kv_tier is not None:
+            # promotion-on-demand: pull any host-resident continuation
+            # of this context's chain back up before acquiring — the
+            # promoted blocks republish as device prefix entries, so
+            # acquire() shares them like any warm prefix. Idempotent
+            # under retry (an already-promoted chain is a device hit,
+            # not a second copy), and a failed/skipped promotion just
+            # means a longer re-prefill.
+            self.kv_tier.promote(self.cache, req.context)
         return self.cache.acquire(req.context, need)
 
     def _prefill_group_attempt_paged(self, bucket: int, group):
@@ -1830,6 +1916,12 @@ class ServingEngine:
     def _finish(self, req: Request):  # holds: _step_lock
         if req.slot is not None:
             self._active.pop(req.slot, None)
+            if req.session is not None and self.kv_tier is not None:
+                # publish the finished conversation's full blocks into
+                # the prefix cache before the row's refs drop: the
+                # between-steps sweep demotes the now-cold chain to
+                # host RAM, and the next turn resumes off it
+                self.cache.insert_prefix(req.slot, req.context)
             self.cache.release(req.slot)
             req.slot = None
         if req._lora_held:
@@ -1865,6 +1957,11 @@ class ServingEngine:
             ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
             tpot_ms=None if tpot is None else round(tpot * 1e3, 3),
             deadline_met=met)
+        if req.session is not None and self.kv_tier is not None:
+            self.kv_tier.session_save(req.session, req.context)
+            if req._session_counted:
+                req._session_counted = False
+                self.kv_tier.session_released(req.session)
         _tracing.finish(req.id, req.finished_at, self.trace_track,
                         "done")
         req._done.set()
@@ -1884,6 +1981,9 @@ class ServingEngine:
         _runlog.log_event("serving_shed", request=req.id,
                           reason=reason, priority=req.priority,
                           error=str(err))
+        if req._session_counted and self.kv_tier is not None:
+            req._session_counted = False
+            self.kv_tier.session_released(req.session)
         _tracing.finish(req.id, req.finished_at, self.trace_track,
                         "shed", reason=reason)
         req._done.set()
@@ -1964,6 +2064,9 @@ class ServingEngine:
         _tracing.mark(req.id, "cancel", now, self.trace_track)
         _tracing.finish(req.id, now, self.trace_track, "canceled",
                         reason=reason)
+        if req._session_counted and self.kv_tier is not None:
+            req._session_counted = False
+            self.kv_tier.session_released(req.session)
         if finalize:
             req.state = "canceled"
             req.shed_reason = reason
@@ -2005,10 +2108,41 @@ class ServingEngine:
             admitted = self._admit()
             produced = (self._spec_decode() if self.spec_tokens
                         else self._decode())
+            if self.kv_tier is not None:
+                self._demote_sweep()
             if self.paged:
                 self._blocks_used_g.set(self.cache.blocks_used)
                 self._blocks_free_g.set(self.cache.blocks_free)
             return bool(admitted or produced or reaped)
+
+    def _demote_sweep(self):  # holds: _step_lock
+        """Between-steps host-tier demotion: prefix entries that have
+        sat cold (refcount 1 — no live request, no resident child pin)
+        across a full FLAGS_serving_demote_idle_ms window move to the
+        host store; 0 demotes cold entries at every step. Runs after
+        the decode dispatch so the copies drain while the device
+        crunches the next batch — demotion never blocks a decode."""
+        pool = self.cache.pool
+        idle_ms = self.kv_tier.demote_idle_ms
+        eligible = None
+        if idle_ms > 0:
+            now = self._clock()
+            cold = {k for k, e in pool._prefix.items()
+                    if pool.allocator.refcount[e.block] == 1}
+            for k in list(self._cold_since):
+                if k not in cold:
+                    del self._cold_since[k]
+            for k in cold:
+                self._cold_since.setdefault(k, now)
+            eligible = {k for k, t0 in self._cold_since.items()
+                        if (now - t0) * 1e3 >= idle_ms}
+            if not eligible:
+                return
+        entries, _blocks = self.kv_tier.demote(self.cache,
+                                               keys=eligible)
+        if entries and eligible is not None:
+            for k in eligible:
+                self._cold_since.pop(k, None)
 
     def stats(self) -> dict:
         """Per-engine serving metrics: time-to-first-token and
@@ -2099,6 +2233,10 @@ class ServingEngine:
             }
         if self.grammar is not None:
             out["json_grammar"] = True
+        if self.kv_tier is not None:
+            # fleet-shared numbers when the tier is shared: every
+            # attached engine reports the same store/session totals
+            out["kv_tier"] = self.kv_tier.stats()
         if self.paged:
             c = self.cache
             hit_t, miss_t = c.prefix_hits, c.prefix_misses

@@ -1,0 +1,763 @@
+"""Host-RAM KV block tier — million-session serving memory.
+
+The device :class:`~paddle_tpu.serving.kv_cache.BlockPool` caps
+concurrent conversations at HBM block count: a session that goes idle
+between turns either holds device blocks hostage (prefix-cache
+residency) or loses its KV entirely and pays a full re-prefill on the
+next turn. This module adds the tier underneath — the serving-side
+analogue of the reference parameter-server stack's host-memory tables
+fronting device compute:
+
+- :class:`HostBlockStore` — a pinned numpy block pool holding prefix
+  chains **int8-at-rest** (codes + per-block-per-head absmax scales on
+  exactly the ``ops.quant_ops`` grid, so an int8 device pool's blocks
+  round-trip losslessly and a f32/bf16 pool pays one quantization on
+  demote). Refcounted like ``BlockAllocator`` — ``leaked()`` stays
+  exact across migrations — with leaf-first LRU eviction under
+  pressure, mirroring the device prefix cache.
+
+- :class:`TierManager` — migrates blocks device<->host *off the step
+  path*. Demotion sweeps cold prefix chains (refcount 1: no live
+  request, no resident child) between engine steps through a pair of
+  alternating staging buffers, so the device->host copy of block N
+  lands while block N-1 quantizes — a decode step never waits on a
+  demotion in flight. Promotion is on demand: when ``acquire()``'s
+  device chain runs out but the rolling hash continues into
+  host-resident entries, the missing blocks are copied back up
+  all-or-nothing and republished as ordinary ``_PrefixEntry`` chain
+  links (a failed promotion unwinds every block it took and falls back
+  to re-prefill — always safe, never leaked). Both directions pass the
+  ``serving.migrate`` fault site and retry via
+  ``RetryPolicy.from_flags``.
+
+- :class:`SessionStore` — conversation contexts keyed by session id so
+  ``ServingEngine.submit(session=...)`` resumes a demoted conversation
+  token-identically: the engine prepends the stored context, the
+  promoted chain covers the shared prefix, and only the unshared
+  suffix re-prefills (exactly the device prefix-cache contract).
+
+The store is *fleet-shared*: ``ReplicaRouter``/``DisaggRouter`` attach
+one ``TierManager`` across replicas and roles, so a chain demoted by
+one worker is promotable by any other and a shared system prompt is
+materialized once per fleet. Chain keys are the pool-independent
+rolling hashes of ``prefix_chain_keys`` — host entries carry
+``parent_key`` (not a physical block), which is what makes them
+meaningful across pools and what keeps a killed replica's chains
+promotable (crash-safe: device refs die with the pool, host refs
+don't).
+
+Migration is pure host-side block surgery — eager ``.at[].set()`` pool
+writes plus block-table bookkeeping, never a new traced shape — so
+``analysis.recompile.predict_serving_compiles(host_tier=True)`` is a
+validated no-op.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observability as _obs
+from ..analysis import concurrency as _ccz
+from ..observability import runlog as _runlog
+from ..ops.quant_ops import KV_QMAX
+from ..resilience.injector import fault_point
+from ..resilience.retry import RetryError, RetryPolicy
+from .kv_cache import BlockKVCache, _PrefixEntry
+
+
+def _np_quantize(x: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """numpy mirror of ``ops.quant_ops.quantize_int8`` (same clamp,
+    same 1e-9 floor, same round-half-even) — host-side encode must
+    land on the identical grid or a promote would not be the inverse
+    of the device write path."""
+    s = np.maximum(scale, 1e-9)
+    return np.clip(np.round(x / s * KV_QMAX), -KV_QMAX, KV_QMAX).astype(np.int8)
+
+
+def _np_dequantize(codes: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """numpy mirror of ``ops.quant_ops.dequantize_int8``."""
+    return codes.astype(np.float32) * (scale / KV_QMAX)
+
+
+class _MigrationSkip(Exception):
+    """Internal: this one migration attempt is skipped by fault policy
+    (injected `skip` at serving.migrate). The chain stays where it is;
+    nothing was taken, nothing leaks."""
+
+
+class _HostEntry:
+    """One host-resident full block of a prefix chain.
+
+    The host twin of ``_PrefixEntry``, with one deliberate change:
+    the parent link is the rolling-hash ``parent_key`` instead of a
+    physical block — host entries outlive any one device pool, so a
+    physical pin would dangle the moment a replica dies. ``block``
+    indexes the owning :class:`HostBlockStore`'s arrays; constructing
+    an entry is the ownership handoff for that block."""
+
+    __slots__ = ("key", "parent_key", "block", "tokens")
+
+    def __init__(self, key, parent_key, block: int,
+                 tokens: Tuple[int, ...]):
+        self.key = key
+        self.parent_key = parent_key
+        self.block = int(block)
+        self.tokens = tokens
+
+
+class _HostAllocator:
+    """Refcounted host block accounting — ``BlockAllocator`` semantics
+    (alloc at refcount 1, ref/deref, exact ``leaked()``) minus the
+    trash reservation: the host tier never scatter-writes, so block 0
+    is an ordinary block and an empty store leaks exactly 0."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError("host tier needs at least 1 block")
+        self.num_blocks = int(num_blocks)
+        self.refcount = np.zeros(self.num_blocks, np.int32)
+        self._free: List[int] = list(range(self.num_blocks))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        blk = self._free.pop(0)
+        self.refcount[blk] = 1
+        return blk
+
+    def ref(self, blk: int):
+        if self.refcount[blk] < 1:
+            raise RuntimeError(f"ref on free host block {blk}")
+        self.refcount[blk] += 1
+
+    def deref(self, blk: int):
+        if self.refcount[blk] < 1:
+            raise RuntimeError(f"deref on free host block {blk}")
+        self.refcount[blk] -= 1
+        if self.refcount[blk] == 0:
+            self._free.append(blk)
+
+    def leaked(self) -> int:
+        return int((self.refcount > 0).sum())
+
+
+class HostBlockStore:
+    """Pinned host-RAM pool of int8-at-rest KV blocks + the chain index.
+
+    Per layer: ``k_codes``/``v_codes`` int8 ``[num_blocks, heads,
+    block_size, head_dim]`` and ``k_scale``/``v_scale`` f32
+    ``[num_blocks, heads]`` — the exact at-rest layout of an int8
+    device pool, so one host gigabyte holds ~4x the sessions of a f32
+    pool and int8 device blocks migrate verbatim (lossless round
+    trip). Arrays are preallocated numpy (page-locked where the
+    runtime pins host buffers), never resized.
+
+    The chain index ``_chains`` is an OrderedDict keyed by rolling
+    hash; ``touch`` is move_to_end, so iteration order IS the LRU
+    eviction order, leaf-first exactly like the device prefix cache: a
+    parent carries one pin per resident child (``_children`` counts
+    them so children may arrive *before* their parent during a
+    leaf-first demotion sweep and retro-pin on the parent's insert).
+
+    Unsynchronized on purpose, like ``BlockPool`` — the owning
+    :class:`TierManager` serializes every touch under its lock, which
+    is what makes one store safely fleet-shared."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 block_size: int = 16, num_blocks: Optional[int] = None):
+        from ..flags import get_flags
+        if num_blocks is None:
+            num_blocks = int(get_flags("serving_host_blocks")
+                             ["serving_host_blocks"])
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        shape = (self.num_blocks, self.num_heads, self.block_size,
+                 self.head_dim)
+        sshape = (self.num_blocks, self.num_heads)
+        self.k_codes = [np.zeros(shape, np.int8)
+                        for _ in range(self.num_layers)]
+        self.v_codes = [np.zeros(shape, np.int8)
+                        for _ in range(self.num_layers)]
+        self.k_scale = [np.zeros(sshape, np.float32)
+                        for _ in range(self.num_layers)]
+        self.v_scale = [np.zeros(sshape, np.float32)
+                        for _ in range(self.num_layers)]
+        self.allocator = _HostAllocator(self.num_blocks)
+        self._chains: "OrderedDict[int, _HostEntry]" = OrderedDict()
+        self._children: Dict[int, int] = {}   # parent_key -> resident kids
+        self.peak_used = 0        # high-water blocks referenced
+        self.evictions = 0        # LRU drops under pressure
+
+    # ---------------------------------------------------------- blocks
+    def acquire(self) -> Optional[int]:
+        """Claim one host block at refcount 1, evicting idle chains
+        LRU (leaf-first) while the free list is dry. None when every
+        resident block is still needed (store genuinely full)."""
+        blk = self.allocator.alloc()
+        while blk is None and self._evict_one():
+            blk = self.allocator.alloc()
+        if blk is not None:
+            self.peak_used = max(self.peak_used, self.allocator.num_used)
+        return blk
+
+    def release(self, blk: int):
+        """Return the caller's reference on ``blk`` (the failed-demote
+        unwind; entry-owned refs go through :meth:`drop`)."""
+        self.allocator.deref(blk)
+
+    def leaked(self) -> int:
+        return self.allocator.leaked()
+
+    # ----------------------------------------------------------- chains
+    def get(self, key) -> Optional[_HostEntry]:
+        return self._chains.get(key)
+
+    def has_key(self, key) -> bool:
+        return key in self._chains
+
+    def touch(self, key):
+        """LRU bump — host chains a promote just re-materialized stay
+        resident (fleet dedup: the next worker promotes them too)."""
+        if key in self._chains:
+            self._chains.move_to_end(key)
+
+    def put(self, ent: _HostEntry):
+        """Publish ``ent``, adopting its block reference. Pins the
+        resident parent (if any) and retro-pins ``ent`` once per
+        already-resident child — leaf-first demotion inserts children
+        before parents, so the parent pin can arrive from either
+        side."""
+        if ent.key in self._chains:
+            raise RuntimeError(f"host chain key {ent.key} already resident")
+        self._chains[ent.key] = ent
+        if ent.parent_key is not None:
+            self._children[ent.parent_key] = (
+                self._children.get(ent.parent_key, 0) + 1)
+            parent = self._chains.get(ent.parent_key)
+            if parent is not None:
+                self.allocator.ref(parent.block)
+        for _ in range(self._children.get(ent.key, 0)):
+            self.allocator.ref(ent.block)
+
+    def drop(self, ent: _HostEntry):
+        """Unpublish ``ent``: release its own reference, unpin its
+        resident parent, decrement the parent's child count."""
+        del self._chains[ent.key]
+        self.allocator.deref(ent.block)
+        if ent.parent_key is not None:
+            n = self._children.get(ent.parent_key, 0) - 1
+            if n > 0:
+                self._children[ent.parent_key] = n
+            else:
+                self._children.pop(ent.parent_key, None)
+            parent = self._chains.get(ent.parent_key)
+            if parent is not None:
+                self.allocator.deref(parent.block)
+
+    def _evict_one(self, count: bool = True) -> bool:
+        """Drop the least-recently-used chain entry nobody pins
+        (refcount 1: no resident child). Leaf-first for free, same as
+        ``BlockPool._evict_one_prefix``. ``count=False`` for teardown
+        drops (``flush``) so ``evictions`` reports pressure only."""
+        for key in list(self._chains):
+            ent = self._chains[key]
+            if self.allocator.refcount[ent.block] == 1:
+                self.drop(ent)
+                if count:
+                    self.evictions += 1
+                return True
+        return False
+
+    def flush(self):
+        """Drop every chain entry (tests / teardown). Leaf-first
+        passes until empty — with no outside references the store
+        always drains to ``leaked() == 0``."""
+        while self._chains:
+            if not self._evict_one(count=False):
+                # externally-held refs (a mid-flight unwind) keep the
+                # remaining entries pinned; nothing more to drop here
+                break
+
+    # ----------------------------------------------------------- payload
+    def write(self, blk: int,
+              layers: Sequence[Tuple[np.ndarray, np.ndarray,
+                                     np.ndarray, np.ndarray]]):
+        """Store one block's per-layer ``(k_codes, v_codes, k_scale,
+        v_scale)`` payload into row ``blk``."""
+        for li, (kc, vc, ks, vs) in enumerate(layers):
+            self.k_codes[li][blk] = kc
+            self.v_codes[li][blk] = vc
+            self.k_scale[li][blk] = ks
+            self.v_scale[li][blk] = vs
+
+    def read(self, blk: int) -> List[Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray, np.ndarray]]:
+        """Views (no copy) of row ``blk``'s per-layer payload."""
+        return [(self.k_codes[li][blk], self.v_codes[li][blk],
+                 self.k_scale[li][blk], self.v_scale[li][blk])
+                for li in range(self.num_layers)]
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "host_blocks": self.num_blocks,
+            "host_blocks_used": self.allocator.num_used,
+            "host_blocks_free": self.allocator.num_free,
+            "host_blocks_peak": self.peak_used,
+            "host_chain_entries": len(self._chains),
+            "host_evictions": self.evictions,
+        }
+
+
+class SessionStore:
+    """Conversation contexts by session id — the piece that turns
+    "prefix cache survived" into "conversation resumed": the engine
+    prepends the stored context to the next turn's prompt, so the
+    rolling hash walks the exact chain the previous turn published.
+
+    Unsynchronized like :class:`HostBlockStore`; the owning
+    :class:`TierManager` serializes access."""
+
+    def __init__(self):
+        self._ctx: Dict[str, List[int]] = {}
+        self.resumed = 0          # submits that found a stored context
+
+    def __len__(self) -> int:
+        return len(self._ctx)
+
+    def get(self, sid: str) -> Optional[List[int]]:
+        ctx = self._ctx.get(sid)
+        return None if ctx is None else list(ctx)
+
+    def save(self, sid: str, context: Sequence[int]):
+        self._ctx[sid] = [int(t) for t in context]
+
+    def drop(self, sid: str):
+        self._ctx.pop(sid, None)
+
+    def session_ids(self) -> List[str]:
+        return list(self._ctx)
+
+
+class TierManager:
+    """Device<->host migration policy over one fleet-shared
+    :class:`HostBlockStore` + :class:`SessionStore`.
+
+    One TierManager serves any number of engines/pools (the routers
+    inject a single instance across replicas and roles); every public
+    method takes the cache it operates on and serializes on
+    ``_lock``. Both migration directions run the ``serving.migrate``
+    fault site per attempt under ``RetryPolicy.from_flags`` — a
+    skipped/exhausted demotion leaves the chain on device, a
+    skipped/exhausted promotion falls back to re-prefill, and any
+    block taken mid-attempt is unwound, never leaked."""
+
+    def __init__(self, store: HostBlockStore,
+                 demote_idle_ms: Optional[float] = None):
+        from ..flags import get_flags
+        if demote_idle_ms is None:
+            demote_idle_ms = float(get_flags("serving_demote_idle_ms")
+                                   ["serving_demote_idle_ms"])
+        self.store = store
+        self.sessions = SessionStore()
+        self.demote_idle_ms = float(demote_idle_ms)
+        self._lock = _ccz.make_lock("kv_tier._lock")
+        self._migrated = {"demote": 0, "promote": 0}  # guarded-by: _lock
+        self._dedup_blocks = 0                        # guarded-by: _lock
+        self._resident: Dict[str, int] = {}           # guarded-by: _lock
+        self._resumed = 0                             # guarded-by: _lock
+        self.sessions_peak = 0                        # guarded-by: _lock
+        # demotion staging: two alternating host scratch buffers per
+        # direction of the copy — the device->host transfer for block
+        # N lands in one while block N-1 quantizes out of the other,
+        # so a sweep between steps never stalls the next decode launch
+        self._stage = None
+        self._stage_i = 0
+        self._mig_demote_c = _obs.counter(
+            "serving_kv_migrations",
+            "KV blocks migrated across the host tier, by direction"
+            ).labels(dir="demote")
+        self._mig_promote_c = _obs.counter(
+            "serving_kv_migrations",
+            "KV blocks migrated across the host tier, by direction"
+            ).labels(dir="promote")
+        self._host_used_g = _obs.gauge(
+            "serving_kv_blocks_used",
+            "physical KV blocks currently referenced (paged serving)"
+            ).labels(tier="host")
+        self._host_free_g = _obs.gauge(
+            "serving_kv_blocks_free",
+            "physical KV blocks on the free list (paged serving)"
+            ).labels(tier="host")
+        self._sess_resident_g = _obs.gauge(
+            "serving_sessions_resident",
+            "sessions with a request currently queued or decoding")
+        self._sess_host_g = _obs.gauge(
+            "serving_sessions_host",
+            "idle sessions whose context is stored in the host tier, "
+            "resumable via submit(session=...)")
+        self._sess_resumed_g = _obs.gauge(
+            "serving_sessions_resumed",
+            "submits that resumed a stored session context "
+            "(re-prefilling only the unshared suffix)")
+        self._update_gauges()
+        _ccz.declare_guarded(self, {
+            "_migrated": "_lock", "_dedup_blocks": "_lock",
+            "_resident": "_lock", "_resumed": "_lock",
+            "sessions_peak": "_lock",
+        })
+
+    # ------------------------------------------------------------ wiring
+    def attach(self, cache: BlockKVCache) -> "TierManager":
+        """Validate ``cache``'s pool geometry against the store and
+        hand back self (the engine-ctor one-liner). Any number of
+        same-geometry caches may attach — that sharing IS the fleet
+        dedup."""
+        pool = cache.pool
+        want = (self.store.num_layers, self.store.num_heads,
+                self.store.head_dim, self.store.block_size)
+        have = (pool.num_layers, pool.num_heads, pool.head_dim,
+                pool.block_size)
+        if want != have:
+            raise ValueError(
+                f"kv_tier geometry mismatch: host store has "
+                f"(layers, heads, head_dim, block_size)={want}, "
+                f"device pool has {have}")
+        return self
+
+    # ---------------------------------------------------------- demotion
+    def demote(self, cache: BlockKVCache, keys=None,
+               budget: Optional[int] = None) -> Tuple[int, int]:
+        """Demote cold device prefix entries (refcount 1 — no live
+        request, no resident child) into the host store, leaf-first in
+        LRU order. ``keys`` restricts to an eligible set (the engine's
+        idle-window filter); ``budget`` caps entries per sweep.
+        Returns ``(entries_demoted, blocks_copied)`` — the difference
+        is fleet dedup: an entry whose chain block is already
+        host-resident frees its device block with zero copies."""
+        pool = cache.pool
+        with self._lock:
+            policy = RetryPolicy.from_flags("serving.migrate")
+            by_block = {e.block: e for e in pool._prefix.values()}
+            entries = blocks = 0
+            progress = True
+            while progress:     # children free their parents mid-sweep
+                progress = False
+                for key in list(pool._prefix):
+                    if budget is not None and entries >= budget:
+                        progress = False
+                        break
+                    ent = pool._prefix.get(key)
+                    if ent is None:
+                        continue
+                    if keys is not None and key not in keys:
+                        continue
+                    if pool.allocator.refcount[ent.block] != 1:
+                        continue
+                    parent_key = None
+                    if ent.parent_block is not None:
+                        parent = by_block.get(ent.parent_block)
+                        if parent is None:
+                            continue    # orphaned pin; leave on device
+                        parent_key = parent.key
+                    try:
+                        moved = policy.call(self._demote_attempt, pool,
+                                            ent, parent_key)
+                    except (_MigrationSkip, RetryError):
+                        continue        # fault policy: stays on device
+                    if moved is None:
+                        progress = False
+                        break           # host tier genuinely full
+                    if moved < 0:
+                        continue        # hash collision: keep on device
+                    by_block.pop(ent.block, None)
+                    pool._drop_entry(ent)
+                    entries += 1
+                    blocks += moved
+                    progress = True
+                    if moved == 0:
+                        self._dedup_blocks += 1
+                else:
+                    continue
+                break
+            if entries:
+                self._migrated["demote"] += blocks
+                self._mig_demote_c.add(blocks)
+                self._update_gauges()
+                if _runlog.enabled():
+                    _runlog.log_event(
+                        "serving_kv_demote", entries=entries,
+                        blocks=blocks, dedup=entries - blocks
+                        if blocks < entries else 0,
+                        host_used=self.store.allocator.num_used)
+            return entries, blocks
+
+    def _demote_attempt(self, pool, ent, parent_key):  # holds: _lock
+        """One retried demotion: returns blocks copied (0 = host
+        already held the chain — dedup), None when the host store is
+        full, -1 on a key collision (different tokens under the same
+        hash: keep the device copy, host wins ties fleet-wide)."""
+        kind = fault_point("serving.migrate")
+        if kind == "skip":
+            raise _MigrationSkip("serving.migrate skip")
+        held = self.store.get(ent.key)
+        if held is not None:
+            if held.tokens != ent.tokens:
+                return -1
+            self.store.touch(ent.key)
+            return 0
+        hb = self.store.acquire()
+        if hb is None:
+            return None
+        self.store.write(hb, self._stage_out(pool, ent.block))
+        self.store.put(_HostEntry(ent.key, parent_key, hb, ent.tokens))
+        return 1
+
+    def _stage_out(self, pool, blk):  # holds: _lock
+        """Pull one device block to host through the double buffer and
+        encode it at rest: int8 pools hand over codes + scales
+        verbatim (lossless), f32/bf16 pools quantize on the
+        ``quantize_int8`` grid with fresh per-head absmax scales."""
+        out = []
+        if pool.kv_dtype == "int8":
+            for (k, v, ks, vs) in pool.layers:
+                out.append((np.asarray(k[blk]), np.asarray(v[blk]),
+                            np.asarray(ks[blk]), np.asarray(vs[blk])))
+            return out
+        if self._stage is None:
+            shape = (pool.num_heads, pool.block_size, pool.head_dim)
+            self._stage = tuple(
+                [np.zeros(shape, np.float32) for _ in range(2)]
+                for _ in range(2 * pool.num_layers))
+        for li, (k, v) in enumerate(pool.layers):
+            kbuf = self._stage[2 * li][self._stage_i]
+            vbuf = self._stage[2 * li + 1][self._stage_i]
+            np.copyto(kbuf, np.asarray(k[blk], np.float32))
+            np.copyto(vbuf, np.asarray(v[blk], np.float32))
+            ks = np.max(np.abs(kbuf), axis=(1, 2))
+            vs = np.max(np.abs(vbuf), axis=(1, 2))
+            out.append((_np_quantize(kbuf, ks[:, None, None]),
+                        _np_quantize(vbuf, vs[:, None, None]), ks, vs))
+        self._stage_i ^= 1
+        return out
+
+    # --------------------------------------------------------- promotion
+    def promote(self, cache: BlockKVCache, prompt: Sequence[int]) -> int:
+        """Copy the host-resident continuation of ``prompt``'s prefix
+        chain back into ``cache``'s pool and republish it as device
+        prefix entries, so the subsequent ``acquire()`` shares it like
+        any warm prefix. All-or-nothing: if the pool cannot hold the
+        whole continuation the attempt unwinds and returns 0 (the
+        caller re-prefills — correct, just slower). Host copies stay
+        resident for the rest of the fleet. Returns blocks promoted."""
+        if not cache.prefix_cache_enabled:
+            return 0
+        pool = cache.pool
+        with self._lock:
+            plan = self._promote_plan(pool, prompt)
+            if plan is None:
+                return 0
+            try:
+                n = RetryPolicy.from_flags("serving.migrate").call(
+                    self._promote_attempt, pool, plan)
+            except (_MigrationSkip, RetryError):
+                return 0
+            if n:
+                self._migrated["promote"] += n
+                self._mig_promote_c.add(n)
+                self._update_gauges()
+                if _runlog.enabled():
+                    _runlog.log_event(
+                        "serving_kv_promote", blocks=n,
+                        tokens=n * pool.block_size,
+                        device_free=pool.allocator.num_free)
+            return n
+
+    def _promote_plan(self, pool, prompt):  # holds: _lock
+        """Walk ``prompt``'s rolling-hash chain: past the
+        device-resident prefix, collect the consecutive host-resident
+        (token-verified) continuation. None when the device chain
+        already covers everything the host knows."""
+        bs = pool.block_size
+        key = None
+        tail = None          # deepest device-resident entry (pin point)
+        cands = []
+        for i in range(len(prompt) // bs):
+            chunk = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
+            key = hash((key, chunk))
+            dent = pool._prefix.get(key)
+            if dent is not None and dent.tokens == chunk:
+                if cands:
+                    break    # device re-entry past a host gap: unusable
+                tail = dent
+                continue
+            hent = self.store.get(key)
+            if hent is None or hent.tokens != chunk:
+                break
+            cands.append((key, chunk, hent))
+        if not cands:
+            return None
+        return (tail, cands)
+
+    def _promote_attempt(self, pool, plan):  # holds: _lock
+        """One retried promotion. The fault point fires before any
+        block is taken, so an injected raise is leak-free by
+        construction; the alloc loop unwinds on shortfall."""
+        kind = fault_point("serving.migrate")
+        if kind == "skip":
+            raise _MigrationSkip("serving.migrate skip")
+        tail, cands = plan
+        # protect the device chain being extended: alloc_block's
+        # prefix eviction only takes refcount-1 entries, and the
+        # extra ref keeps the matched leaf (hence, via child pins,
+        # the whole chain) off the table
+        if tail is not None:
+            pool.allocator.ref(tail.block)
+        try:
+            taken: List[int] = []
+            for _ in cands:
+                blk = pool.alloc_block()
+                if blk is None:
+                    pool.release_blocks(taken)
+                    return 0    # all-or-nothing under pool pressure
+                taken.append(blk)
+            self._copy_in(pool, taken, [c[2].block for c in cands])
+            parent = tail
+            for (key, chunk, _hent), blk in zip(cands, taken):
+                pin = None
+                if parent is not None:
+                    pin = parent.block
+                    pool.allocator.ref(pin)
+                pool._prefix[key] = _PrefixEntry(key, pin, blk, chunk)
+                self.store.touch(key)   # stays host-resident: fleet dedup
+                parent = pool._prefix[key]
+            return len(taken)
+        finally:
+            if tail is not None:
+                pool.allocator.deref(tail.block)
+
+    def _copy_in(self, pool, dst_blocks, src_blocks):  # holds: _lock
+        """Batched host->device copy: one ``.at[dst].set()`` per pool
+        array per layer (eager writes, not traced — the zero-compile
+        property ``predict_serving_compiles`` asserts)."""
+        dst = np.asarray(dst_blocks, np.int32)
+        new_layers = []
+        for li, layer in enumerate(pool.layers):
+            kc = np.stack([self.store.k_codes[li][b] for b in src_blocks])
+            vc = np.stack([self.store.v_codes[li][b] for b in src_blocks])
+            ks = np.stack([self.store.k_scale[li][b] for b in src_blocks])
+            vs = np.stack([self.store.v_scale[li][b] for b in src_blocks])
+            if pool.kv_dtype == "int8":
+                k, v, ksp, vsp = layer
+                new_layers.append((k.at[dst].set(kc), v.at[dst].set(vc),
+                                   ksp.at[dst].set(ks),
+                                   vsp.at[dst].set(vs)))
+            else:
+                k, v = layer
+                kf = _np_dequantize(kc, ks[:, :, None, None])
+                vf = _np_dequantize(vc, vs[:, :, None, None])
+                new_layers.append((k.at[dst].set(kf.astype(k.dtype)),
+                                   v.at[dst].set(vf.astype(v.dtype))))
+        pool.layers = new_layers
+
+    # ---------------------------------------------------------- sessions
+    def session_context(self, sid: str) -> Optional[List[int]]:
+        with self._lock:
+            return self.sessions.get(sid)
+
+    def session_started(self, sid: str):
+        """A request for ``sid`` was admitted to some engine's queue."""
+        with self._lock:
+            self._resident[sid] = self._resident.get(sid, 0) + 1
+            self._bump_session_peak()
+            self._update_gauges()
+
+    def session_released(self, sid: str):
+        """A request for ``sid`` left the engine (finished, shed, or
+        canceled) — the resident gauge drops, the stored context (if
+        the request finished) stays resumable."""
+        with self._lock:
+            n = self._resident.get(sid, 0) - 1
+            if n > 0:
+                self._resident[sid] = n
+            else:
+                self._resident.pop(sid, None)
+            self._update_gauges()
+
+    def session_resumed(self, sid: str, stored_tokens: int,
+                        prompt_tokens: int):
+        with self._lock:
+            self.sessions.resumed += 1
+            self._resumed += 1
+            self._update_gauges()
+            if _runlog.enabled():
+                _runlog.log_event(
+                    "serving_session_resume", session=sid,
+                    stored_tokens=stored_tokens,
+                    prompt_tokens=prompt_tokens)
+
+    def session_save(self, sid: str, context: Sequence[int]):
+        with self._lock:
+            self.sessions.save(sid, context)
+            self._bump_session_peak()
+            self._update_gauges()
+
+    def _bump_session_peak(self):  # holds: _lock
+        live = set(self._resident)
+        live.update(self.sessions.session_ids())
+        if len(live) > self.sessions_peak:
+            self.sessions_peak = len(live)
+
+    # -------------------------------------------------------- accounting
+    def has_chain(self, key) -> bool:
+        """True when the host store holds an entry for this chain key —
+        the fleet prefix index asks this to keep (or convert) affinity
+        entries whose device copy died with a worker."""
+        with self._lock:
+            return self.store.has_key(key)
+
+    def leaked(self) -> int:
+        """Host blocks still referenced — the host half of the
+        fleet-wide zero-leak identity (``flush()`` first to drop chain
+        residency, exactly like ``flush_prefix_cache`` on device)."""
+        with self._lock:
+            return self.store.leaked()
+
+    def flush(self):
+        with self._lock:
+            self.store.flush()
+            self._update_gauges()
+
+    def _update_gauges(self):  # holds: _lock
+        self._host_used_g.set(self.store.allocator.num_used)
+        self._host_free_g.set(self.store.allocator.num_free)
+        self._sess_resident_g.set(len(self._resident))
+        self._sess_host_g.set(len(self.sessions))
+        self._sess_resumed_g.set(self._resumed)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.store.stats())
+            out.update({
+                "migrated_demote_blocks": self._migrated["demote"],
+                "migrated_promote_blocks": self._migrated["promote"],
+                "demote_dedup_entries": self._dedup_blocks,
+                "sessions_resident": len(self._resident),
+                "sessions_host": len(self.sessions),
+                "sessions_resumed": self._resumed,
+                "sessions_peak": self.sessions_peak,
+            })
+            return out

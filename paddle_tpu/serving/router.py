@@ -209,6 +209,30 @@ class ReplicaRouter:
                     model.gpt.cfg, rank,
                     int(mx if mx is not None
                         else gl["serving_lora_max_adapters"]))
+        if model is not None and \
+                "kv_tier" not in self._engine_kwargs:
+            # same sharing shape for the host KV tier: ONE fleet-wide
+            # HostBlockStore + TierManager, so a chain demoted by any
+            # replica is promotable by every other (a shared system
+            # prompt is materialized once per fleet, not once per
+            # pool) and sessions resume on whichever replica the
+            # router picks. Scale-ups and restarts inherit it through
+            # the saved kwargs; a killed replica's device refs die
+            # with its pool while its host chains stay promotable.
+            gt = _flags.get_flags(["serving_host_tier",
+                                   "serving_host_blocks",
+                                   "serving_block_size"])
+            if gt["serving_host_tier"]:
+                from .kv_tier import HostBlockStore, TierManager
+                cfg = model.gpt.cfg
+                bs = self._engine_kwargs.get("block_size")
+                bs = int(bs if bs is not None
+                         else gt["serving_block_size"])
+                self._engine_kwargs["kv_tier"] = TierManager(
+                    HostBlockStore(
+                        cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                        block_size=bs,
+                        num_blocks=int(gt["serving_host_blocks"])))
         engine_kwargs = self._engine_kwargs
         if engines is not None:
             if model is not None or engine_kwargs:
@@ -235,6 +259,11 @@ class ReplicaRouter:
                         autoscale.max_replicas)
             self.engines = [ServingEngine(model, **engine_kwargs)
                             for _ in range(n)]  # guarded-by: _lock
+        # the fleet-shared host KV tier (None when off) — also
+        # reachable as engines[i].kv_tier; prebuilt engines carry
+        # their own
+        self.kv_tier = (engine_kwargs.get("kv_tier") or
+                        getattr(self.engines[0], "kv_tier", None))
         self._draining = False              # guarded-by: _lock
         self._lock = _ccz.make_lock("router._lock")
         self._retiring: List[ServingEngine] = []  # guarded-by: _lock

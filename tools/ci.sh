@@ -43,7 +43,11 @@
 #      decode/prefill compile budget, run-log events feed
 #      tools/trace_summary.py; per-request tracing blame identity +
 #      Perfetto export + /v1/requests/<id> debug endpoint, with the
-#      recompile predictor proving tracing never compiles)
+#      recompile predictor proving tracing never compiles; plus the
+#      host-KV-tier session phase: a two-turn session demoted to
+#      host RAM and resumed token-identically, migration/session
+#      metrics and run-log events minted, predictor agreeing
+#      host_tier/sessions are validated no-ops)
 #  10. loadgen SLO gate (seeded open-loop traffic through the
 #      SLO-admitting gpt2-tiny engine: goodput > 0 with attainment
 #      reported and zero leaked KV blocks, then the chaos crossover —
@@ -56,7 +60,12 @@
 #      traffic with a deterministic straggler replica, a mid-run
 #      chaos kill and 10% client abandonment (disconnect -> cancel
 #      with full reclaim), where the hedged arm must beat the
-#      unhedged arm's goodput at zero leaks / zero new compiles)
+#      unhedged arm's goodput at zero leaks / zero new compiles —
+#      and the returning-users host-tier gate: seeded multi-turn
+#      session traffic that parks MORE concurrent sessions than the
+#      device pool has KV blocks (idle chains demoted to the pinned
+#      host pool, promoted back token-identically on resume), at
+#      zero leaks in both tiers and zero new compiles after warmup)
 #  11. chaos soak gate (hours of seeded diurnal traffic on the virtual
 #      clock with replica kills injected at virtual instants and
 #      auto-restart healing the fleet: goodput > 0 in every window,
@@ -148,6 +157,12 @@ if [[ "${1:-}" != "quick" ]]; then
   # zero extra compiles; affinity routing beats least-loaded on shared
   # prefixes; killing a prefill worker mid-handoff leaks nothing
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving_disagg.py -q
+  echo "   host KV tier gate (session park/resume + fleet dedup)"
+  # sessions demoted to the pinned host pool resume token-identically
+  # (incl. spec K=2, int8 KV, LoRA pins), promotion is all-or-nothing,
+  # one fleet-shared store dedups chains across workers, and chaos at
+  # serving.replica + serving.migrate leaks zero blocks on either tier
+  JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q
 else
   echo "== 7/16 serving plane: reduced subset (quick mode)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
@@ -165,6 +180,10 @@ or head_sharded or drain or chaos_skip"
     -q -m "not slow" \
     -k "matches_symmetric or zero_compiles or backpressure \
 or flag_parsing"
+  echo "   host KV tier gate: reduced subset (quick mode)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q \
+    -k "(resumes_token_identical and greedy) or fleet_dedup \
+or all_or_nothing or evicts_lru or session_store"
 fi
 
 echo "== 8/16 speculative decoding gate"
@@ -346,6 +365,39 @@ print(f"   hedging: goodput {gh}/s vs {gu}/s unhedged, "
       f"{h['abandoned']} abandoned -> canceled, 0 leaks, 0 new compiles")
 PY
 rm -f "$HEDGED_JSON" "$UNHEDGED_JSON"
+echo "   returning users (host KV tier: park sessions > device blocks)"
+# the million-session contract: seeded multi-turn session traffic on
+# the virtual clock where each returning user's idle gap demotes their
+# KV chain to the pinned host pool (serving.migrate is fault-eligible)
+# and the next turn promotes it back token-identically. The run must
+# park strictly more concurrent sessions than the device pool has KV
+# blocks (the capacity headroom comes from host RAM, not HBM), resume
+# at least one session, leak zero blocks in BOTH tiers, and compile
+# nothing new after warmup — migrations are host-side numpy surgery,
+# never a jit input. The trace replays byte-identically from seed 3.
+JAX_PLATFORMS=cpu python tools/loadgen.py --model gpt2-tiny \
+  --mode poisson --rate "$LG_RATE" --duration "$LG_DURATION" --seed 3 \
+  --slots 1 --max-len 64 --buckets 8,16,32 --prompt-tokens 4:8 \
+  --new-tokens 2:4 --returning-frac 0.9 --turns-per-session 2:3 \
+  --host-blocks 64 --demote-idle-ms 0 --virtual-step-ms 5 --json \
+  --expect-resumed-min 1 --expect-zero-leaks \
+  --expect-zero-new-compiles --expect-capacity-gt-device \
+  | JAX_PLATFORMS=cpu python -c "
+import json, sys
+r = json.loads(sys.stdin.read())
+assert r['exceptions'] == 0, r
+s = r['sessions']
+assert s['sessions_resumed'] >= 1, s
+assert s['sessions_peak'] > s['device_blocks'], s
+assert s['leaked_host_blocks'] == 0 and r['leaked_kv_blocks'] == 0, r
+assert r['new_compiles_after_warmup'] == 0, r
+assert s['migrated_demote_blocks'] >= s['migrated_promote_blocks'] >= 1, s
+print(f\"   sessions: {s['sessions_peak']} peak on \"
+      f\"{s['device_blocks']} device blocks, \"
+      f\"{s['sessions_resumed']} resumed, \"
+      f\"{s['migrated_demote_blocks']}/{s['migrated_promote_blocks']} \"
+      f\"blocks demoted/promoted, 0 leaks both tiers, 0 new compiles\")
+"
 
 echo "== 11/16 chaos soak gate (virtual-clock fleet fault tolerance)"
 # hours of seeded diurnal traffic compressed into seconds on the

@@ -77,6 +77,21 @@ vs offered load, win rate, and the duplicated-token cost of racing
 (``--straggler I:MS`` makes replica I a deterministic straggler for
 the hedge to beat).
 
+Returning users (``--returning-frac F --turns-per-session A:B``,
+needs ``--host-blocks N`` for the host KV tier): a seeded fraction of
+arrivals open a multi-turn session — turn 1 is the arrival itself,
+follow-up turns arrive after idle gaps (long enough for the demotion
+sweep to park the context in host RAM) and submit with
+``session=<id>`` so the engine prepends the stored context and
+resumes token-identically off a host-promoted chain. Session draws
+come from a dedicated RandomState (session-free seeds keep their
+byte-identical traces) and ride the trace rows as column 11, so a
+returning-users workload replays byte for byte; the report grows a
+``sessions`` section — offered/turns/resumed, host-block peaks, the
+zero-leak identity for the host half, and the sessions-beyond-HBM
+capacity gate (``--expect-capacity-gt-device``: peak concurrent
+sessions must exceed the device pool's block count).
+
 Chaos replay: a trace may carry a ``chaos`` schedule (rows of
 ``[t, kind, index]``, kind in kill | restart | kill_decode —
 ``tools/trace_convert.py`` extracts them from a live run's
@@ -132,6 +147,10 @@ class Arrival(NamedTuple):
     # (fleet cancel) once this fraction of the new-token budget has
     # been produced — the abandonment workload; 0 = patient client
     abandon_after: float = 0.0
+    # returning-user conversation id ("" = one-shot request): turns
+    # sharing a session submit with session=<id> so the host KV tier
+    # resumes the stored context after an idle gap
+    session: str = ""
 
 
 class VirtualClock:
@@ -179,7 +198,9 @@ class LoadGen:
                  tenant_mix: Optional[dict] = None,
                  closed_loop: int = 0,
                  think_time_ms: Tuple[float, float] = (0.0, 0.0),
-                 abandon_frac: float = 0.0):
+                 abandon_frac: float = 0.0,
+                 returning_frac: float = 0.0,
+                 turns_per_session: Tuple[int, int] = (2, 4)):
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
                              f"got {mode!r}")
@@ -247,6 +268,21 @@ class LoadGen:
             raise ValueError("abandon_frac must be in [0, 1]")
         self.abandon_frac = float(abandon_frac)
         self._abandon = self.abandon_frac > 0
+        # Returning users: a seeded fraction of arrivals open a
+        # multi-turn session — follow-up turns arrive after an idle
+        # gap and submit with session=<id> so the host KV tier resumes
+        # the stored context. All draws come from a dedicated
+        # RandomState, so session-free seeds keep their byte-identical
+        # traces.
+        if not (0.0 <= float(returning_frac) <= 1.0):
+            raise ValueError("returning_frac must be in [0, 1]")
+        ta, tb = (int(turns_per_session[0]), int(turns_per_session[1]))
+        if ta < 1 or tb < ta:
+            raise ValueError(
+                "turns_per_session must satisfy 1 <= A <= B")
+        self.returning_frac = float(returning_frac)
+        self.turns_per_session = (ta, tb)
+        self._returning = self.returning_frac > 0
         #: chaos schedule replayed alongside the arrivals: dicts of
         #: {"t", "kind", "index"}; populated by from_trace or by hand
         self.chaos: List[dict] = []
@@ -278,12 +314,18 @@ class LoadGen:
                          int(row[7]), str(row[8]))
             if len(row) > 9:   # abandonment-bearing rows: col 10
                 extra = extra + (float(row[9]),)
+            if len(row) > 10:  # session-bearing rows: col 11
+                extra = extra + (str(row[10]),)
             arrivals.append(Arrival(float(t),
                                     tuple(int(x) for x in prompt),
                                     int(mnt), int(pri), *extra))
         last_t = max((a.t for a in arrivals), default=0.0)
         duration = float(meta.get("duration") or 0.0)
-        if duration <= last_t:
+        if duration <= 0:
+            # metadata-free trace: synthesize a window covering the
+            # recorded arrivals (session follow-up turns legitimately
+            # land past the recorded window, so a recorded duration is
+            # kept verbatim — byte-identical re-serialization)
             duration = last_t + 1e-6 if arrivals else 1.0
         rate = float(meta.get("rate") or 0.0)
         if rate <= 0:
@@ -300,6 +342,10 @@ class LoadGen:
         lg._abandon = any(len(r) > 9 for r in trace["arrivals"])
         if lg._abandon:
             lg.abandon_frac = 1.0   # marker; the schedule rows govern
+        # session-bearing traces re-serialize byte-identically too
+        lg._returning = any(len(r) > 10 for r in trace["arrivals"])
+        if lg._returning:
+            lg.returning_frac = 1.0   # marker; the rows govern
         # chaos rows ([t, kind, index]) replay kill/restart schedules
         lg.chaos = [{"t": float(r[0]), "kind": str(r[1]),
                      "index": int(r[2])}
@@ -405,6 +451,39 @@ class LoadGen:
             if keep:
                 out.append(Arrival(round(t, 9), prompt, mnt, pri,
                                    *extra, abandon_after=ab))
+        if self._returning and out:
+            # A seeded fraction of arrivals open a session: the
+            # arrival itself becomes turn 1 and T-1 follow-up turns
+            # arrive after idle gaps long enough for the demotion
+            # sweep to park the context in the host tier. Every draw
+            # comes from this dedicated stream, so returning-free
+            # seeds keep their byte-identical traces.
+            sess_rng = np.random.RandomState(
+                (self.seed * 2654435761 + 163) % (2 ** 32))
+            followups: List[Arrival] = []
+            sid = 0
+            for j, a in enumerate(out):
+                if float(sess_rng.uniform()) >= self.returning_frac:
+                    continue
+                sid += 1
+                lo, hi = self.turns_per_session
+                turns = int(sess_rng.randint(lo, hi + 1))
+                out[j] = a._replace(session=str(sid))
+                t = a.t
+                for _ in range(turns - 1):
+                    gap = float(sess_rng.uniform(0.25, 1.0)) * \
+                        max(self.duration, 1e-3)
+                    t = t + gap
+                    plen = self._sample_span(sess_rng,
+                                             *self.prompt_tokens)
+                    mnt = self._sample_span(sess_rng,
+                                            *self.new_tokens)
+                    prompt = tuple(int(x) for x in sess_rng.randint(
+                        1, self.vocab_size, size=plen))
+                    followups.append(Arrival(
+                        round(t, 9), prompt, mnt, a.priority,
+                        session=str(sid)))
+            out = sorted(out + followups, key=lambda a: a.t)
         self._schedule = out
         return out
 
@@ -414,13 +493,18 @@ class LoadGen:
         rows = []
         for a in self.schedule():
             row = [a.t, list(a.prompt), a.max_new_tokens, a.priority]
-            if self._decoded or self._abandon:
-                # decode-bearing rows carry 5 more; abandonment rows
-                # pad them (greedy defaults) so col 10 stays col 10
+            if self._decoded or self._abandon or self._returning:
+                # decode-bearing rows carry 5 more; abandonment and
+                # session rows pad them (greedy defaults) so col 10
+                # stays col 10
                 row += [a.temperature, a.top_k, a.top_p, a.seed,
                         a.tenant]
-            if self._abandon:   # abandonment-bearing rows add col 10
+            if self._abandon or self._returning:
+                # abandonment-bearing rows add col 10; session rows
+                # pad it so col 11 stays col 11
                 row.append(a.abandon_after)
+            if self._returning:   # session-bearing rows add col 11
+                row.append(a.session)
             rows.append(row)
         payload = {
             "mode": self.mode, "rate": self.rate,
@@ -469,6 +553,7 @@ class LoadGen:
                     "sampled": a.temperature > 0,
                     "tenant": a.tenant,
                     "abandon_after": a.abandon_after,
+                    "session": a.session,
                     "abandoned": False, "outcome": None,
                     "reason": None, "req": None}
                    for i, a in enumerate(arrivals)]
@@ -488,6 +573,8 @@ class LoadGen:
                           top_p=arr.top_p, seed=arr.seed)
             if arr.tenant:
                 kw["tenant"] = arr.tenant
+            if arr.session:
+                kw["session"] = arr.session
             try:
                 rec["req"] = target.submit(
                     list(arr.prompt), max_new_tokens=arr.max_new_tokens,
@@ -781,6 +868,40 @@ class LoadGen:
                     seen_pools.add(id(pool))
                     leaked_pages += pool.leaked()
             report["leaked_lora_pages"] = leaked_pages
+        if self._returning:
+            # returning-users section: session volume straight from
+            # the records, residency/migration/resume accounting from
+            # the fleet-shared tier, and the zero-leak identity for
+            # the host half (flush first, like the device pools above)
+            tier = next(
+                (e.kv_tier for e in self._engines(target)
+                 if getattr(e, "kv_tier", None) is not None), None)
+            sess: dict = {
+                "sessions_offered": len({r["session"] for r in records
+                                         if r["session"]}),
+                "session_turns": sum(1 for r in records
+                                     if r["session"]),
+            }
+            dev_blocks = next(
+                (e.cache.allocator.num_blocks
+                 for e in self._engines(target)
+                 if getattr(e, "paged", False)), 0)
+            sess["device_blocks"] = dev_blocks
+            if tier is not None:
+                ts = tier.stats()
+                sess.update(
+                    sessions_resumed=ts["sessions_resumed"],
+                    sessions_peak=ts["sessions_peak"],
+                    host_blocks=ts["host_blocks"],
+                    host_blocks_peak=ts["host_blocks_peak"],
+                    host_evictions=ts["host_evictions"],
+                    migrated_demote_blocks=ts["migrated_demote_blocks"],
+                    migrated_promote_blocks=ts[
+                        "migrated_promote_blocks"],
+                    demote_dedup_entries=ts["demote_dedup_entries"])
+                tier.flush()
+                sess["leaked_host_blocks"] = tier.leaked()
+            report["sessions"] = sess
         stats = getattr(target, "stats", None)
         st = stats() if callable(stats) else {}
         if "hedges" in st:
@@ -827,10 +948,18 @@ def warmup(target, max_new_tokens: int = 2):
             except QueueFullError:
                 target.run_until_idle()
     target.run_until_idle()
+    tiers = set()
     for e in engines:
         e.reset_cost_estimates()
         if e.paged:
             e.cache.flush_prefix_cache()
+        # warmup chains demoted by the between-steps sweep would sit
+        # in the (fleet-shared) host store; flush it once so measured
+        # traffic starts from an empty tier
+        tier = getattr(e, "kv_tier", None)
+        if tier is not None and id(tier) not in tiers:
+            tiers.add(id(tier))
+            tier.flush()
 
 
 # ------------------------------------------------------------------ CLI
@@ -899,6 +1028,28 @@ def main(argv=None) -> int:
                     "dedicated stream; each fires a fleet cancel once "
                     "25-75%% of its token budget has landed); "
                     "requires --closed-loop")
+    ap.add_argument("--returning-frac", type=float, default=0.0,
+                    metavar="F", help="fraction of arrivals that open "
+                    "a multi-turn session (seeded draws from a "
+                    "dedicated stream): follow-up turns arrive after "
+                    "idle gaps and submit with session=<id> so the "
+                    "host KV tier resumes the stored context; "
+                    "requires --host-blocks")
+    ap.add_argument("--turns-per-session", type=_parse_range,
+                    default=(2, 4), metavar="A:B",
+                    help="returning-users turns per session, uniform "
+                    "on [A, B] from the session stream")
+    ap.add_argument("--host-blocks", type=int, default=0,
+                    metavar="N", help="> 0 turns on the host-RAM KV "
+                    "tier (FLAGS_serving_host_tier) with N host "
+                    "blocks — cold chains demote int8-at-rest and "
+                    "sessions park/resume through the fleet-shared "
+                    "store")
+    ap.add_argument("--demote-idle-ms", type=float, default=None,
+                    metavar="MS", help="FLAGS_serving_demote_idle_ms "
+                    "for the run: how long (engine clock) a prefix "
+                    "entry must sit cold before the sweep demotes it "
+                    "(0 = every step; default: the flag)")
     ap.add_argument("--priority-mix", type=_parse_mix, default=None,
                     metavar="P:W,P:W", help="priority class weights, "
                     "e.g. '0:0.1,1:0.8,2:0.1' (lower = more urgent)")
@@ -996,6 +1147,15 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-sheds-min", type=int, default=None,
                     help="exit 1 unless shed_total >= this (chaos runs "
                     "must actually shed)")
+    ap.add_argument("--expect-resumed-min", type=int, default=None,
+                    help="exit 1 unless sessions_resumed >= this "
+                    "(returning-users runs must actually resume)")
+    ap.add_argument("--expect-capacity-gt-device",
+                    action="store_true",
+                    help="exit 1 unless the peak concurrent-session "
+                    "count exceeds the device pool's block count — "
+                    "the sessions-beyond-HBM capacity gate (host "
+                    "tier on)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1018,6 +1178,18 @@ def main(argv=None) -> int:
               "(abandonment is a client hang-up mid-decode)",
               file=sys.stderr)
         return 1
+    if args.returning_frac and args.host_blocks <= 0:
+        print("FAIL: --returning-frac needs --host-blocks > 0 "
+              "(session resume parks context in the host KV tier)",
+              file=sys.stderr)
+        return 1
+    if args.host_blocks > 0:
+        from paddle_tpu import flags as _fl
+        tier_flags = {"serving_host_tier": True,
+                      "serving_host_blocks": args.host_blocks}
+        if args.demote_idle_ms is not None:
+            tier_flags["serving_demote_idle_ms"] = args.demote_idle_ms
+        _fl.set_flags(tier_flags)
     if args.replay:
         lg = LoadGen.from_trace(args.replay)
         if args.closed_loop:
@@ -1034,7 +1206,9 @@ def main(argv=None) -> int:
                      tenant_mix=args.tenant_mix,
                      closed_loop=args.closed_loop,
                      think_time_ms=args.think_time_ms,
-                     abandon_frac=args.abandon_frac)
+                     abandon_frac=args.abandon_frac,
+                     returning_frac=args.returning_frac,
+                     turns_per_session=args.turns_per_session)
     if args.chaos:
         for part in args.chaos.split(","):
             t_s, kind, idx = part.split(":")
@@ -1181,6 +1355,24 @@ def main(argv=None) -> int:
             report["shed_total"] < args.expect_sheds_min:
         print(f"FAIL: shed_total {report['shed_total']} < "
               f"{args.expect_sheds_min}", file=sys.stderr)
+        ok = False
+    sess = report.get("sessions", {})
+    if args.expect_resumed_min is not None:
+        r = sess.get("sessions_resumed")
+        if r is None or r < args.expect_resumed_min:
+            print(f"FAIL: sessions_resumed {r} < "
+                  f"{args.expect_resumed_min}", file=sys.stderr)
+            ok = False
+    if args.expect_capacity_gt_device:
+        peak, dev = sess.get("sessions_peak"), sess.get(
+            "device_blocks", 0)
+        if peak is None or peak <= dev:
+            print(f"FAIL: sessions_peak {peak} <= device_blocks "
+                  f"{dev} (no capacity win over HBM)", file=sys.stderr)
+            ok = False
+    if args.expect_zero_leaks and sess.get("leaked_host_blocks"):
+        print(f"FAIL: leaked_host_blocks = "
+              f"{sess['leaked_host_blocks']}", file=sys.stderr)
         ok = False
     if report["exceptions"]:
         print(f"FAIL: {report['exceptions']} unhandled exceptions",

@@ -67,6 +67,14 @@ Asserts, end to end through the observability plane:
     nothing, logs serving_cancel / serving_hedge events, mints the
     canceled/hedge/retry-budget metrics, and matches the predictor's
     ``cancel``/``hedge`` no-op claims (predicted == observed);
+  - a host-KV-tier session episode (FLAGS_serving_host_tier, explicit
+    ``kv_tier=``): a two-turn session is demoted to host RAM by the
+    idle sweep, resumed token-identically (the resumed turn equals
+    replaying the stored conversation as a plain prompt), drains both
+    tiers leak-free, logs serving_kv_demote / serving_kv_promote /
+    serving_session_resume events, mints the migration/session
+    metrics, and matches the predictor's ``host_tier``/``sessions``
+    validated-no-op claim (predicted == observed);
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
     int8-quantization, SLO-admission and tracing metrics;
@@ -772,6 +780,77 @@ def main() -> int:
           f"retry budget {default_budget().remaining():.1f} tokens, "
           f"{deltaC} == predicted")
 
+    # -- host-tier phase: session parking is host-side numpy ----------
+    # Enabling the host KV tier bumps the flags version (a fresh
+    # phase), but every demotion/promotion is host-side numpy surgery:
+    # the predictor says ``host_tier=``/``sessions=`` are validated
+    # no-ops and the live tracker must agree. A two-turn session is
+    # demoted off device by the idle sweep, resumed from host RAM, and
+    # the resumed turn must be token-identical to replaying the stored
+    # conversation as a plain prompt. Both tiers drain leak-free.
+    from paddle_tpu.serving.kv_tier import HostBlockStore, TierManager
+    baseT = {site: c["count"]
+             for site, c in observability.compiles().items()
+             if site.startswith(("serving_", "decode_", "verify_"))}
+    pt.set_flags({"serving_host_tier": True, "serving_host_blocks": 64})
+    storeT = HostBlockStore(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                            block_size=4, num_blocks=64)
+    tierT = TierManager(storeT, demote_idle_ms=0.0)
+    engT = ServingEngine(model, max_slots=2, max_len=32,
+                         buckets=[8, 16], max_queue=16, block_size=4,
+                         kv_tier=tierT)
+    # round 1 warms BOTH prefill buckets, so the resume suffix lands
+    # warm no matter how much of the context promotion covers
+    tT1 = [3, 1, 4]
+    fillT = [2, 7, 1, 8, 2, 8, 1, 8, 2, 8]
+    rT1 = engT.submit(tT1, max_new_tokens=4, session="obs")
+    rF = engT.submit(fillT, max_new_tokens=4)
+    engT.run_until_idle()
+    assert rT1.state == "done" and rF.state == "done"
+    for _ in range(3):          # idle sweep demotes the cold chains
+        engT.step()
+    stT = tierT.stats()
+    assert stT["sessions_host"] == 1, stT
+    assert stT["migrated_demote_blocks"] > 0, stT
+    tT2 = [1, 5]
+    rT2 = engT.submit(tT2, max_new_tokens=4, session="obs")
+    engT.run_until_idle()
+    assert rT2.state == "done"
+    stT = tierT.stats()
+    assert stT["sessions_resumed"] == 1, stT
+    assert stT["migrated_promote_blocks"] > 0, stT
+    # token identity: the resumed turn equals replaying the stored
+    # conversation (turn-1 full sequence + turn-2 prompt) sessionless
+    ctxT = rT1.output_ids + tT2
+    rT3 = engT.submit(ctxT, max_new_tokens=4)
+    engT.run_until_idle()
+    assert rT3.state == "done" and rT3.output_ids == rT2.output_ids, (
+        rT3.output_ids, rT2.output_ids)
+    engT.run_until_idle()
+    engT.cache.flush_prefix_cache()
+    assert engT.cache.allocator.leaked() == 1, (  # trash block only
+        engT.cache.allocator.leaked())
+    tierT.flush()
+    assert tierT.leaked() == 0, tierT.leaked()
+    afterT = {site: c["count"]
+              for site, c in observability.compiles().items()
+              if site.startswith(("serving_", "decode_", "verify_"))}
+    deltaT = {site: n - baseT.get(site, 0)
+              for site, n in afterT.items() if n - baseT.get(site, 0)}
+    workloadT = [[(tT1, 4), (fillT, 4)], [(ctxT, 4)], [(ctxT, 4)]]
+    predT = predict_serving_compiles(
+        workloadT, buckets=[8, 16], max_len=32, block_size=4,
+        host_tier=True, sessions=1)
+    assert predT == predict_serving_compiles(
+        workloadT, buckets=[8, 16], max_len=32, block_size=4), \
+        "host_tier/sessions must be predictor no-ops"
+    assert deltaT == predT, (
+        f"host-tier-phase recompile prediction drifted:\n"
+        f"  predicted {predT}\n  observed  {deltaT}")
+    print(f"   host tier: demote {stT['migrated_demote_blocks']} / "
+          f"promote {stT['migrated_promote_blocks']} blocks, resume "
+          f"token-identical, 0 leaks both tiers, {deltaT} == predicted")
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -805,7 +884,11 @@ def main() -> int:
                    "sanitizer_lock_acquires",
                    "serving_canceled_total",
                    "serving_hedges_total",
-                   "serving_retry_budget_remaining"):
+                   "serving_retry_budget_remaining",
+                   "serving_kv_migrations",
+                   "serving_sessions_resident",
+                   "serving_sessions_host",
+                   "serving_sessions_resumed"):
         assert needle in text, f"/metrics missing {needle}"
     print(f"   /metrics: {n} samples, valid Prometheus text")
 
@@ -821,7 +904,8 @@ def main() -> int:
               "serving_request", "serving_handoff",
               "serving_lora_load", "serving_replica_kill",
               "serving_replica_recover", "serving_cancel",
-              "serving_hedge"):
+              "serving_hedge", "serving_kv_demote",
+              "serving_kv_promote", "serving_session_resume"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])

@@ -585,6 +585,44 @@ def render_serving_block():
         "and the soak re-asserts the extended identity and the hedge",
         "budget envelope.",
         "",
+        "Session capacity scales past HBM through the host-RAM KV",
+        "tier (`FLAGS_serving_host_tier`, serving/kv_tier.py): a",
+        "fleet-shared `serving.HostBlockStore` parks cold prefix",
+        "chains in pinned host memory, int8-at-rest on the same",
+        "absmax grid the device pool quantizes with, behind a",
+        "refcounted allocator whose `leaked()` must read zero after",
+        "drain just like the device pool's. A `serving.TierManager`",
+        "demotes idle chains between steps (LRU, leaf-first,",
+        "double-buffered staging copies off the step path; cadence",
+        "via `FLAGS_serving_demote_idle_ms`), promotes them back",
+        "all-or-nothing on demand at admission, and dedups fleet-wide",
+        "— two workers demoting the same system prompt store it once.",
+        "`submit(session=...)` turns that into resumable",
+        "conversations: the engine stores each finished turn's",
+        "context in a `serving.SessionStore`, prepends it to the next",
+        "turn, and re-prefills only the unshared suffix, so a",
+        "demoted conversation resumes *token-identically* (spec K>0,",
+        "int8 device KV and LoRA tenant pins included) and concurrent",
+        "sessions are bounded by host blocks, not device blocks.",
+        "Routers build ONE tier across replicas and roles, the fleet",
+        "prefix index keeps a killed worker's entries alive as",
+        "host-tier markers whenever the chain is still promotable,",
+        "and migration faults (`serving.migrate`) retry per",
+        "`RetryPolicy` without leaking either tier. Every migration",
+        "is host-side numpy/block surgery —",
+        "`predict_serving_compiles(host_tier=True, sessions=N)` is a",
+        "validated no-op. `GET /metrics` grows",
+        "`serving_kv_migrations{dir=}`, tier-labelled block gauges",
+        "and `serving_sessions_{resident,host,resumed}`; the run log",
+        "records `serving_kv_demote` / `serving_kv_promote` /",
+        "`serving_session_resume`; and `tools/loadgen.py",
+        "--returning-frac F --turns-per-session A:B --host-blocks N`",
+        "drives seeded multi-turn sessions with idle gaps (session",
+        "rows ride the trace for byte-identical replay) and gates",
+        "resumed sessions, zero leaks on both tiers, zero new",
+        "compiles after warmup, and peak concurrent sessions above",
+        "the device pool's block count.",
+        "",
         "Flags:",
         "",
     ]
