@@ -368,6 +368,17 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
       concurrent requests into the same memory (asserted; concurrency
       is a scheduling fact, valid on any backend).
 
+    Unless BENCH_SERVING_MEGASTEP is 0/1 (default 8), the megastep
+    block serves a decode-heavy workload (short uniform prompts, long
+    decodes) through a 2-replica fleet twice — the serial per-token
+    loop vs device-resident decode megasteps
+    (FLAGS_serving_megastep=N, router stepping from a 2-thread pool) —
+    asserts exact token parity and a >=1.2x goodput win on every
+    backend: the win is the removed per-token host loop, not device
+    speed. Dispatch-ahead stays off in the timed arm (it only pays
+    under async dispatch, i.e. on TPU).
+    BENCH_SERVING_MEGASTEP_ASSERT=0 reports without the gate.
+
     Unless BENCH_SERVING_TP=0, the tp block compares the same workload
     through a mesh-sharded tensor-parallel engine (1xM model split when
     >=2 devices exist, the degenerate 1x1 mesh otherwise) and a
@@ -622,6 +633,97 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
             finally:
                 pt.set_flags({"serving_attn_impl": "xla",
                               "serving_kv_dtype": "f32"})
+        mega_cmp = None
+        ms_n = int(os.environ.get("BENCH_SERVING_MEGASTEP", "8"))
+        if ms_n > 1:
+            # -- decode megasteps + threaded dispatch vs serial N=1 --
+            # the same workload through a 2-replica fleet twice: the
+            # serial per-token loop (megastep=1) and device-resident
+            # megasteps (N decode iterations per compiled dispatch,
+            # one host commit per megastep) with the router stepping
+            # replicas from a thread pool. Token streams must match
+            # exactly; the >=1.2x goodput gate holds on CPU too — the
+            # win is removed Python/host-commit overhead, not device
+            # speed (BENCH_SERVING_MEGASTEP_ASSERT=0 reports without
+            # asserting; BENCH_SERVING_MEGASTEP=0/1 skips the block).
+            from paddle_tpu.serving import ReplicaRouter
+            # decode-heavy geometry: short uniform prompts, long
+            # decodes — the regime the megastep exists for (the host
+            # loop runs once per token; prefill-heavy mixes measure
+            # prefill, which megasteps don't touch). Sized
+            # independently of --seq so the gate is stable across
+            # bench geometries.
+            ms_slots = min(batch, 4)
+            ms_mnt = max(new_tokens, 48)
+            ms_len = max(seq, 8 + ms_mnt + 8)
+            r9 = np.random.RandomState(9)
+            ms_ps = [r9.randint(1, cfg.vocab_size, size=8).tolist()
+                     for _ in range(4 * ms_slots)]
+
+            def serve_fleet():
+                rt = ReplicaRouter(model, n_replicas=2,
+                                   max_slots=ms_slots, max_len=ms_len,
+                                   max_queue=len(ms_ps) + ms_slots)
+                rs = [rt.submit(p, max_new_tokens=ms_mnt)
+                      for p in ms_ps]
+                rt.run_until_idle()
+                assert all(rq.state == "done" for rq in rs)
+                return rs, rt
+
+            def timed_arm(flags):
+                # set_flags bumps the flag-plane version (invalidating
+                # every step_entry), so it runs ONCE per arm; the warm
+                # pass right after it pays every compile, leaving the
+                # timed pass compile-free
+                pt.set_flags(flags)
+                serve_fleet()[1].stop()
+                t0 = time.perf_counter()
+                rs, rt = serve_fleet()
+                dt_arm = time.perf_counter() - t0
+                rt.stop()
+                return rs, dt_arm
+
+            try:
+                s_reqs, s_dt = timed_arm(
+                    {"serving_megastep": 1,
+                     "serving_dispatch_ahead": False,
+                     "serving_dispatch_threads": 0})
+                # dispatch-ahead stays OFF in the timed arm: it
+                # overlaps commit with megastep k+1 only on async
+                # backends (TPU); under synchronous CPU dispatch the
+                # speculative call blocks before the commit, a wash
+                m_reqs, m_dt = timed_arm(
+                    {"serving_megastep": ms_n,
+                     "serving_dispatch_ahead": False,
+                     "serving_dispatch_threads": 2})
+            finally:
+                pt.set_flags({"serving_megastep": 1,
+                              "serving_dispatch_ahead": False,
+                              "serving_dispatch_threads": 0})
+            for a, b2 in zip(s_reqs, m_reqs):
+                assert a.output_ids == b2.output_ids, \
+                    "megastep decode diverged from the serial " \
+                    "per-token loop"
+            s_toks = sum(len(rq.tokens) for rq in s_reqs)
+            m_toks = sum(len(rq.tokens) for rq in m_reqs)
+            ms_speedup = (m_toks / m_dt) / (s_toks / s_dt)
+            if os.environ.get(
+                    "BENCH_SERVING_MEGASTEP_ASSERT", "1") != "0":
+                assert ms_speedup >= 1.2, (
+                    f"megastep={ms_n}+threaded goodput speedup "
+                    f"{ms_speedup:.2f}x < 1.2x over the serial "
+                    "per-token fleet")
+            mega_cmp = {
+                "megastep": ms_n,
+                "dispatch_threads": 2,
+                "dispatch_ahead": False,
+                "slots": ms_slots,
+                "new_tokens": ms_mnt,
+                "serial_tokens_per_sec": round(s_toks / s_dt, 1),
+                "megastep_tokens_per_sec": round(m_toks / m_dt, 1),
+                "speedup": round(ms_speedup, 2),
+                "token_parity": True,
+            }
         tp_cmp = None
         if os.environ.get("BENCH_SERVING_TP", "1") != "0":
             # mesh-sharded serving: the same workload through a
@@ -730,6 +832,8 @@ def child_main_serving(batch: int, seq: int, steps: int) -> int:
         out["attn"] = attn_cmp
     if kv_quant_cmp is not None:
         out["kv_quant"] = kv_quant_cmp
+    if mega_cmp is not None:
+        out["megastep"] = mega_cmp
     if tp_cmp is not None:
         out["tp"] = tp_cmp
     # full observability snapshot (counters + histogram percentiles +

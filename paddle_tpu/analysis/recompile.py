@@ -142,7 +142,8 @@ def predict_serving_compiles(
         tracing: Optional[float] = None,
         sanitize: bool = False,
         host_tier: bool = False,
-        sessions: int = 0) -> Dict[str, int]:
+        sessions: int = 0,
+        megastep: int = 1) -> Dict[str, int]:
     """Predict the engine's ``tracked_jit`` compile counts for a
     serving workload, before running it.
 
@@ -305,6 +306,22 @@ def predict_serving_compiles(
     already warmed, by construction. A million sessions tiered
     through host RAM therefore predict the same counts as none —
     the concurrent-session capacity contract, statically.
+
+    ``megastep`` (``FLAGS_serving_megastep``: N decode iterations per
+    compiled dispatch, ``lax.scan`` device-resident) is the one knob
+    in this family that ADDS a compile surface instead of being a
+    no-op: with N > 1 the decode plane has exactly TWO entries —
+    ``decode_megastep_paged{n=N}`` for slots the scheduler can run N
+    ahead, and the single-token ``decode_step_paged`` fallback the
+    engine drops to whenever a megastep is unsafe for the whole batch
+    (a grammar cursor that must observe every token, stop sequences
+    beyond the device-table caps, a hard deadline with room for fewer
+    than N tokens). Both compile once; ``_choose_megastep`` never
+    picks an intermediate N, so no third surface exists. Requires
+    ``paged=True`` and ``spec_tokens == 0`` (the engine rejects both
+    combinations). ``dispatch_ahead`` and threaded routers reuse the
+    same two entries — enqueueing megastep k+1 early replays the
+    cached trace by construction.
     """
     for val, ok, flag in ((attn_impl, ("xla", "pallas"),
                            "attn_impl"),
@@ -393,6 +410,17 @@ def predict_serving_compiles(
             f"on/off), got {host_tier!r}")
     if int(sessions) < 0:
         raise ValueError(f"sessions must be >= 0, got {sessions}")
+    megastep = int(megastep)
+    if megastep < 1:
+        raise ValueError(f"megastep must be >= 1, got {megastep}")
+    if megastep > 1 and not paged:
+        raise ValueError(
+            "megastep > 1 requires paged=True (the device-resident "
+            "decode loop carries the paged KV pool through lax.scan)")
+    if megastep > 1 and spec_tokens > 0:
+        raise ValueError(
+            "megastep > 1 is mutually exclusive with spec_tokens > 0 "
+            "(the engine rejects the combination)")
     if sessions and not host_tier:
         raise ValueError(
             "sessions requires host_tier=True (submit(session=...) "
@@ -444,6 +472,8 @@ def predict_serving_compiles(
             counts[f"verify_step{suffix}{{k={spec_tokens}}}"] = 1
         else:
             counts[f"decode_step{suffix}"] = 1
+            if megastep > 1:
+                counts[f"decode_megastep_paged{{n={megastep}}}"] = 1
     return counts
 
 

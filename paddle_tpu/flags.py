@@ -260,6 +260,38 @@ define_flag("serving_spec_ngram", 3,
             "prompt+generated context when proposing draft tokens "
             "(falls back to shorter n-grams, then to repeating the "
             "last token).")
+define_flag("serving_megastep", 1,
+            "Device-resident decode megasteps: decode iterations run "
+            "inside one compiled lax.scan entry per step() call, with "
+            "EOS / budget / stop-sequence early-exit carried as "
+            "per-slot data (finished slots freeze behind a live-mask) "
+            "and one host commit per megastep instead of per token. "
+            "Output is byte-identical to megastep=1; requires "
+            "serving_paged and is incompatible with "
+            "serving_spec_tokens > 0. Requests the device stop tables "
+            "cannot hold (decoding.STOP_MAX_SEQS/STOP_MAX_LEN) or "
+            "that decode under a JSON grammar fall back to single "
+            "steps, as does a step whose tightest hard deadline could "
+            "not absorb a whole megastep. 1 (default) keeps the "
+            "per-token host loop.")
+define_flag("serving_dispatch_ahead", False,
+            "Megastep pipelining: after committing megastep k, "
+            "dispatch k+1 from k's device-carry outputs before "
+            "syncing, so host commit work overlaps device execution "
+            "(jax.block_until_ready only at commit). The speculative "
+            "dispatch is consumed only if the scheduler state it "
+            "assumed is unchanged (no finishes, no admissions, no "
+            "weight/flag changes); otherwise it is discarded — pools "
+            "are pure functional values, so a discard has no side "
+            "effects. Requires serving_megastep > 1.")
+define_flag("serving_dispatch_threads", 0,
+            "Router dispatch concurrency: ReplicaRouter / DisaggRouter "
+            "step their replicas from a bounded thread pool of this "
+            "size instead of the serial per-engine loop (engines are "
+            "stepped concurrently; health strikes, hedging and "
+            "deadline reaping stay at step boundaries with identical "
+            "semantics). 0 (default) = serial stepping, byte-identical "
+            "scheduling order.")
 define_flag("serving_paged", True,
             "ServingEngine KV memory manager: True = block-paged "
             "BlockKVCache (per-request block tables over a fixed pool "

@@ -434,21 +434,40 @@ class DecodeEngine(ServingEngine):
                                     is self.cache.pool))
         return adopted
 
-    def step(self) -> bool:
+    def adopt_step(self) -> bool:
+        """The admission half of :meth:`step`: reap hard-expired slots
+        (their rows free up for this very step's adoptions), then drain
+        adoptable handoffs. Split out so the threaded disagg router can
+        run this serially — a cross-pool adoption derefs the *source*
+        prefill worker's pool (``release_blocks`` above), which is not
+        safe concurrently with another worker allocating on it — while
+        fanning the decode halves out in parallel."""
         with self._step_lock:
             _monitor.stat_add("STAT_serving_steps")
-            # reap hard-expired slots first: their rows free up for
-            # this very step's adoptions
             reaped = self._reap_expired()
             worked = self._adopt_handoffs() > 0
+            return bool(worked or reaped)
+
+    def decode_step(self) -> bool:
+        """The compute half of :meth:`step`: one decode dispatch
+        (megastep-aware via ``_decode_any``) plus the host-tier demote
+        sweep and pool gauges. Only touches this engine's own pool and
+        internally-locked shared planes (LoRA pool, tier manager,
+        metrics), so the threaded router may run decode halves of
+        workers with *distinct* pools concurrently."""
+        with self._step_lock:
             produced = (self._spec_decode() if self.spec_tokens
-                        else self._decode())
+                        else self._decode_any())
             if self.kv_tier is not None:
                 self._demote_sweep()
             if self.paged:
                 self._blocks_used_g.set(self.cache.blocks_used)
                 self._blocks_free_g.set(self.cache.blocks_free)
-            return bool(worked or produced or reaped)
+            return bool(produced)
+
+    def step(self) -> bool:
+        worked = self.adopt_step()
+        return self.decode_step() or worked
 
 
 class DisaggRouter:
@@ -479,11 +498,14 @@ class DisaggRouter:
                  n_decode: Optional[int] = None,
                  prefix_affinity: Optional[bool] = None,
                  handoff_queue: Optional[int] = None,
-                 colocate: bool = True, **engine_kwargs):
+                 colocate: bool = True,
+                 dispatch_threads: Optional[int] = None,
+                 **engine_kwargs):
         from .. import flags as _flags
         g = _flags.get_flags(["serving_disagg",
                               "serving_prefix_affinity",
-                              "serving_handoff_queue"])
+                              "serving_handoff_queue",
+                              "serving_dispatch_threads"])
         if n_prefill is None or n_decode is None:
             dims = parse_disagg(g["serving_disagg"])
             if dims is None:
@@ -555,6 +577,21 @@ class DisaggRouter:
             self.decodes.append(
                 DecodeEngine(model, self._handoff, **kw))
         self.colocate = bool(colocate)
+        # threaded fleet dispatch (0 = the serial loop, byte-identical
+        # scheduling): prefill steps fan out in parallel (each prefill
+        # worker owns a private pool), then — after a barrier — the
+        # adoption sweeps run serially (cross-pool adoption derefs the
+        # source pool) and the decode dispatches fan out grouped by
+        # pool identity (colocate aliases several decode workers to
+        # one prefill pool; same pool -> same worker thread).
+        self._dispatch_threads = int(
+            dispatch_threads if dispatch_threads is not None
+            else g["serving_dispatch_threads"])
+        if self._dispatch_threads < 0:
+            raise ValueError(
+                "dispatch_threads must be >= 0, got "
+                f"{self._dispatch_threads}")
+        self._step_pool = None   # lazily-built ThreadPoolExecutor
         self._killed: List[ServingEngine] = []  # guarded-by: _lock
         self._rehomed = 0                       # guarded-by: _lock
         self._draining = False                  # guarded-by: _lock
@@ -880,15 +917,68 @@ class DisaggRouter:
         return purged
 
     # ---------------------------------------------------------- stepping
+    def _dispatch_pool(self):
+        """The persistent bounded worker pool for threaded dispatch,
+        built on first use and shut down by :meth:`stop`."""
+        if self._step_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._step_pool = ThreadPoolExecutor(
+                max_workers=self._dispatch_threads,
+                thread_name_prefix=f"disagg{self._rid}-dispatch")
+        return self._step_pool
+
+    @staticmethod
+    def _await_all(futs) -> bool:
+        worked = False
+        err = None
+        for f in futs:
+            try:
+                worked = bool(f.result()) or worked
+            except Exception as e:     # barrier first, raise after
+                err = err or e
+        if err is not None:
+            raise err
+        return worked
+
     def step(self) -> bool:
         """One fleet iteration: every prefill worker (admission +
         export), then every decode worker (adoption + decode), in
-        fixed order — the deterministic test/benchmark path."""
-        worked = False
-        for eng in list(self.prefills):
-            worked = eng.step() or worked
-        for eng in list(self.decodes):
-            worked = eng.step() or worked
+        fixed order — the deterministic test/benchmark path.
+
+        With ``FLAGS_serving_dispatch_threads`` > 0 (or the
+        ``dispatch_threads=`` constructor override) the per-worker
+        steps fan out over a bounded pool in three phases: prefill
+        steps in parallel (private pools), a barrier so every export
+        is visible, the adoption sweeps serially on the calling thread
+        (a cross-pool adoption releases blocks on the *source*
+        prefill pool — unsafe concurrently with its other users), then
+        the decode dispatches in parallel grouped by pool identity."""
+        if self._dispatch_threads > 0:
+            pool = self._dispatch_pool()
+            worked = self._await_all(
+                [pool.submit(eng.step) for eng in list(self.prefills)])
+            decodes = list(self.decodes)
+            for eng in decodes:
+                worked = eng.adopt_step() or worked
+            groups: dict = {}
+            for eng in decodes:
+                groups.setdefault(id(eng.cache.pool), []).append(eng)
+
+            def _run_group(group):
+                w = False
+                for eng in group:
+                    w = eng.decode_step() or w
+                return w
+
+            worked = self._await_all(
+                [pool.submit(_run_group, grp)
+                 for grp in groups.values()]) or worked
+        else:
+            worked = False
+            for eng in list(self.prefills):
+                worked = eng.step() or worked
+            for eng in list(self.decodes):
+                worked = eng.step() or worked
         self._handoff_gauge.set(len(self._handoff))
         return worked
 
@@ -1175,6 +1265,9 @@ class DisaggRouter:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._step_pool is not None:
+            self._step_pool.shutdown(wait=True)
+            self._step_pool = None
 
     def stats(self) -> dict:
         """Fleet view: per-role worker counts and queue depths, the
@@ -1240,6 +1333,7 @@ class DisaggRouter:
             "shed_total": sum(shed.values()),
             "canceled": canceled,
             "canceled_total": sum(canceled.values()),
+            "dispatch_threads": self._dispatch_threads,
             "queue_depths": [self._depth(e) for e in self.prefills],
             "kv_blocks_free": [self._blocks_free(e)
                                for e in self.prefills],

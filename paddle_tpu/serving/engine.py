@@ -117,12 +117,15 @@ from ..dygraph.tensor import Tensor
 from ..distributed.sharding import (SERVING_TP_RULES, kv_pool_shardings,
                                     mesh_cache_key, parse_serving_mesh,
                                     serving_mesh)
-from ..models.generation import (decode_step, decode_step_paged,
-                                 draft_ngram, step_entry, verify_step,
+from ..models.generation import (decode_megastep_paged, decode_step,
+                                 decode_step_paged, draft_ngram,
+                                 step_entry, verify_step,
                                  verify_step_paged)
 from ..resilience.injector import fault_point
 from ..resilience.retry import RetryError, RetryPolicy
-from .decoding import DecodeParams, request_key, sample_first
+from .decoding import (STOP_MAX_LEN, STOP_MAX_SEQS, DecodeParams,
+                       StopMatcher, request_key, sample_first,
+                       stop_table_rows, stops_fit)
 from .kv_cache import BlockKVCache, SlotKVCache
 from .kv_tier import HostBlockStore, TierManager
 from .lora import LoRAPool
@@ -200,6 +203,16 @@ class Request:
         self.decode = decode if decode is not None else DecodeParams()
         self.tenant = str(tenant)
         self._key = request_key(self.decode.seed)
+        # incremental stop-sequence automaton, fed once per committed
+        # token in _append_token (O(1) amortized; replaces the old
+        # O(len^2) full-suffix scan). Its per-pattern states are the
+        # exact device representation the decode megastep carries, and
+        # it travels with the object through adopts and re-homes.
+        self._stop = (StopMatcher(self.decode.stop_sequences)
+                      if self.decode.stop_sequences else None)
+        # whether the stops fit the fixed-shape device stop tables
+        # (megastep eligibility, computed once)
+        self._stops_fit = stops_fit(self.decode.stop_sequences)
         self._cursor = None        # JsonCursor when json_mode is on
         self._lora_held = False    # this request pins its tenant page
         self.rehomed = False       # recovered from a killed replica
@@ -344,7 +357,9 @@ class ServingEngine:
                  clock=None, kv_pool=None,
                  lora_rank: Optional[int] = None,
                  lora_max_adapters: Optional[int] = None,
-                 lora_pool=None, grammar=None, kv_tier=None):
+                 lora_pool=None, grammar=None, kv_tier=None,
+                 megastep: Optional[int] = None,
+                 dispatch_ahead: Optional[bool] = None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -352,6 +367,8 @@ class ServingEngine:
                               "serving_idle_wait",
                               "serving_spec_tokens",
                               "serving_spec_ngram",
+                              "serving_megastep",
+                              "serving_dispatch_ahead",
                               "serving_paged", "serving_block_size",
                               "serving_num_blocks",
                               "serving_prefix_cache",
@@ -421,6 +438,27 @@ class ServingEngine:
             raise ValueError(
                 f"spec_tokens {self.spec_tokens} leaves no room in "
                 f"max_len={self.max_len} slots")
+        # Device-resident decode megasteps: N decode iterations per
+        # compiled dispatch, one host commit per megastep. Constructor/
+        # flag state like the SLO knobs — never set_flags mid-run.
+        self.megastep = int(megastep if megastep is not None
+                            else g["serving_megastep"])
+        if self.megastep < 1:
+            raise ValueError(
+                f"megastep must be >= 1, got {self.megastep}")
+        if self.megastep > 1 and self.spec_tokens > 0:
+            raise ValueError(
+                "megastep > 1 cannot combine with speculative decoding "
+                "(FLAGS_serving_spec_tokens > 0): the draft-verify "
+                "round-trip is inherently per-host-step")
+        self.dispatch_ahead = bool(
+            dispatch_ahead if dispatch_ahead is not None
+            else g["serving_dispatch_ahead"])
+        if self.dispatch_ahead and self.megastep <= 1:
+            raise ValueError(
+                "dispatch_ahead requires megastep > 1 "
+                "(FLAGS_serving_megastep); there is no megastep "
+                "pipeline to fill at N=1")
         self.buckets = (_parse_buckets(g["serving_prefill_buckets"],
                                        self.max_len)
                         if buckets is None else
@@ -428,6 +466,11 @@ class ServingEngine:
                                        self.max_len))
         self.paged = bool(paged if paged is not None
                           else g["serving_paged"])
+        if self.megastep > 1 and not self.paged:
+            raise ValueError(
+                "megastep > 1 requires the paged KV cache "
+                "(FLAGS_serving_paged); the dense decode step has no "
+                "device-resident scan sibling")
         self.kv_dtype = str(kv_dtype if kv_dtype is not None
                             else g["serving_kv_dtype"])
         # which attention lowering the compiled paged steps traced with;
@@ -663,6 +706,12 @@ class ServingEngine:
             "live weight hot-swaps applied to this engine's model "
             "(0 = the weights it was built with)").labels(engine=eid)
         self._weight_version_g.set(0)
+        # dispatch-ahead speculation: megastep k+1's un-synced device
+        # result, enqueued while k's commit ran; consumed by the next
+        # decode only when the scheduler state it assumed is unchanged
+        self._ahead = None                # guarded-by: _step_lock
+        self._ahead_hits = 0              # guarded-by: _step_lock
+        self._ahead_misses = 0            # guarded-by: _step_lock
         self._qerr_max = 0.0              # guarded-by: _step_lock
         self._qerr_gauge = None
         if self.kv_dtype == "int8":
@@ -687,6 +736,9 @@ class ServingEngine:
             "_prefix_miss_reqs": "_step_lock",
             "_weight_version": "_step_lock",
             "_qerr_max": "_step_lock",
+            "_ahead": "_step_lock",
+            "_ahead_hits": "_step_lock",
+            "_ahead_misses": "_step_lock",
         })
 
     # -------------------------------------------------------------- mesh
@@ -765,7 +817,11 @@ class ServingEngine:
                                                  self.mesh)
                 v = jax.device_put(v, NamedSharding(self.mesh, spec))
             staged.append((p, v))
-        with self._step_lock:
+        from ..models.generation import model_trace_lock
+        with self._step_lock, model_trace_lock(self.model):
+            # the trace lock keeps the cut clean fleet-wide: a sibling
+            # replica mid-trace holds borrowed tracers in these same
+            # Parameters, and its restore would silently undo the swap
             for p, v in staged:
                 p.value = v
             self._weight_version += 1
@@ -1768,6 +1824,10 @@ class ServingEngine:
                 self.cache.release(slot)
                 self._shed(req, e)
             return 0
+        # the TPOT EWMA is per *committed token*: one step commits
+        # exactly one token per active slot here, so the step wall is
+        # already a per-token sample (the megastep and spec paths
+        # divide by tokens committed explicitly)
         self._note_tpot_ms((time.perf_counter() - t0) * 1e3)
         if self.paged:
             nxt, _, arrays, qerr, new_keys = out
@@ -1783,6 +1843,236 @@ class ServingEngine:
             self._append_token(req, int(nxt[slot]))
             produced += 1
         return produced
+
+    # ------------------------------------------------ decode megasteps
+    def _choose_megastep(self) -> int:  # holds: _step_lock
+        """The megastep N this decode runs at: the configured
+        ``megastep`` unless the active batch needs the per-token host
+        loop — a grammar-cursored row (the mask is recomputed host-side
+        every token), stops beyond the fixed device-table caps, or a
+        hard deadline too tight to absorb a whole megastep (the budget
+        caps N so a dying client is reaped within one step, never a
+        megastep late). Falls all the way back to 1, never to an
+        intermediate N: the engine owns exactly two decode compile
+        surfaces — ``decode_megastep_paged{n=N}`` and the
+        ``decode_step_paged`` fallback — which is what
+        ``predict_serving_compiles(megastep=N)`` emits."""
+        n = self.megastep
+        if n <= 1 or not self._active:
+            return 1
+        tpot = self._tpot_cost_ms()
+        now = None
+        for req in self._active.values():
+            if req._cursor is not None or not req._stops_fit:
+                return 1
+            if req.hard_deadline is not None and tpot > 0:
+                if now is None:
+                    now = self._clock()
+                if (req.hard_deadline - now) * 1e3 < n * tpot:
+                    return 1
+        return n
+
+    def _megastep_inputs(self, n: int):  # holds: _step_lock
+        """Build one megastep dispatch's ``(args, ctx)``: the
+        fixed-shape device inputs plus the reusable constants (tables,
+        sampling params, stop tables, the compiled fn) a dispatch-ahead
+        re-dispatch feeds unchanged. Empty slots are frozen from
+        iteration 0 (``live=False``) and write their strays into the
+        trash block exactly as the single step does."""
+        b = self.max_slots
+        tokens = np.zeros(b, np.int32)
+        live = np.zeros(b, bool)
+        budget = np.ones(b, np.int32)
+        eos = np.full(b, -1, np.int32)
+        J, L = STOP_MAX_SEQS, STOP_MAX_LEN
+        pat = np.full((b, J, L), -1, np.int32)
+        plen = np.zeros((b, J), np.int32)
+        fail = np.zeros((b, J, L + 1), np.int32)
+        state = np.zeros((b, J), np.int32)
+        for slot, req in self._active.items():
+            tokens[slot] = req.tokens[-1]
+            live[slot] = True
+            budget[slot] = req.max_new_tokens - len(req.tokens)
+            if req.eos_token_id is not None:
+                eos[slot] = int(req.eos_token_id)
+            if req._stop is not None:
+                (pat[slot], plen[slot], fail[slot],
+                 state[slot]) = stop_table_rows(req._stop)
+        fn = decode_megastep_paged(self.model, n, self.mesh,
+                                   self.kv_dtype,
+                                   self._lora_shape)["fn"]
+        samp = self._build_samp()
+        ctx = {
+            "fn": fn,
+            "tables": jnp.asarray(self.cache.tables),
+            "samp_const": (samp[0], samp[1], samp[2], samp[4]),
+            "eos": jnp.asarray(eos),
+            "stop_tables": (jnp.asarray(pat), jnp.asarray(plen),
+                            jnp.asarray(fail)),
+            "lora": (self._lora_args()
+                     if self._lora_shape is not None else None),
+        }
+        spat, splen, sfail = ctx["stop_tables"]
+        args = (jnp.asarray(tokens), jnp.asarray(self.cache.lengths),
+                ctx["tables"], self.cache.arrays(), samp,
+                jnp.asarray(live), jnp.asarray(budget), ctx["eos"],
+                (spat, splen, sfail, jnp.asarray(state)))
+        if self._lora_shape is not None:
+            args = args + (ctx["lora"],)
+        return args, ctx
+
+    def _ahead_snapshot(self, n: int, extra_tokens: int = 0):
+        """The scheduler state a speculative dispatch assumes: the
+        megastep N, the weight and flag-plane versions, and each active
+        slot's (slot, request id, committed length) — with
+        ``extra_tokens`` added per slot when snapshotting the
+        *post-commit* state a pre-commit dispatch runs against."""
+        return (n, self._weight_version, _flags.version(),
+                tuple(sorted(
+                    (slot, req.id, len(req.tokens) + extra_tokens)
+                    for slot, req in self._active.items())))
+
+    def _dispatch_ahead(self, n: int, out, ctx):  # holds: _step_lock
+        """Enqueue megastep k+1 from k's still-un-synced device carry
+        outputs, before the host blocks on k's results — the device
+        queue stays fed while the host commits. The dispatch assumes
+        k commits with no finishes, no admissions, no reaps and no
+        weight/flag/pool changes; :meth:`_take_ahead` validates all of
+        that before consuming, and a discard is free (pools are pure
+        functional values — nothing was mutated)."""
+        (_toks, _finish, tok_f, pos_f, pools_f, keys_f, live_f,
+         rem_f, st_f, _qerr) = out
+        temp, tk, tp, mask = ctx["samp_const"]
+        spat, splen, sfail = ctx["stop_tables"]
+        args = (tok_f, pos_f, ctx["tables"], pools_f,
+                (temp, tk, tp, keys_f, mask), live_f, rem_f,
+                ctx["eos"], (spat, splen, sfail, st_f))
+        if self._lora_shape is not None:
+            args = args + (ctx["lora"],)
+        self._ahead = {
+            "n": n,
+            "snap": self._ahead_snapshot(n, extra_tokens=n),
+            "leaf": pools_f[0][0],
+            "lora_arrays": (None if self._lora_shape is None
+                            else self.lora_pool.arrays),
+            "out": ctx["fn"](*args),
+            "ctx": ctx,
+        }
+
+    def _take_ahead(self, n: int):  # holds: _step_lock
+        """Consume the stored speculative megastep iff the live
+        scheduler state matches what it assumed — same N, same
+        (slot, request, length) composition, same weight/flag
+        versions, and the KV pools are *the same arrays* the
+        speculation read (identity check on a pool leaf: any prefill,
+        demotion, promotion or adoption rebinds them). Single-shot:
+        hit or miss, the slot clears."""
+        ah, self._ahead = self._ahead, None
+        if ah is None:
+            return None
+        ok = (ah["n"] == n and
+              ah["snap"] == self._ahead_snapshot(n) and
+              self.cache.arrays()[0][0] is ah["leaf"] and
+              (self._lora_shape is None or
+               ah["lora_arrays"] is self.lora_pool.arrays))
+        if not ok:
+            self._ahead_misses += 1
+            _monitor.stat_add("STAT_serving_ahead_misses")
+            return None
+        self._ahead_hits += 1
+        _monitor.stat_add("STAT_serving_ahead_hits")
+        return ah["out"], ah["ctx"]
+
+    def _megastep_attempt(self, n: int):
+        """One megastep dispatch attempt (the serving.step fault
+        site). The fault check fires BEFORE the speculation is
+        consumed, so an injected skip leaves the stored dispatch valid
+        for the next attempt — the state it assumed is untouched.
+        Returns ``(out, ctx)``."""
+        kind = fault_point("serving.step")
+        if kind == "skip":
+            raise _SkipStep("injected skip of one decode megastep")
+        taken = self._take_ahead(n)
+        if taken is not None:
+            return taken
+        args, ctx = self._megastep_inputs(n)
+        return ctx["fn"](*args), ctx
+
+    def _decode_megastep(self, n: int) -> int:  # holds: _step_lock
+        """One device-resident megastep over every occupied slot: N
+        decode iterations inside one compiled dispatch, then ONE host
+        commit — each slot's committed tokens replayed through the
+        ordinary :meth:`_append_token` path (finish reasons, tracing
+        marks and session state re-derived exactly; the device and
+        host early-exit conditions are equivalent by construction, the
+        token-identity oracle). Returns tokens produced."""
+        if not self._active:
+            return 0
+        n_active = len(self._active)
+        t0 = time.perf_counter()
+        try:
+            with _monitor.stat_time("STAT_serving_decode"), \
+                    _profiler.RecordEvent("serving.decode"):
+                out, ctx = RetryPolicy.from_flags(
+                    "serving.step").call(self._megastep_attempt, n)
+        except _SkipStep:
+            return 0
+        except RetryError as e:
+            for slot, req in list(self._active.items()):
+                del self._active[slot]
+                self.cache.release(slot)
+                self._shed(req, e)
+            return 0
+        (toks, finish, _tok_f, _pos_f, pools_f, keys_f, _live_f,
+         _rem_f, _st_f, qerr) = out
+        if self.dispatch_ahead:
+            # enqueue k+1 behind k on the device BEFORE the host
+            # blocks on k's results: commit work below overlaps it
+            self._dispatch_ahead(n, out, ctx)
+        toks = np.asarray(toks)          # syncs megastep k
+        finish = np.asarray(finish)
+        keys_arr = np.asarray(keys_f)
+        self.cache.set_arrays(pools_f)
+        self._note_qerr(qerr, n * n_active)
+        produced = 0
+        for slot, req in list(self._active.items()):
+            f = int(finish[slot])
+            ncommit = (f + 1) if f >= 0 else n
+            # iteration i wrote its token's KV at pos0 + i; a slot
+            # finishing at iteration f committed f+1 tokens, a live
+            # slot all n — lengths stay prompt + generated - 1, the
+            # same invariant the single step keeps
+            self.cache.advance(slot, ncommit)
+            for i in range(ncommit):
+                self._append_token(req, int(toks[i, slot]))
+                produced += 1
+                if req.state != "running":
+                    break
+            if req.state == "running":
+                req._key = keys_arr[slot].copy()
+        if produced:
+            # per-token pace: the megastep wall spread over the tokens
+            # each slot actually committed (satellite: TPOT samples
+            # divide by tokens, not steps, so SLO admission stays
+            # calibrated at megastep > 1)
+            self._note_tpot_ms((time.perf_counter() - t0) * 1e3 *
+                               n_active / produced)
+        if _runlog.enabled():
+            _runlog.log_event("serving_megastep", n=n, active=n_active,
+                              produced=produced)
+        return produced
+
+    def _decode_any(self) -> int:  # holds: _step_lock
+        """Route one decode round: the device-resident megastep when
+        eligible, else the per-token single step (megastep=1, grammar
+        rows, oversized stops, tight deadlines). A fallback round
+        drops any stored speculation — its snapshot could never match
+        a state the single step advanced."""
+        n = self._choose_megastep()
+        if n > 1:
+            return self._decode_megastep(n)
+        self._ahead = None
+        return self._decode()
 
     # ------------------------------------------------- speculative decode
     def _verify_attempt(self, tokens: np.ndarray):
@@ -1889,6 +2179,10 @@ class ServingEngine:
             _tracing.mark(req.id, "first_token", req.first_token_at,
                           self.trace_track)
         _monitor.stat_add("STAT_serving_tokens")
+        if req._stop is not None:
+            # advance the incremental matcher over the committed token
+            # (O(1) amortized); _hit_stop below just reads the latch
+            req._stop.feed(token)
         if req._cursor is not None:
             # advance the grammar pushdown over the committed token;
             # a structurally-complete document retires the request
@@ -1904,14 +2198,16 @@ class ServingEngine:
             self._finish(req)
 
     def _hit_stop(self, req: Request) -> bool:
-        """Host-side stop-sequence check on the generated suffix; the
-        matched stop tokens stay in the output (OpenAI-style truncation
-        is the caller's choice — the engine reports what it committed)."""
-        t = req.tokens
-        for s in req.decode.stop_sequences:
-            if len(t) >= len(s) and t[-len(s):] == list(s):
-                return True
-        return False
+        """Host-side stop-sequence check; the matched stop tokens stay
+        in the output (OpenAI-style truncation is the caller's choice —
+        the engine reports what it committed). Reads the request's
+        incremental KMP matcher (fed per committed token in
+        :meth:`_append_token`): O(1) per check, where the old
+        full-suffix rescan was O(len^2) over a request's lifetime.
+        ``state == len(pattern)`` in the automaton holds exactly when
+        the pattern is a suffix of the generated tokens, so the verdict
+        is identical token for token."""
+        return req._stop is not None and req._stop.hit
 
     def _finish(self, req: Request):  # holds: _step_lock
         if req.slot is not None:
@@ -2107,7 +2403,7 @@ class ServingEngine:
             reaped = self._reap_expired()
             admitted = self._admit()
             produced = (self._spec_decode() if self.spec_tokens
-                        else self._decode())
+                        else self._decode_any())
             if self.kv_tier is not None:
                 self._demote_sweep()
             if self.paged:
@@ -2168,6 +2464,8 @@ class ServingEngine:
             qerr_max = self._qerr_max
             prefix_hit_reqs = self._prefix_hit_reqs
             prefix_miss_reqs = self._prefix_miss_reqs
+            ahead_hits = self._ahead_hits
+            ahead_misses = self._ahead_misses
         with self._lock:
             completed = self._completed
             slo_met = self._slo_met
@@ -2208,6 +2506,12 @@ class ServingEngine:
             out["spec_acceptance_rate"] = (
                 round(spec_accepted / spec_proposed, 4)
                 if spec_proposed else None)
+        if self.megastep > 1:
+            out["megastep"] = self.megastep
+            out["dispatch_ahead"] = self.dispatch_ahead
+            if self.dispatch_ahead:
+                out["ahead_hits"] = ahead_hits
+                out["ahead_misses"] = ahead_misses
         out["paged"] = self.paged
         out["attn_impl"] = self.attn_impl
         out["kv_dtype"] = self.kv_dtype

@@ -27,6 +27,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..analysis import concurrency as _ccz
 from .kv_cache import BlockAllocator
 
 __all__ = ["LoRAPool", "make_adapter"]
@@ -77,10 +78,19 @@ class LoRAPool:
             arrs.append(jnp.zeros(
                 (self.num_layers, self.pages, rank, dout), jnp.float32))
         self.arrays = tuple(arrs)
-        self._by_name: Dict[str, int] = {}
+        self._by_name: Dict[str, int] = {}  # guarded-by: _lock
         self._alloc = BlockAllocator(self.pages)
         base = self._alloc.alloc()
         assert base == BASE_PAGE
+        # one pool serves many engines (router replicas, disagg pairs);
+        # with threaded dispatch those engines pin/release pages from
+        # different worker threads, so the refcount plane and the
+        # functional array rebinds serialize here. Reentrant: evict ->
+        # in_use and acquire -> page_of nest. Engines take this after
+        # their own _step_lock; the pool never calls back into an
+        # engine, so the order edge is acyclic.
+        self._lock = _ccz.make_lock("lora_pool._lock", reentrant=True)
+        _ccz.declare_guarded(self, {"arrays": "_lock"})
 
     @property
     def shape_key(self) -> Tuple[int, int]:
@@ -98,13 +108,15 @@ class LoRAPool:
 
     @property
     def loaded(self):
-        return sorted(self._by_name)
+        with self._lock:
+            return sorted(self._by_name)
 
     def page_of(self, name: str) -> int:
         """The live page for a tenant name (``""`` = base page 0)."""
         if not name:
             return BASE_PAGE
-        page = self._by_name.get(name)
+        with self._lock:
+            page = self._by_name.get(name)
         if page is None:
             raise ValueError(
                 f"unknown lora adapter {name!r} (loaded: {self.loaded})")
@@ -112,20 +124,24 @@ class LoRAPool:
 
     def acquire(self, name: str) -> int:
         """Pin a tenant's page for one in-flight request."""
-        page = self.page_of(name)
-        if page != BASE_PAGE:
-            self._alloc.ref(page)
-        return page
+        with self._lock:
+            page = self.page_of(name)
+            if page != BASE_PAGE:
+                self._alloc.ref(page)
+            return page
 
     def release(self, name: str):
-        page = self._by_name.get(name) if name else None
-        if page is not None and self._alloc.refcount[page] > 1:
-            self._alloc.deref(page)
+        with self._lock:
+            page = self._by_name.get(name) if name else None
+            if page is not None and self._alloc.refcount[page] > 1:
+                self._alloc.deref(page)
 
     def in_use(self, name: str) -> int:
         """In-flight requests currently pinning a tenant's page."""
-        page = self._by_name.get(name)
-        return 0 if page is None else int(self._alloc.refcount[page]) - 1
+        with self._lock:
+            page = self._by_name.get(name)
+            return (0 if page is None
+                    else int(self._alloc.refcount[page]) - 1)
 
     def load(self, name: str, state: Dict[str, np.ndarray]) -> int:
         """Load (or hot-reload) an adapter into a pool page.
@@ -151,49 +167,53 @@ class LoRAPool:
                 raise ValueError(
                     f"adapter {name!r} factor {key}: shape {got} != "
                     f"expected {shape}")
-        page = self._by_name.get(name)
-        if page is None:
-            page = self._alloc.alloc()
-            if page is None:
-                raise ValueError(
-                    f"lora pool full ({self.max_adapters} adapters); "
-                    f"evict one first (loaded: {self.loaded})")
-            self._by_name[name] = page
         import jax.numpy as jnp
-        arrs = list(self.arrays)
-        for i, t in enumerate(TARGETS):
-            a = jnp.asarray(state[f"{t}.A"], jnp.float32)
-            b = jnp.asarray(state[f"{t}.B"], jnp.float32)
-            arrs[2 * i] = arrs[2 * i].at[:, page].set(a)
-            arrs[2 * i + 1] = arrs[2 * i + 1].at[:, page].set(b)
-        self.arrays = tuple(arrs)
+        with self._lock:
+            page = self._by_name.get(name)
+            if page is None:
+                page = self._alloc.alloc()
+                if page is None:
+                    raise ValueError(
+                        f"lora pool full ({self.max_adapters} adapters); "
+                        f"evict one first (loaded: {self.loaded})")
+                self._by_name[name] = page
+            arrs = list(self.arrays)
+            for i, t in enumerate(TARGETS):
+                a = jnp.asarray(state[f"{t}.A"], jnp.float32)
+                b = jnp.asarray(state[f"{t}.B"], jnp.float32)
+                arrs[2 * i] = arrs[2 * i].at[:, page].set(a)
+                arrs[2 * i + 1] = arrs[2 * i + 1].at[:, page].set(b)
+            self.arrays = tuple(arrs)
         return page
 
     def evict(self, name: str) -> int:
         """Free a tenant's page; refuses while requests still pin it."""
-        page = self._by_name.get(name)
-        if page is None:
-            raise ValueError(
-                f"unknown lora adapter {name!r} (loaded: {self.loaded})")
-        busy = self.in_use(name)
-        if busy:
-            raise ValueError(
-                f"adapter {name!r} is pinned by {busy} in-flight "
-                f"request(s); drain before evicting")
-        del self._by_name[name]
-        self._alloc.deref(page)
         import jax.numpy as jnp
-        arrs = list(self.arrays)
-        for i in range(len(arrs)):
-            arrs[i] = arrs[i].at[:, page].set(
-                jnp.zeros_like(arrs[i][:, page]))
-        self.arrays = tuple(arrs)
+        with self._lock:
+            page = self._by_name.get(name)
+            if page is None:
+                raise ValueError(
+                    f"unknown lora adapter {name!r} "
+                    f"(loaded: {self.loaded})")
+            busy = self.in_use(name)
+            if busy:
+                raise ValueError(
+                    f"adapter {name!r} is pinned by {busy} in-flight "
+                    f"request(s); drain before evicting")
+            del self._by_name[name]
+            self._alloc.deref(page)
+            arrs = list(self.arrays)
+            for i in range(len(arrs)):
+                arrs[i] = arrs[i].at[:, page].set(
+                    jnp.zeros_like(arrs[i][:, page]))
+            self.arrays = tuple(arrs)
         return page
 
     def leaked(self) -> int:
         """Pages still pinned beyond their load ref (chaos leak check);
         0 when every request released (the base page never counts)."""
-        return int((self._alloc.refcount[1:] > 1).sum())
+        with self._lock:
+            return int((self._alloc.refcount[1:] > 1).sum())
 
 
 def make_adapter(cfg, rank: int, seed: int = 0,

@@ -156,6 +156,7 @@ class ReplicaRouter:
                  engines: Optional[Sequence[ServingEngine]] = None,
                  autoscale=None, hedge_ms: Optional[float] = None,
                  hedge_budget: Optional[float] = None,
+                 dispatch_threads: Optional[int] = None,
                  **engine_kwargs):
         from .. import flags as _flags
         g = _flags.get_flags(["serving_replicas", "serving_autoscale",
@@ -165,7 +166,8 @@ class ReplicaRouter:
                               "serving_hedge_budget",
                               "serving_breaker_window",
                               "serving_breaker_threshold",
-                              "serving_breaker_cooldown_s"])
+                              "serving_breaker_cooldown_s",
+                              "serving_dispatch_threads"])
         self._strike_limit = max(1, int(g["serving_replica_strikes"]))
         self._auto_restart = bool(g["serving_auto_restart"])
         # hedged prefill (Dean & Barroso tail-at-scale): 0 = off,
@@ -184,6 +186,22 @@ class ReplicaRouter:
         self._brk_window_n = max(0, int(g["serving_breaker_window"]))
         self._brk_threshold = float(g["serving_breaker_threshold"])
         self._brk_cooldown = float(g["serving_breaker_cooldown_s"])
+        # threaded replica dispatch (0 = the serial loop, byte-identical
+        # scheduling): step() fans _step_replica over a bounded
+        # persistent worker pool so one slow replica's device dispatch
+        # doesn't serialize the fleet's step. Per-replica health /
+        # breaker state is only ever touched by the one worker stepping
+        # that replica, and reaping/hedging/autoscale stay on the
+        # caller's thread at the step boundary, so supervision
+        # semantics match the serial loop exactly.
+        self._dispatch_threads = int(
+            dispatch_threads if dispatch_threads is not None
+            else g["serving_dispatch_threads"])
+        if self._dispatch_threads < 0:
+            raise ValueError(
+                "dispatch_threads must be >= 0, got "
+                f"{self._dispatch_threads}")
+        self._step_pool = None   # lazily-built ThreadPoolExecutor
         if autoscale is None:
             bounds = _parse_autoscale(g["serving_autoscale"])
             if bounds is not None:
@@ -897,22 +915,57 @@ class ReplicaRouter:
                           retiring=retiring)
 
     # ---------------------------------------------------------- stepping
+    def _dispatch_pool(self):
+        """The persistent bounded worker pool for threaded dispatch,
+        built on first use and shut down by :meth:`stop`."""
+        if self._step_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._step_pool = ThreadPoolExecutor(
+                max_workers=self._dispatch_threads,
+                thread_name_prefix=f"router{self._rid}-dispatch")
+        return self._step_pool
+
     def step(self) -> bool:
         """One scheduler iteration on every replica — retiring ones
         included, so scale-down drains rather than sheds — under the
         strike watchdog (an unproductive replica turns suspect, then
         dead and torn down/replaced), then one autoscale decision
         (deterministic test/benchmark path). Returns whether any
-        replica worked."""
+        replica worked.
+
+        With ``FLAGS_serving_dispatch_threads`` > 0 (or the
+        ``dispatch_threads=`` constructor override) the per-replica
+        steps run concurrently from a bounded worker pool instead of
+        the serial loop: each replica's device work overlaps its
+        peers' Python scheduling. The barrier at the end of the
+        fan-out keeps every fleet-level transition — strike reaping,
+        hedge resolution, autoscale — at the step boundary, exactly
+        where the serial loop applies them."""
         self._check_replica_fault()
         self._fire_due_hedges()
         worked = False
-        for eng in list(self.engines):
-            if eng in self.engines:     # not torn down this iteration
-                worked = self._step_replica(eng) or worked
-        self._reap_dead()
-        for eng in list(self._retiring):
-            worked = eng.step() or worked
+        if self._dispatch_threads > 0:
+            pool = self._dispatch_pool()
+            futs = [pool.submit(self._step_replica, eng)
+                    for eng in list(self.engines)]
+            futs += [pool.submit(eng.step)
+                     for eng in list(self._retiring)]
+            err = None
+            for f in futs:
+                try:
+                    worked = bool(f.result()) or worked
+                except Exception as e:   # match serial: first raiser
+                    err = err or e       # propagates after the barrier
+            self._reap_dead()
+            if err is not None:
+                raise err
+        else:
+            for eng in list(self.engines):
+                if eng in self.engines:  # not torn down this iteration
+                    worked = self._step_replica(eng) or worked
+            self._reap_dead()
+            for eng in list(self._retiring):
+                worked = eng.step() or worked
         self._resolve_hedges()
         if self._autoscale is not None:
             self._maybe_autoscale()
@@ -1187,6 +1240,9 @@ class ReplicaRouter:
     def stop(self):
         for eng in self.engines + self._retiring:
             eng.stop()
+        if self._step_pool is not None:
+            self._step_pool.shutdown(wait=True)
+            self._step_pool = None
 
     def stats(self) -> dict:
         """Router-level view: replica count, per-replica queue depths
@@ -1255,6 +1311,8 @@ class ReplicaRouter:
         }
         if self._hedge_ms != 0.0:
             out["hedges"] = hedges
+        if self._dispatch_threads > 0:
+            out["dispatch_threads"] = self._dispatch_threads
         if self._brk_window_n > 0:
             out["breaker"] = [e._brk_state for e in live]
         if tenants:

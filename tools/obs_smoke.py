@@ -75,6 +75,14 @@ Asserts, end to end through the observability plane:
     serving_session_resume events, mints the migration/session
     metrics, and matches the predictor's ``host_tier``/``sessions``
     validated-no-op claim (predicted == observed);
+  - a device-resident decode-megastep episode
+    (FLAGS_serving_megastep=4 + FLAGS_serving_dispatch_ahead): N
+    decode iterations per compiled dispatch stay token-identical to a
+    megastep=1 engine at the same flags version, the decode plane
+    traces exactly its TWO predicted surfaces (the megastep entry and
+    the single-token fallback a caps-exceeding stop list forces), no
+    KV blocks leak, and predict_serving_compiles(megastep=4) equals
+    the live tracker;
   - GET /metrics on ServingHTTPServer parses as Prometheus text and
     carries serving, fault, compile, KV block-pool, attention-impl,
     int8-quantization, SLO-admission and tracing metrics;
@@ -851,6 +859,76 @@ def main() -> int:
           f"promote {stT['migrated_promote_blocks']} blocks, resume "
           f"token-identical, 0 leaks both tiers, {deltaT} == predicted")
 
+    # -- megastep phase: device-resident decode megasteps -------------
+    # FLAGS_serving_megastep=N runs N decode iterations inside ONE
+    # compiled dispatch (lax.scan carrying the paged pools, early-exit
+    # state as data) and FLAGS_serving_dispatch_ahead enqueues
+    # megastep k+1 against the un-synced carries while k executes.
+    # The decode plane has exactly TWO compile surfaces under N > 1:
+    # decode_megastep_paged{n=N}, plus the single-token fallback the
+    # scheduler drops to whenever a megastep is unsafe for the whole
+    # batch — driven here by a request whose stop list exceeds the
+    # device stop-table caps. This burst exercises both, tokens must
+    # equal a megastep=1 engine's at the same flags version (which
+    # itself adds ZERO compiles: the fallback already retraced
+    # decode_step_paged), and the per-phase delta must equal
+    # predict_serving_compiles(megastep=4).
+    from paddle_tpu.serving.decoding import STOP_MAX_SEQS
+    baseM = {site: c["count"]
+             for site, c in observability.compiles().items()
+             if site.startswith(("serving_", "decode_", "verify_"))}
+    pt.set_flags({"serving_megastep": 4,
+                  "serving_dispatch_ahead": True,
+                  "serving_host_tier": False})
+    try:
+        engM = ServingEngine(model, max_slots=3, max_len=32,
+                             buckets=[8, 16], max_queue=16,
+                             block_size=4)
+        big_stops = [[90 + j] for j in range(STOP_MAX_SEQS + 1)]
+        reqsM = [engM.submit(p, max_new_tokens=8) for p in prompts]
+        reqsM.append(engM.submit(prompts[0], max_new_tokens=8,
+                                 stop=big_stops))
+        engM.run_until_idle()
+        assert all(r.state == "done" for r in reqsM)
+        stM = engM.stats()
+        assert stM["megastep"] == 4 and stM["dispatch_ahead"], stM
+        assert stM["ahead_hits"] + stM["ahead_misses"] >= 1, stM
+        eng1 = ServingEngine(model, max_slots=3, max_len=32,
+                             buckets=[8, 16], max_queue=16,
+                             block_size=4, megastep=1,
+                             dispatch_ahead=False)
+        reqs1 = [eng1.submit(p, max_new_tokens=8) for p in prompts]
+        reqs1.append(eng1.submit(prompts[0], max_new_tokens=8,
+                                 stop=big_stops))
+        eng1.run_until_idle()
+        for a, b in zip(reqsM, reqs1):
+            assert a.output_ids == b.output_ids, (
+                f"megastep=4 diverged on request {a.id}: "
+                f"{a.output_ids} vs {b.output_ids}")
+        engM.cache.flush_prefix_cache()
+        assert engM.cache.allocator.leaked() == 1  # trash block only
+        afterM = {site: c["count"]
+                  for site, c in observability.compiles().items()
+                  if site.startswith(("serving_", "decode_",
+                                      "verify_"))}
+        deltaM = {site: n - baseM.get(site, 0)
+                  for site, n in afterM.items()
+                  if n - baseM.get(site, 0)}
+        workloadM = [[(p, 8) for p in prompts] + [(prompts[0], 8)]]
+        predM = predict_serving_compiles(
+            workloadM, buckets=[8, 16], max_len=32, block_size=4,
+            megastep=4)
+        assert deltaM == predM, (
+            f"megastep-phase recompile prediction drifted:\n"
+            f"  predicted {predM}\n  observed  {deltaM}")
+        print(f"   megastep: N=4 + dispatch-ahead token-identical to "
+              f"N=1 ({stM['ahead_hits']} ahead hits / "
+              f"{stM['ahead_misses']} misses), both decode surfaces "
+              f"traced, {deltaM} == predicted")
+    finally:
+        pt.set_flags({"serving_megastep": 1,
+                      "serving_dispatch_ahead": False})
+
     # -- /metrics scrape ----------------------------------------------
     srv = ServingHTTPServer(eng, port=0)
     srv.start()
@@ -905,7 +983,8 @@ def main() -> int:
               "serving_lora_load", "serving_replica_kill",
               "serving_replica_recover", "serving_cancel",
               "serving_hedge", "serving_kv_demote",
-              "serving_kv_promote", "serving_session_resume"):
+              "serving_kv_promote", "serving_session_resume",
+              "serving_megastep"):
         assert k in kinds, f"run log missing {k!r} events (got {kinds})"
     from tools import trace_summary
     rc = trace_summary.main([path, "--top", "5"])
