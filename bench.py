@@ -1054,6 +1054,13 @@ def child_main_loadgen(batch: int, seq: int, steps: int) -> int:
         "device": getattr(dev, "device_kind", str(dev)),
     }
     out["observability"] = observability.snapshot()
+    # BENCH_LEDGER=PATH: feed the SLO-aware arm (the headline goodput
+    # number) into the perf-regression ledger alongside loadgen/soak
+    ledger = os.environ.get("BENCH_LEDGER")
+    if ledger:
+        from tools import perf_ledger
+        out["ledger_row"] = perf_ledger.append_report(
+            ledger, rep_b, run="bench", label="loadgen")
     print(json.dumps(out))
     return 0
 

@@ -547,3 +547,31 @@ define_flag("serving_trace_keep", 512,
             "queryable via GET /v1/requests/<id> and the exporters; "
             "older ids 404. Active (in-flight) traces are never "
             "evicted.")
+define_flag("serving_devprof", False,
+            "Device-cost observatory (observability/devprof.py): on "
+            "every tracked_jit compile, capture the lowered entry's "
+            "XLA cost_analysis() (flops, HBM bytes, output bytes) "
+            "into devprof.cost_table() and the xla_cost{fn,metric} "
+            "gauges, and arm the engine's sampled device timer "
+            "(FLAGS_serving_devprof_sample). Cost capture lowers the "
+            "raw step function out-of-band, so the tracked compile "
+            "counters never move — predict_serving_compiles("
+            "devprof=True) is a validated no-op.")
+define_flag("serving_devprof_sample", 0.1,
+            "Device-timing sampling fraction under "
+            "FLAGS_serving_devprof: a deterministic hash of the "
+            "engine's dispatch counter picks which step dispatches "
+            "get a block_until_ready timer (device ms histograms, "
+            "roofline MFU/HBM-utilization gauges, host/device blame "
+            "split). Skipped dispatches keep the PR 19 async/"
+            "dispatch-ahead path untouched; 0 samples nothing (bit-"
+            "identical to devprof off on the step path).")
+define_flag("devprof_peak_flops", 0.0,
+            "Roofline peak compute (FLOP/s) the MFU gauge divides by. "
+            "0 (default) picks a per-platform nominal: 275e12 (TPU), "
+            "312e12 (GPU), 1e11 (CPU) — pin it to your part's "
+            "datasheet number for honest MFU.")
+define_flag("devprof_peak_hbm_gbps", 0.0,
+            "Roofline peak memory bandwidth (GB/s) the HBM-"
+            "utilization gauge divides by. 0 (default) picks a "
+            "per-platform nominal: 1200 (TPU), 2000 (GPU), 50 (CPU).")

@@ -110,6 +110,7 @@ from ..analysis import concurrency as _ccz
 from .. import observability as _obs
 from .. import profiler as _profiler
 from ..observability import compile_tracker as _ct
+from ..observability import devprof as _devprof
 from ..observability import runlog as _runlog
 from ..observability import tracing as _tracing
 from ..dygraph.tape import no_grad
@@ -359,7 +360,9 @@ class ServingEngine:
                  lora_max_adapters: Optional[int] = None,
                  lora_pool=None, grammar=None, kv_tier=None,
                  megastep: Optional[int] = None,
-                 dispatch_ahead: Optional[bool] = None):
+                 dispatch_ahead: Optional[bool] = None,
+                 devprof: Optional[bool] = None,
+                 devprof_sample: Optional[float] = None):
         g = _flags.get_flags(["serving_max_slots", "serving_max_len",
                               "serving_max_queue",
                               "serving_prefill_buckets",
@@ -382,7 +385,9 @@ class ServingEngine:
                               "serving_lora_rank",
                               "serving_lora_max_adapters",
                               "serving_host_tier",
-                              "serving_host_blocks"])
+                              "serving_host_blocks",
+                              "serving_devprof",
+                              "serving_devprof_sample"])
         self.model = model
         cfg = model.gpt.cfg
         self.max_slots = int(max_slots if max_slots is not None
@@ -721,6 +726,21 @@ class ServingEngine:
                 "rows written by this engine's compiled steps"
                 ).labels(engine=eid)
             self._qerr_gauge.set(0.0)
+        # Device-cost observatory (observability/devprof.py): sampled
+        # block_until_ready timing around step dispatches, on the
+        # ENGINE clock so virtual-clock replays stay deterministic.
+        # Constructor/flag state like the SLO knobs — never set_flags
+        # mid-run. The cost-capture half rides tracked_jit's compile
+        # branch and needs no engine state; sampling decisions hash
+        # the dispatch counter, so the async dispatch-ahead path is
+        # untouched on every skipped (1 - sample rate) dispatch.
+        self._devprof = None
+        if bool(devprof if devprof is not None
+                else g["serving_devprof"]):
+            self._devprof = _devprof.DevProfiler(
+                sample=(devprof_sample if devprof_sample is not None
+                        else float(g["serving_devprof_sample"])),
+                gauge_labels={"engine": eid})
         # dynamic half of the `# guarded-by:` declarations above: under
         # FLAGS_sanitize_locks a rebinding write to any of these without
         # the named lock held raises GuardedStateError. Construction
@@ -1562,6 +1582,8 @@ class ServingEngine:
             for g_req, _row, _shared in group:
                 _tracing.mark(g_req.id, "admit", t_adm,
                               self.trace_track)
+            timer = self._devprof_timer(
+                f"serving_prefill_paged{{bucket={bucket}}}")
             t0 = time.perf_counter()
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
@@ -1576,8 +1598,13 @@ class ServingEngine:
                     self._shed(req, e)
                 continue
             if out is not None:
+                # EMA window closes BEFORE the devprof sync: the
+                # block_until_ready below must not inflate the cost
+                # estimate that drives SLO admission
                 self._note_prefill_ms(
                     bucket, (time.perf_counter() - t0) * 1e3)
+            if timer is not None and out is not None:
+                timer.device_done(out)
             for (req, row, _), err in shed:
                 self.cache.release_row(row)
                 self._shed(req, err)
@@ -1615,6 +1642,8 @@ class ServingEngine:
                                   self.trace_track)
                 self._append_token(req,
                                    self._take_first(req, first, lg, i))
+            if timer is not None and out is not None:
+                timer.finish()
         return expired + len(candidates) - len(back), admitted
 
     def _admit_round(self):  # holds: _step_lock
@@ -1637,6 +1666,8 @@ class ServingEngine:
             for g_req in group:
                 _tracing.mark(g_req.id, "admit", t_adm,
                               self.trace_track)
+            timer = self._devprof_timer(
+                f"serving_prefill{{bucket={bucket}}}")
             t0 = time.perf_counter()
             try:
                 with _monitor.stat_time("STAT_serving_prefill"), \
@@ -1649,8 +1680,12 @@ class ServingEngine:
                     self._shed(req, e)
                 continue
             if out is not None:
+                # EMA window closes before the devprof sync (see the
+                # paged twin above)
                 self._note_prefill_ms(
                     bucket, (time.perf_counter() - t0) * 1e3)
+            if timer is not None and out is not None:
+                timer.device_done(out)
             for req, err in shed:
                 self._shed(req, err)
             if not live:
@@ -1677,6 +1712,8 @@ class ServingEngine:
                 # prefill; sampled/masked rows draw from them instead)
                 self._append_token(req,
                                    self._take_first(req, first, lg, i))
+            if timer is not None and out is not None:
+                timer.finish()
         return expired + len(candidates), admitted
 
     def _take_first(self, req: Request, first: np.ndarray, lg,
@@ -1800,6 +1837,20 @@ class ServingEngine:
                 _runlog.log_event("serving_kv_quant",
                                   max_abs_err=round(e, 6), rows=int(rows))
 
+    def _devprof_timer(self, entry):  # holds: _step_lock
+        """A StepTimer when devprof is on AND this dispatch hashed
+        into the sample, else None. The tick consumes one counter
+        increment either way, so two same-seed runs sample the same
+        step indices; a None costs nothing further — the async /
+        dispatch-ahead structure of a skipped dispatch is untouched.
+        Timestamps come off the ENGINE clock: virtual-clock replays
+        measure deterministic (zero-wall) splits and stay
+        byte-identical."""
+        dp = self._devprof
+        if dp is None or not dp.tick():
+            return None
+        return _devprof.StepTimer(dp, entry, self._clock)
+
     def _decode(self) -> int:  # holds: _step_lock
         """One batched decode over every occupied slot. Returns how
         many tokens were produced (0 when idle/skipped)."""
@@ -1808,6 +1859,8 @@ class ServingEngine:
         tokens = np.zeros(self.max_slots, np.int32)
         for slot, req in self._active.items():
             tokens[slot] = req.tokens[-1]
+        timer = self._devprof_timer(
+            "decode_step_paged" if self.paged else "decode_step")
         t0 = time.perf_counter()
         try:
             with _monitor.stat_time("STAT_serving_decode"), \
@@ -1827,8 +1880,12 @@ class ServingEngine:
         # the TPOT EWMA is per *committed token*: one step commits
         # exactly one token per active slot here, so the step wall is
         # already a per-token sample (the megastep and spec paths
-        # divide by tokens committed explicitly)
+        # divide by tokens committed explicitly). Closed BEFORE the
+        # devprof sync so block_until_ready never inflates the cost
+        # estimate that drives SLO admission.
         self._note_tpot_ms((time.perf_counter() - t0) * 1e3)
+        if timer is not None:
+            timer.device_done(out)   # block_until_ready + stamp
         if self.paged:
             nxt, _, arrays, qerr, new_keys = out
             self._note_qerr(qerr, len(self._active))
@@ -1842,6 +1899,8 @@ class ServingEngine:
             self.cache.advance(slot, 1)
             self._append_token(req, int(nxt[slot]))
             produced += 1
+        if timer is not None:
+            timer.finish()   # host_s = the commit loop above
         return produced
 
     # ------------------------------------------------ decode megasteps
@@ -2009,6 +2068,7 @@ class ServingEngine:
         if not self._active:
             return 0
         n_active = len(self._active)
+        timer = self._devprof_timer(f"decode_megastep_paged{{n={n}}}")
         t0 = time.perf_counter()
         try:
             with _monitor.stat_time("STAT_serving_decode"), \
@@ -2025,6 +2085,12 @@ class ServingEngine:
             return 0
         (toks, finish, _tok_f, _pos_f, pools_f, keys_f, _live_f,
          _rem_f, _st_f, qerr) = out
+        if timer is not None:
+            # the one documented sampling cost: block on megastep k
+            # BEFORE enqueuing k+1, so the measured device time is
+            # k's alone. The (1 - sample rate) majority of megasteps
+            # skip this and keep the dispatch-ahead overlap intact.
+            timer.device_done(out)
         if self.dispatch_ahead:
             # enqueue k+1 behind k on the device BEFORE the host
             # blocks on k's results: commit work below overlaps it
@@ -2057,6 +2123,8 @@ class ServingEngine:
             # calibrated at megastep > 1)
             self._note_tpot_ms((time.perf_counter() - t0) * 1e3 *
                                n_active / produced)
+        if timer is not None:
+            timer.finish()
         if _runlog.enabled():
             _runlog.log_event("serving_megastep", n=n, active=n_active,
                               produced=produced)
@@ -2112,6 +2180,9 @@ class ServingEngine:
             tokens[slot, 0] = req.tokens[-1]
             tokens[slot, 1:] = d
         n_active = len(self._active)
+        timer = self._devprof_timer(
+            f"verify_step_paged{{k={K}}}" if self.paged
+            else f"verify_step{{k={K}}}")
         t0 = time.perf_counter()
         try:
             with _monitor.stat_time("STAT_serving_verify"), \
@@ -2126,6 +2197,8 @@ class ServingEngine:
                 self.cache.release(slot)
                 self._shed(req, e)
             return 0
+        if timer is not None:
+            timer.device_done(out)
         if self.paged:
             nxt, _, arrays, qerr, accept, new_keys = out
             self._note_qerr(qerr, (K + 1) * len(self._active))
@@ -2167,6 +2240,8 @@ class ServingEngine:
             # tokens each slot actually committed this step
             self._note_tpot_ms((time.perf_counter() - t0) * 1e3 *
                                n_active / produced)
+        if timer is not None:
+            timer.finish()
         return produced
 
     # -------------------------------------------------------- lifecycle
@@ -2258,6 +2333,15 @@ class ServingEngine:
             if req._session_counted:
                 req._session_counted = False
                 self.kv_tier.session_released(req.session)
+        if self._devprof is not None:
+            # annotate the sampled device share so blame() splits this
+            # trace's decode into decode_device + decode_host. None
+            # (no samples yet, or a virtual-clock run whose samples
+            # are zero-width) leaves the trace — and its exported
+            # bytes — exactly as without devprof.
+            frac = self._devprof.device_frac()
+            if frac is not None:
+                _tracing.annotate(req.id, decode_device_frac=frac)
         _tracing.finish(req.id, req.finished_at, self.trace_track,
                         "done")
         req._done.set()
@@ -2541,6 +2625,11 @@ class ServingEngine:
             # fleet-shared numbers when the tier is shared: every
             # attached engine reports the same store/session totals
             out["kv_tier"] = self.kv_tier.stats()
+        if self._devprof is not None:
+            # sampled roofline view (device/host split, per-entry
+            # MFU/HBM utilization and verdicts) — flows into
+            # GET /v1/stats with the rest of this dict
+            out["devprof"] = self._devprof.stats()
         if self.paged:
             c = self.cache
             hit_t, miss_t = c.prefix_hits, c.prefix_misses
